@@ -1,0 +1,7 @@
+"""Common utilities shared by every layer (ref: src/common)."""
+
+from horaedb_tpu.common.error import Error, ensure
+from horaedb_tpu.common.size_ext import ReadableSize
+from horaedb_tpu.common.time_ext import ReadableDuration, now_ms
+
+__all__ = ["Error", "ensure", "ReadableDuration", "ReadableSize", "now_ms"]

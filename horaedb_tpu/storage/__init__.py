@@ -1,0 +1,33 @@
+"""TimeMergeStorage: LSM-on-object-storage engine (ref: src/storage)."""
+
+from horaedb_tpu.storage.config import (
+    ColumnOptions,
+    ManifestConfig,
+    SchedulerConfig,
+    StorageConfig,
+    UpdateMode,
+    WriteConfig,
+)
+from horaedb_tpu.storage.types import (
+    BUILTIN_COLUMN_NUM,
+    RESERVED_COLUMN_NAME,
+    SEQ_COLUMN_NAME,
+    StorageSchema,
+    Timestamp,
+    TimeRange,
+)
+
+__all__ = [
+    "BUILTIN_COLUMN_NUM",
+    "ColumnOptions",
+    "ManifestConfig",
+    "RESERVED_COLUMN_NAME",
+    "SEQ_COLUMN_NAME",
+    "SchedulerConfig",
+    "StorageConfig",
+    "StorageSchema",
+    "TimeRange",
+    "Timestamp",
+    "UpdateMode",
+    "WriteConfig",
+]

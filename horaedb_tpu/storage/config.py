@@ -1,0 +1,165 @@
+"""Engine configuration (ref: src/storage/src/config.rs).
+
+Field names and defaults track the reference's TOML keys so configs are
+interchangeable: scheduler (config.rs:24-50), parquet encodings (52-94),
+per-column overrides (96-103), write props (105-133), manifest (135-155),
+UpdateMode (166-172).  Unknown keys are rejected (serde deny_unknown_fields
+equivalent) by `from_dict`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from horaedb_tpu.common import Error, ReadableDuration, ReadableSize, ensure
+
+
+class UpdateMode(enum.Enum):
+    """Row-merge semantics for duplicate primary keys (ref: config.rs:166-172).
+
+    OVERWRITE keeps the row with the highest sequence (LastValueOperator);
+    APPEND concatenates binary value columns (BytesMergeOperator).
+    """
+
+    OVERWRITE = "Overwrite"
+    APPEND = "Append"
+
+
+class CompressionCodec(enum.Enum):
+    UNCOMPRESSED = "uncompressed"
+    SNAPPY = "snappy"
+    ZSTD = "zstd"
+    LZ4 = "lz4"
+    GZIP = "gzip"
+
+
+@dataclass
+class SchedulerConfig:
+    """Compaction scheduler knobs (ref: config.rs:24-50)."""
+
+    schedule_interval: ReadableDuration = field(
+        default_factory=lambda: ReadableDuration.from_secs(10))
+    max_pending_compaction_tasks: int = 10
+    # Executor memory gate (ref: executor.rs:93-114 uses 2 GiB default).
+    memory_limit: ReadableSize = field(default_factory=lambda: ReadableSize.gb(2))
+    # Picker thresholds (ref: picker.rs defaults).
+    max_record_batch_size: int = 8192
+    input_sst_max_num: int = 30
+    input_sst_min_num: int = 5
+    new_sst_max_size: ReadableSize = field(default_factory=lambda: ReadableSize.gb(1))
+    ttl: Optional[ReadableDuration] = None
+
+
+@dataclass
+class ColumnOptions:
+    """Per-column parquet writer overrides (ref: config.rs:96-103)."""
+
+    enable_dict: Optional[bool] = None
+    enable_bloom_filter: Optional[bool] = None
+    encoding: Optional[str] = None
+    compression: Optional[CompressionCodec] = None
+
+
+@dataclass
+class WriteConfig:
+    """Parquet writer properties (ref: config.rs:105-133)."""
+
+    max_row_group_size: int = 8192
+    write_batch_size: int = 1024
+    enable_sorting_columns: bool = True
+    enable_dict: bool = False
+    enable_bloom_filter: bool = False
+    encoding: Optional[str] = None
+    compression: CompressionCodec = CompressionCodec.SNAPPY
+    column_options: dict[str, ColumnOptions] = field(default_factory=dict)
+
+
+@dataclass
+class ManifestConfig:
+    """Manifest merge thresholds (ref: config.rs:135-155, manifest/mod.rs:48-50)."""
+
+    channel_size: int = 3
+    merge_interval: ReadableDuration = field(
+        default_factory=lambda: ReadableDuration.from_secs(5))
+    min_merge_threshold: int = 10
+    hard_merge_threshold: int = 90
+    soft_merge_threshold: int = 50
+
+
+@dataclass
+class StorageConfig:
+    """Top-level engine config (ref: config.rs:157-164)."""
+
+    write: WriteConfig = field(default_factory=WriteConfig)
+    manifest: ManifestConfig = field(default_factory=ManifestConfig)
+    scheduler: SchedulerConfig = field(default_factory=SchedulerConfig)
+    update_mode: UpdateMode = UpdateMode.OVERWRITE
+
+
+_DURATION_FIELDS = {"schedule_interval", "merge_interval", "ttl"}
+_SIZE_FIELDS = {"memory_limit", "new_sst_max_size"}
+# Nested sections, keyed by field name.  This dict is THE mechanism for
+# nested coercion: add new nested config dataclasses here.
+_NESTED = {
+    "write": WriteConfig,
+    "manifest": ManifestConfig,
+    "scheduler": SchedulerConfig,
+}
+
+
+def _coerce(cls: type, f: dataclasses.Field, value: Any) -> Any:
+    where = f"{cls.__name__}.{f.name}"
+    if value is None:
+        return None
+    if f.name in _DURATION_FIELDS:
+        if isinstance(value, ReadableDuration):
+            return value
+        ensure(isinstance(value, str), f'{where} expects a duration string like "10s"')
+        return ReadableDuration.parse(value)
+    if f.name in _SIZE_FIELDS:
+        if isinstance(value, ReadableSize):
+            return value
+        ensure(isinstance(value, str), f'{where} expects a size string like "2GB"')
+        return ReadableSize.parse(value)
+    if f.name == "update_mode":
+        if isinstance(value, UpdateMode):
+            return value
+        try:
+            return UpdateMode(value)
+        except ValueError as e:
+            raise Error.context(
+                f"{where}: expected one of {[m.value for m in UpdateMode]}", e)
+    if f.name == "compression":
+        if isinstance(value, CompressionCodec):
+            return value
+        try:
+            return CompressionCodec(str(value).lower())
+        except ValueError as e:
+            raise Error.context(
+                f"{where}: expected one of {[c.value for c in CompressionCodec]}", e)
+    if f.name == "column_options":
+        ensure(isinstance(value, dict), f"{where} expects a table of column options")
+        return {k: from_dict(ColumnOptions, v) for k, v in value.items()}
+    if f.name in _NESTED:
+        ensure(isinstance(value, dict), f"{where} expects a config table")
+        return from_dict(_NESTED[f.name], value)
+    return value
+
+
+def from_dict(cls: type, data: dict[str, Any]) -> Any:
+    """Build a config dataclass from a parsed TOML/JSON dict.
+
+    Rejects unknown keys, mirroring serde's deny_unknown_fields
+    (ref: config.rs:24-26 and every config struct), and validates value
+    types at load time so misconfigurations fail here, not mid-flight.
+    """
+    ensure(isinstance(data, dict), f"{cls.__name__} config must be a table")
+    names = {f.name: f for f in dataclasses.fields(cls)}
+    unknown = set(data) - set(names)
+    if unknown:
+        raise Error(f"unknown config keys for {cls.__name__}: {sorted(unknown)}")
+    kwargs = {key: _coerce(cls, names[key], value) for key, value in data.items()}
+    return cls(**kwargs)

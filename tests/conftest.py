@@ -1,0 +1,18 @@
+"""Test harness setup.
+
+Force JAX onto the CPU backend with 8 virtual devices BEFORE jax is
+imported anywhere, so multi-chip sharding (Mesh/shard_map) is testable
+without real TPU hardware.  Must happen at conftest import time.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,87 @@
+"""Tests for horaedb_tpu.common (ref tests: src/common/src/*.rs inline tests)."""
+
+import pytest
+
+from horaedb_tpu.common import Error, ReadableDuration, ReadableSize, ensure
+
+
+class TestEnsure:
+    def test_pass(self):
+        ensure(True, "ok")
+
+    def test_fail(self):
+        with pytest.raises(Error, match="boom"):
+            ensure(False, "boom")
+
+    def test_context_chain(self):
+        cause = ValueError("inner")
+        err = Error.context("outer", cause)
+        assert err.__cause__ is cause
+
+
+class TestReadableDuration:
+    @pytest.mark.parametrize(
+        "text,millis",
+        [
+            ("500ms", 500),
+            ("12h", 12 * 3600 * 1000),
+            ("1d", 24 * 3600 * 1000),
+            ("2m", 120_000),
+            ("30s", 30_000),
+            ("1h30m", 90 * 60 * 1000),
+            ("1d2h3m4s5ms", ((26 * 60 + 3) * 60 + 4) * 1000 + 5),
+            ("0.5h", 1_800_000),
+            ("0s", 0),
+        ],
+    )
+    def test_parse(self, text, millis):
+        assert ReadableDuration.parse(text).millis == millis
+
+    @pytest.mark.parametrize("text", ["", "abc", "1x", "5", "1m1h", "1s500ms1s", "-1s"])
+    def test_parse_invalid(self, text):
+        with pytest.raises(Error):
+            ReadableDuration.parse(text)
+
+    @pytest.mark.parametrize("text", ["500ms", "12h", "1h30m", "1d2h3m4s5ms", "0s"])
+    def test_roundtrip(self, text):
+        d = ReadableDuration.parse(text)
+        assert ReadableDuration.parse(str(d)) == d
+
+    def test_accessors(self):
+        assert ReadableDuration.from_secs(1.5).millis == 1500
+        assert ReadableDuration.from_millis(250).seconds == 0.25
+
+
+class TestReadableSize:
+    @pytest.mark.parametrize(
+        "text,num",
+        [
+            ("0", 0),
+            ("123", 123),
+            ("1b", 1),
+            ("2KB", 2048),
+            ("2kib", 2048),
+            ("512MB", 512 * 1024**2),
+            ("2GB", 2 * 1024**3),
+            ("1.5k", 1536),
+            ("4T", 4 * 1024**4),
+            ("1PB", 1024**5),
+        ],
+    )
+    def test_parse(self, text, num):
+        assert ReadableSize.parse(text).bytes == num
+
+    @pytest.mark.parametrize("text", ["", "abc", "1zb", "-5", "1 2"])
+    def test_parse_invalid(self, text):
+        with pytest.raises(Error):
+            ReadableSize.parse(text)
+
+    def test_roundtrip(self):
+        for text in ["2GB", "512MB", "1KB", "123B"]:
+            s = ReadableSize.parse(text)
+            assert ReadableSize.parse(str(s)) == s
+
+    def test_constructors(self):
+        assert ReadableSize.gb(2).bytes == 2 * 1024**3
+        assert ReadableSize.mb(3).bytes == 3 * 1024**2
+        assert ReadableSize.kb(5).bytes == 5 * 1024

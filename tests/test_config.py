@@ -1,0 +1,68 @@
+"""Tests for config loading (ref: src/storage/src/config.rs serde behavior)."""
+
+import pytest
+
+from horaedb_tpu.common import Error, ReadableDuration, ReadableSize
+from horaedb_tpu.storage.config import (
+    CompressionCodec,
+    StorageConfig,
+    UpdateMode,
+    WriteConfig,
+    from_dict,
+)
+
+
+def test_defaults():
+    cfg = StorageConfig()
+    assert cfg.update_mode is UpdateMode.OVERWRITE
+    assert cfg.write.max_row_group_size == 8192
+    assert cfg.write.write_batch_size == 1024
+    assert cfg.write.compression is CompressionCodec.SNAPPY
+    assert cfg.manifest.soft_merge_threshold == 50
+    assert cfg.manifest.hard_merge_threshold == 90
+    assert cfg.scheduler.max_pending_compaction_tasks == 10
+    assert cfg.scheduler.input_sst_min_num == 5
+
+
+def test_from_dict_full():
+    cfg = from_dict(
+        StorageConfig,
+        {
+            "update_mode": "Append",
+            "write": {"compression": "zstd", "enable_dict": True,
+                      "column_options": {"value": {"enable_bloom_filter": True}}},
+            "manifest": {"merge_interval": "2s"},
+            "scheduler": {"memory_limit": "512MB", "ttl": "7d"},
+        },
+    )
+    assert cfg.update_mode is UpdateMode.APPEND
+    assert cfg.write.compression is CompressionCodec.ZSTD
+    assert cfg.write.column_options["value"].enable_bloom_filter is True
+    assert cfg.manifest.merge_interval == ReadableDuration.parse("2s")
+    assert cfg.scheduler.memory_limit == ReadableSize.parse("512MB")
+    assert cfg.scheduler.ttl == ReadableDuration.parse("7d")
+
+
+def test_deny_unknown_fields():
+    with pytest.raises(Error, match="unknown config keys"):
+        from_dict(StorageConfig, {"wrtie": {}})
+    with pytest.raises(Error, match="ManifestConfig"):
+        from_dict(StorageConfig, {"manifest": {"bogus": 1}})
+
+
+def test_wrong_value_types_fail_at_load():
+    with pytest.raises(Error, match="duration string"):
+        from_dict(StorageConfig, {"scheduler": {"schedule_interval": 10}})
+    with pytest.raises(Error, match="size string"):
+        from_dict(StorageConfig, {"scheduler": {"memory_limit": 2}})
+    with pytest.raises(Error, match="config table"):
+        from_dict(StorageConfig, {"write": "fast"})
+
+
+def test_bad_enum_values_raise_framework_error():
+    with pytest.raises(Error, match="update_mode"):
+        from_dict(StorageConfig, {"update_mode": "overwrite"})  # case matters
+    with pytest.raises(Error, match="compression"):
+        from_dict(WriteConfig, {"compression": "brotli9000"})
+    # compression is case-normalized
+    assert from_dict(WriteConfig, {"compression": "ZSTD"}).compression is CompressionCodec.ZSTD

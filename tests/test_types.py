@@ -1,0 +1,112 @@
+"""Tests for storage types (ref tests: src/storage/src/types.rs:242-302)."""
+
+import pyarrow as pa
+import pytest
+
+from horaedb_tpu.common import Error
+from horaedb_tpu.storage import (
+    RESERVED_COLUMN_NAME,
+    SEQ_COLUMN_NAME,
+    StorageSchema,
+    TimeRange,
+    Timestamp,
+    UpdateMode,
+)
+
+
+class TestTimestamp:
+    @pytest.mark.parametrize(
+        "ts,segment,expected",
+        [
+            # mirror of types.rs test_timestamp_truncate_by
+            (0, 20, 0),
+            (10, 20, 0),
+            (20, 20, 20),
+            (30, 20, 20),
+            (40, 20, 40),
+            (41, 20, 40),
+            # negative timestamps follow Rust i64 truncation (toward zero)
+            (-10, 20, 0),
+            (-20, 20, -20),
+            (-41, 20, -40),
+        ],
+    )
+    def test_truncate_by(self, ts, segment, expected):
+        assert Timestamp(ts).truncate_by(segment) == expected
+
+    def test_bounds(self):
+        assert Timestamp.MIN < 0 < Timestamp.MAX
+
+
+class TestTimeRange:
+    def test_overlaps(self):
+        a = TimeRange.new(0, 10)
+        assert a.overlaps(TimeRange.new(5, 15))
+        assert a.overlaps(TimeRange.new(-5, 1))
+        assert not a.overlaps(TimeRange.new(10, 20))  # end is exclusive
+        assert not a.overlaps(TimeRange.new(-5, 0))
+
+    def test_contains(self):
+        r = TimeRange.new(0, 10)
+        assert r.contains(0) and r.contains(9)
+        assert not r.contains(10) and not r.contains(-1)
+
+    def test_merged(self):
+        assert TimeRange.new(0, 10).merged(TimeRange.new(5, 20)) == TimeRange.new(0, 20)
+
+
+def user_schema():
+    return pa.schema(
+        [
+            pa.field("pk1", pa.int64()),
+            pa.field("pk2", pa.string()),
+            pa.field("value", pa.int64()),
+        ]
+    )
+
+
+class TestStorageSchema:
+    def test_builtin_columns_appended(self):
+        s = StorageSchema.try_new(user_schema(), 2, UpdateMode.OVERWRITE)
+        assert s.arrow_schema.names == ["pk1", "pk2", "value", SEQ_COLUMN_NAME, RESERVED_COLUMN_NAME]
+        assert s.seq_idx == 3 and s.reserved_idx == 4
+        assert s.value_idxes == [2]
+        assert s.primary_key_names == ["pk1", "pk2"]
+        assert s.user_schema.names == ["pk1", "pk2", "value"]
+
+    def test_rejects_bad_schemas(self):
+        with pytest.raises(Error):
+            StorageSchema.try_new(user_schema(), 0, UpdateMode.OVERWRITE)
+        with pytest.raises(Error, match="no value column"):
+            StorageSchema.try_new(user_schema(), 3, UpdateMode.OVERWRITE)
+        bad = user_schema().append(pa.field(SEQ_COLUMN_NAME, pa.uint64()))
+        with pytest.raises(Error, match="builtin"):
+            StorageSchema.try_new(bad, 1, UpdateMode.OVERWRITE)
+
+    def test_fill_required_projections(self):
+        s = StorageSchema.try_new(user_schema(), 2, UpdateMode.OVERWRITE)
+        assert s.fill_required_projections(None) is None
+        # value-only projection gains pks + seq (ref: types.rs:283-301)
+        assert s.fill_required_projections([2]) == [2, 0, 1, 3]
+        # already complete stays put
+        assert s.fill_required_projections([0, 1, 2, 3]) == [0, 1, 2, 3]
+
+    def test_fill_builtin_columns(self):
+        s = StorageSchema.try_new(user_schema(), 2, UpdateMode.OVERWRITE)
+        batch = pa.record_batch(
+            [pa.array([1, 2]), pa.array(["a", "b"]), pa.array([10, 20])],
+            schema=user_schema(),
+        )
+        out = s.fill_builtin_columns(batch, sequence=99)
+        assert out.schema.equals(s.arrow_schema)
+        assert out.column(s.seq_idx).to_pylist() == [99, 99]
+        assert out.column(s.reserved_idx).null_count == 2
+
+    def test_fill_builtin_columns_empty(self):
+        s = StorageSchema.try_new(user_schema(), 2, UpdateMode.OVERWRITE)
+        batch = pa.record_batch(
+            [pa.array([], type=pa.int64()), pa.array([], type=pa.string()),
+             pa.array([], type=pa.int64())],
+            schema=user_schema(),
+        )
+        assert s.fill_builtin_columns(batch, 1).num_rows == 0
