@@ -1,0 +1,19 @@
+"""Object-storage abstraction (ref: object_store 0.11 crate usage).
+
+The reference's data + metadata plane is `Arc<dyn ObjectStore>`
+(ref: src/storage/src/types.rs:135), with LocalFileSystem used everywhere
+and S3 config present but unimplemented.  We mirror that: an async ABC,
+a local-filesystem impl, and an in-memory fake for tests.
+"""
+
+from horaedb_tpu.objstore.api import NotFoundError, ObjectMeta, ObjectStore
+from horaedb_tpu.objstore.local import LocalObjectStore
+from horaedb_tpu.objstore.memory import MemoryObjectStore
+
+__all__ = [
+    "LocalObjectStore",
+    "MemoryObjectStore",
+    "NotFoundError",
+    "ObjectMeta",
+    "ObjectStore",
+]

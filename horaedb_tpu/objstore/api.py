@@ -1,0 +1,47 @@
+"""ObjectStore interface (ref: object_store crate get/put/list/delete/head,
+consumed at src/storage/src/manifest/mod.rs:139-156, storage.rs:213-217)."""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass
+
+from horaedb_tpu.common.error import Error
+
+
+class NotFoundError(Error):
+    """Raised by get/head/delete when the object does not exist."""
+
+
+@dataclass(frozen=True)
+class ObjectMeta:
+    path: str
+    size: int
+
+
+class ObjectStore(abc.ABC):
+    """Async key→bytes store; paths are '/'-separated keys, not OS paths."""
+
+    @abc.abstractmethod
+    async def put(self, path: str, data: bytes) -> None:
+        """Atomically create/replace the object at `path`."""
+
+    @abc.abstractmethod
+    async def get(self, path: str) -> bytes:
+        """Read the whole object; raises NotFoundError."""
+
+    @abc.abstractmethod
+    async def get_range(self, path: str, start: int, end: int) -> bytes:
+        """Read bytes [start, end); raises NotFoundError."""
+
+    @abc.abstractmethod
+    async def head(self, path: str) -> ObjectMeta:
+        """Object metadata; raises NotFoundError."""
+
+    @abc.abstractmethod
+    async def delete(self, path: str) -> None:
+        """Delete; raises NotFoundError if absent."""
+
+    @abc.abstractmethod
+    async def list(self, prefix: str) -> list[ObjectMeta]:
+        """All objects whose path starts with `prefix`, sorted by path."""

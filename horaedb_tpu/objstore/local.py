@@ -1,0 +1,113 @@
+"""Local-filesystem ObjectStore (ref: object_store::local::LocalFileSystem,
+the store used by the server at src/server/src/main.rs:112).
+
+Puts are atomic (temp file + rename) to preserve the manifest's
+crash-consistency: a torn snapshot write must never be observable.
+Blocking syscalls run in the default thread pool via asyncio.to_thread.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import tempfile
+
+from horaedb_tpu.common.error import Error
+from horaedb_tpu.objstore.api import NotFoundError, ObjectMeta, ObjectStore
+
+
+class LocalObjectStore(ObjectStore):
+    def __init__(self, root: str) -> None:
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+
+    def _fs_path(self, path: str) -> str:
+        fs = os.path.normpath(os.path.join(self.root, path.lstrip("/")))
+        if not fs.startswith(self.root + os.sep) and fs != self.root:
+            raise Error(f"path escapes store root: {path}")
+        return fs
+
+    async def put(self, path: str, data: bytes) -> None:
+        def _put() -> None:
+            fs = self._fs_path(path)
+            os.makedirs(os.path.dirname(fs), exist_ok=True)
+            fd, tmp = tempfile.mkstemp(dir=os.path.dirname(fs), prefix=".tmp-put-")
+            try:
+                with os.fdopen(fd, "wb") as f:
+                    f.write(data)
+                os.replace(tmp, fs)
+            except BaseException:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
+
+        await asyncio.to_thread(_put)
+
+    async def get(self, path: str) -> bytes:
+        def _get() -> bytes:
+            try:
+                with open(self._fs_path(path), "rb") as f:
+                    return f.read()
+            except FileNotFoundError:
+                raise NotFoundError(f"object not found: {path}") from None
+
+        return await asyncio.to_thread(_get)
+
+    async def get_range(self, path: str, start: int, end: int) -> bytes:
+        def _get_range() -> bytes:
+            try:
+                with open(self._fs_path(path), "rb") as f:
+                    f.seek(start)
+                    return f.read(max(0, end - start))
+            except FileNotFoundError:
+                raise NotFoundError(f"object not found: {path}") from None
+
+        return await asyncio.to_thread(_get_range)
+
+    async def head(self, path: str) -> ObjectMeta:
+        def _head() -> ObjectMeta:
+            try:
+                st = os.stat(self._fs_path(path))
+            except FileNotFoundError:
+                raise NotFoundError(f"object not found: {path}") from None
+            return ObjectMeta(path=path, size=st.st_size)
+
+        return await asyncio.to_thread(_head)
+
+    async def delete(self, path: str) -> None:
+        def _delete() -> None:
+            try:
+                os.unlink(self._fs_path(path))
+            except FileNotFoundError:
+                raise NotFoundError(f"object not found: {path}") from None
+
+        await asyncio.to_thread(_delete)
+
+    async def list(self, prefix: str) -> list[ObjectMeta]:
+        def _list() -> list[ObjectMeta]:
+            # Walk only the subtree the prefix's directory part points at —
+            # the manifest merger lists the delta dir every few seconds and
+            # must not pay for a scan of the (much larger) data/ tree.
+            dir_part = prefix.rsplit("/", 1)[0] if "/" in prefix else ""
+            walk_root = self._fs_path(dir_part) if dir_part else self.root
+            if not os.path.isdir(walk_root):
+                return []
+            out: list[ObjectMeta] = []
+            for dirpath, _dirnames, filenames in os.walk(walk_root):
+                for name in filenames:
+                    if name.startswith(".tmp-put-"):
+                        continue
+                    fs = os.path.join(dirpath, name)
+                    key = os.path.relpath(fs, self.root).replace(os.sep, "/")
+                    if key.startswith(prefix):
+                        out.append(ObjectMeta(path=key, size=os.stat(fs).st_size))
+            out.sort(key=lambda m: m.path)
+            return out
+
+        return await asyncio.to_thread(_list)
+
+    def local_path(self, path: str) -> str:
+        """Filesystem path for zero-copy reads (parquet mmap fast path)."""
+        return self._fs_path(path)

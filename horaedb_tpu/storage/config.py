@@ -11,6 +11,8 @@ from __future__ import annotations
 
 import dataclasses
 import enum
+import functools
+import typing
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
@@ -146,7 +148,36 @@ def _coerce(cls: type, f: dataclasses.Field, value: Any) -> Any:
     if f.name in _NESTED:
         ensure(isinstance(value, dict), f"{where} expects a config table")
         return from_dict(_NESTED[f.name], value)
+    return _check_scalar(cls, f, value, where)
+
+
+def _check_scalar(cls: type, f: dataclasses.Field, value: Any, where: str) -> Any:
+    """Validate plain int/bool/str fields against their declared type so
+    misconfigurations fail at load, not mid-flight (bool checked before int
+    since bool subclasses int)."""
+    hints = _type_hints(cls)
+    declared = hints.get(f.name)
+    if declared is None:
+        return value
+    origin = typing.get_origin(declared)
+    if origin is typing.Union:  # Optional[T]
+        args = [a for a in typing.get_args(declared) if a is not type(None)]
+        if len(args) != 1:
+            return value
+        declared = args[0]
+    if declared is bool:
+        ensure(isinstance(value, bool), f"{where} expects a boolean")
+    elif declared is int:
+        ensure(isinstance(value, int) and not isinstance(value, bool),
+               f"{where} expects an integer")
+    elif declared is str:
+        ensure(isinstance(value, str), f"{where} expects a string")
     return value
+
+
+@functools.lru_cache(maxsize=None)
+def _type_hints(cls: type) -> dict[str, Any]:
+    return typing.get_type_hints(cls)
 
 
 def from_dict(cls: type, data: dict[str, Any]) -> Any:

@@ -1,0 +1,214 @@
+"""Manifest: SST metadata store with snapshot + delta log on object storage
+(ref: src/storage/src/manifest/mod.rs).
+
+Design (identical to the reference):
+- Every update = one delta file put, THEN the in-memory cache mutation
+  (crash between the two loses nothing: recovery folds deltas).
+- A background merger folds deltas into the snapshot every
+  `merge_interval` (or on signal) once more than `min_merge_threshold`
+  deltas exist; crossing `soft_merge_threshold` nudges it, crossing
+  `hard_merge_threshold` FAILS the write — that is the engine's write
+  backpressure (ref: manifest/mod.rs:248-262).
+- Startup recovery = read snapshot, fold ALL deltas, rewrite snapshot
+  (`first_run`, ref: manifest/mod.rs:212-214, 274-333).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from horaedb_tpu.common.error import Error
+from horaedb_tpu.common.id_alloc import MonotonicIdAllocator
+from horaedb_tpu.objstore import NotFoundError, ObjectStore
+from horaedb_tpu.storage.config import ManifestConfig
+from horaedb_tpu.storage.manifest.encoding import (
+    ManifestUpdate,
+    Snapshot,
+    decode_manifest_update,
+    encode_manifest_update,
+)
+from horaedb_tpu.storage.sst import FileId, FileMeta, SstFile
+from horaedb_tpu.storage.types import TimeRange
+
+logger = logging.getLogger(__name__)
+
+PREFIX_PATH = "manifest"
+SNAPSHOT_FILENAME = "snapshot"
+DELTA_PREFIX = "delta"
+
+_DELTA_IDS = MonotonicIdAllocator()
+
+
+async def _read_snapshot(store: ObjectStore, path: str) -> Snapshot:
+    try:
+        return Snapshot.from_bytes(await store.get(path))
+    except NotFoundError:
+        return Snapshot()
+
+
+class _Merger:
+    """Background delta→snapshot folder (ref: ManifestMerger, mod.rs:184-333)."""
+
+    def __init__(self, snapshot_path: str, delta_dir: str, store: ObjectStore,
+                 config: ManifestConfig):
+        self.snapshot_path = snapshot_path
+        self.delta_dir = delta_dir
+        self.store = store
+        self.config = config
+        self.deltas_num = 0
+        self._signal: asyncio.Queue[None] = asyncio.Queue(maxsize=config.channel_size)
+        self._task: asyncio.Task | None = None
+        # Serializes folds: the reference funnels every merge through one
+        # consumer task; we allow trigger_merge() alongside the background
+        # loop, so an explicit lock keeps a delta from being folded twice
+        # concurrently.
+        self._merge_lock = asyncio.Lock()
+
+    def start(self) -> None:
+        self._task = asyncio.create_task(self._run(), name="manifest-merger")
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    async def _run(self) -> None:
+        interval = self.config.merge_interval.seconds
+        logger.info("start manifest merge background job, interval=%ss", interval)
+        while True:
+            try:
+                await asyncio.wait_for(self._signal.get(), timeout=interval)
+            except TimeoutError:
+                pass
+            except asyncio.TimeoutError:  # Python < 3.11 alias
+                pass
+            if self.deltas_num > self.config.min_merge_threshold:
+                try:
+                    await self.do_merge(first_run=False)
+                except Exception:
+                    logger.exception("failed to merge manifest deltas")
+
+    def _schedule_merge(self) -> None:
+        try:
+            self._signal.put_nowait(None)
+        except asyncio.QueueFull:
+            logger.debug("merge signal channel full, merge already pending")
+
+    def maybe_schedule_merge(self) -> None:
+        """Backpressure gate run before every update (ref: mod.rs:248-262)."""
+        current = self.deltas_num
+        hard = self.config.hard_merge_threshold
+        if current > hard:
+            self._schedule_merge()
+            raise Error(
+                f"Manifest has too many delta files, value:{current}, hard_limit:{hard}"
+            )
+        if current > self.config.soft_merge_threshold:
+            self._schedule_merge()
+
+    async def do_merge(self, first_run: bool) -> None:
+        async with self._merge_lock:
+            await self._do_merge_locked(first_run)
+
+    async def _do_merge_locked(self, first_run: bool) -> None:
+        metas = await self.store.list(self.delta_dir + "/")
+        paths = [m.path for m in metas]
+        if not paths:
+            return
+        if first_run:
+            self.deltas_num = len(paths)
+
+        delta_bufs = await asyncio.gather(*(self.store.get(p) for p in paths))
+        updates = [decode_manifest_update(buf) for buf in delta_bufs]
+
+        snapshot = await _read_snapshot(self.store, self.snapshot_path)
+        # Deltas are unsorted, so add all new files first, then delete
+        # (ref: mod.rs:296-300).
+        to_deletes: list[FileId] = []
+        for update in updates:
+            snapshot.add_records(update.to_adds)
+            to_deletes.extend(update.to_deletes)
+        snapshot.delete_records(to_deletes)
+
+        # 1. Persist the snapshot, 2. then best-effort delete merged deltas.
+        await self.store.put(self.snapshot_path, snapshot.into_bytes())
+        results = await asyncio.gather(
+            *(self.store.delete(p) for p in paths), return_exceptions=True
+        )
+        for path, res in zip(paths, results):
+            if isinstance(res, BaseException):
+                logger.error("failed to delete delta %s: %s", path, res)
+            else:
+                self.deltas_num -= 1
+
+
+class Manifest:
+    """SST metadata store (ref: Manifest, mod.rs:67-176)."""
+
+    def __init__(self, root_dir: str, store: ObjectStore, config: ManifestConfig):
+        base = root_dir.rstrip("/")
+        self.snapshot_path = f"{base}/{PREFIX_PATH}/{SNAPSHOT_FILENAME}"
+        self.delta_dir = f"{base}/{PREFIX_PATH}/{DELTA_PREFIX}"
+        self.store = store
+        self._merger = _Merger(self.snapshot_path, self.delta_dir, store, config)
+        self._ssts: list[SstFile] = []
+        self._cache_lock = asyncio.Lock()
+
+    @classmethod
+    async def open(cls, root_dir: str, store: ObjectStore,
+                   config: ManifestConfig | None = None) -> "Manifest":
+        m = cls(root_dir, store, config or ManifestConfig())
+        # Recovery: fold all deltas into the snapshot before serving.
+        await m._merger.do_merge(first_run=True)
+        snapshot = await _read_snapshot(store, m.snapshot_path)
+        m._ssts = snapshot.into_ssts()
+        logger.debug("loaded manifest snapshot at startup, ssts=%d", len(m._ssts))
+        m._merger.start()
+        return m
+
+    async def close(self) -> None:
+        await self._merger.stop()
+
+    async def add_file(self, file_id: FileId, meta: FileMeta) -> None:
+        await self.update(ManifestUpdate(to_adds=[SstFile(file_id, meta)]))
+
+    async def update(self, update: ManifestUpdate) -> None:
+        self._merger.maybe_schedule_merge()
+        self._merger.deltas_num += 1
+        try:
+            await self._update_inner(update)
+        except BaseException:
+            self._merger.deltas_num -= 1
+            raise
+
+    async def _update_inner(self, update: ManifestUpdate) -> None:
+        path = f"{self.delta_dir}/{_DELTA_IDS.allocate()}"
+        # 1. Persist the delta, 2. then mutate the cache (ref: mod.rs:139-156).
+        await self.store.put(path, encode_manifest_update(update))
+        async with self._cache_lock:
+            self._ssts.extend(update.to_adds)
+            if update.to_deletes:
+                dels = set(update.to_deletes)
+                self._ssts = [f for f in self._ssts if f.id not in dels]
+
+    async def all_ssts(self) -> list[SstFile]:
+        async with self._cache_lock:
+            return list(self._ssts)
+
+    async def find_ssts(self, time_range: TimeRange) -> list[SstFile]:
+        async with self._cache_lock:
+            return [f for f in self._ssts if f.meta.time_range.overlaps(time_range)]
+
+    # test/introspection hooks
+    @property
+    def deltas_num(self) -> int:
+        return self._merger.deltas_num
+
+    async def trigger_merge(self) -> None:
+        """Force a synchronous fold (tests and shutdown)."""
+        await self._merger.do_merge(first_run=False)

@@ -59,6 +59,18 @@ def test_wrong_value_types_fail_at_load():
         from_dict(StorageConfig, {"write": "fast"})
 
 
+def test_scalar_type_validation():
+    with pytest.raises(Error, match="integer"):
+        from_dict(StorageConfig, {"manifest": {"channel_size": "three"}})
+    with pytest.raises(Error, match="integer"):
+        from_dict(StorageConfig, {"manifest": {"channel_size": True}})
+    with pytest.raises(Error, match="boolean"):
+        from_dict(WriteConfig, {"enable_dict": "yes"})
+    # valid scalars load
+    cfg = from_dict(StorageConfig, {"manifest": {"channel_size": 7}})
+    assert cfg.manifest.channel_size == 7
+
+
 def test_bad_enum_values_raise_framework_error():
     with pytest.raises(Error, match="update_mode"):
         from_dict(StorageConfig, {"update_mode": "overwrite"})  # case matters

@@ -1,0 +1,158 @@
+"""Manifest integration tests (ref tests: manifest/mod.rs:405-508)."""
+
+import asyncio
+
+import pytest
+
+from horaedb_tpu.common import Error, ReadableDuration
+from horaedb_tpu.objstore import MemoryObjectStore
+from horaedb_tpu.storage.config import ManifestConfig
+from horaedb_tpu.storage.manifest import (
+    Manifest,
+    ManifestUpdate,
+    _read_snapshot,
+)
+from horaedb_tpu.storage.sst import FileMeta, SstFile
+from horaedb_tpu.storage.types import TimeRange
+
+
+def meta(start, end, rows=10, size=100, seq=1):
+    return FileMeta(max_sequence=seq, num_rows=rows, size=size,
+                    time_range=TimeRange.new(start, end))
+
+
+def fast_config(**overrides):
+    cfg = ManifestConfig(merge_interval=ReadableDuration.from_millis(50))
+    for k, v in overrides.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+def test_add_and_find():
+    async def go():
+        store = MemoryObjectStore()
+        m = await Manifest.open("root", store, fast_config())
+        try:
+            for i, (s, e) in enumerate([(0, 10), (10, 20), (1000, 1010)]):
+                await m.add_file(100 + i, meta(s, e))
+            found = await m.find_ssts(TimeRange.new(5, 15))
+            assert sorted(f.id for f in found) == [100, 101]
+            assert await m.find_ssts(TimeRange.new(50, 60)) == []
+            assert len(await m.all_ssts()) == 3
+        finally:
+            await m.close()
+
+    asyncio.run(go())
+
+
+def test_update_delete_from_cache():
+    async def go():
+        store = MemoryObjectStore()
+        m = await Manifest.open("root", store, fast_config())
+        try:
+            await m.add_file(1, meta(0, 10))
+            await m.add_file(2, meta(10, 20))
+            await m.update(ManifestUpdate(
+                to_adds=[SstFile(3, meta(0, 20))], to_deletes=[1, 2]))
+            ssts = await m.all_ssts()
+            assert [f.id for f in ssts] == [3]
+        finally:
+            await m.close()
+
+    asyncio.run(go())
+
+
+def test_delta_then_cache_ordering():
+    """A delta file must exist for every acknowledged update."""
+
+    async def go():
+        store = MemoryObjectStore()
+        m = await Manifest.open("root", store, fast_config())
+        try:
+            await m.add_file(1, meta(0, 10))
+            deltas = await store.list("root/manifest/delta/")
+            assert len(deltas) == 1
+        finally:
+            await m.close()
+
+    asyncio.run(go())
+
+
+def test_background_merge_convergence():
+    """Mirror of manifest/mod.rs test: after the background merger runs,
+    the snapshot matches memory and the delta dir is empty."""
+
+    async def go():
+        store = MemoryObjectStore()
+        cfg = fast_config(min_merge_threshold=0)
+        m = await Manifest.open("root", store, cfg)
+        try:
+            for i in range(5):
+                await m.add_file(i, meta(i * 10, i * 10 + 10, seq=i))
+            assert m.deltas_num == 5
+            # wait for the 50ms-interval background merge to fold everything
+            for _ in range(100):
+                await asyncio.sleep(0.02)
+                if m.deltas_num == 0:
+                    break
+            assert m.deltas_num == 0
+            assert await store.list("root/manifest/delta/") == []
+            snap = await _read_snapshot(store, "root/manifest/snapshot")
+            assert sorted(r.id for r in snap.records) == list(range(5))
+            mem = await m.all_ssts()
+            assert sorted(f.id for f in mem) == sorted(r.id for r in snap.records)
+        finally:
+            await m.close()
+
+    asyncio.run(go())
+
+
+def test_recovery_folds_deltas():
+    async def go():
+        store = MemoryObjectStore()
+        # Session 1: write files, no merge (interval long, threshold high)
+        cfg = ManifestConfig(merge_interval=ReadableDuration.parse("1h"))
+        m1 = await Manifest.open("root", store, cfg)
+        await m1.add_file(1, meta(0, 10))
+        await m1.add_file(2, meta(10, 20))
+        await m1.update(ManifestUpdate(to_adds=[], to_deletes=[1]))
+        await m1.close()
+        assert len(await store.list("root/manifest/delta/")) == 3
+
+        # Session 2: open() folds all deltas into the snapshot
+        m2 = await Manifest.open("root", store, cfg)
+        try:
+            ssts = await m2.all_ssts()
+            assert [f.id for f in ssts] == [2]
+            assert await store.list("root/manifest/delta/") == []
+            snap = await _read_snapshot(store, "root/manifest/snapshot")
+            assert [r.id for r in snap.records] == [2]
+        finally:
+            await m2.close()
+
+    asyncio.run(go())
+
+
+def test_hard_threshold_rejects_write():
+    async def go():
+        store = MemoryObjectStore()
+        cfg = ManifestConfig(
+            merge_interval=ReadableDuration.parse("1h"),
+            soft_merge_threshold=2,
+            hard_merge_threshold=4,
+            min_merge_threshold=0,
+        )
+        m = await Manifest.open("root", store, cfg)
+        try:
+            for i in range(5):
+                await m.add_file(i, meta(0, 10))
+            with pytest.raises(Error, match="too many delta files"):
+                await m.add_file(99, meta(0, 10))
+            # but the scheduled merge unblocks it
+            await m.trigger_merge()
+            assert m.deltas_num == 0
+            await m.add_file(99, meta(0, 10))
+        finally:
+            await m.close()
+
+    asyncio.run(go())

@@ -1,0 +1,95 @@
+"""Tests for the object-store abstraction."""
+
+import asyncio
+
+import pytest
+
+from horaedb_tpu.common import Error
+from horaedb_tpu.objstore import (
+    LocalObjectStore,
+    MemoryObjectStore,
+    NotFoundError,
+)
+
+
+@pytest.fixture(params=["memory", "local"])
+def store(request, tmp_path):
+    if request.param == "memory":
+        return MemoryObjectStore()
+    return LocalObjectStore(str(tmp_path))
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class TestObjectStore:
+    def test_put_get_roundtrip(self, store):
+        async def go():
+            await store.put("a/b/c.bin", b"hello")
+            assert await store.get("a/b/c.bin") == b"hello"
+            meta = await store.head("a/b/c.bin")
+            assert meta.size == 5 and meta.path == "a/b/c.bin"
+
+        run(go())
+
+    def test_put_overwrites(self, store):
+        async def go():
+            await store.put("k", b"v1")
+            await store.put("k", b"v2longer")
+            assert await store.get("k") == b"v2longer"
+
+        run(go())
+
+    def test_get_range(self, store):
+        async def go():
+            await store.put("k", b"0123456789")
+            assert await store.get_range("k", 2, 5) == b"234"
+            assert await store.get_range("k", 8, 100) == b"89"
+
+        run(go())
+
+    def test_missing_raises(self, store):
+        async def go():
+            for op in (store.get("nope"), store.head("nope"), store.delete("nope")):
+                with pytest.raises(NotFoundError):
+                    await op
+
+        run(go())
+
+    def test_delete(self, store):
+        async def go():
+            await store.put("k", b"v")
+            await store.delete("k")
+            with pytest.raises(NotFoundError):
+                await store.get("k")
+
+        run(go())
+
+    def test_list_prefix_sorted(self, store):
+        async def go():
+            await store.put("m/delta/2", b"bb")
+            await store.put("m/delta/1", b"a")
+            await store.put("m/snapshot", b"ccc")
+            await store.put("data/1.sst", b"dddd")
+            deltas = await store.list("m/delta/")
+            assert [m.path for m in deltas] == ["m/delta/1", "m/delta/2"]
+            assert [m.size for m in deltas] == [1, 2]
+            everything = await store.list("")
+            assert len(everything) == 4
+
+        run(go())
+
+
+def test_local_store_rejects_escape(tmp_path):
+    store = LocalObjectStore(str(tmp_path))
+    with pytest.raises(Error, match="escapes"):
+        run(store.get("../../etc/passwd"))
+
+
+def test_local_store_atomic_put_no_temp_left(tmp_path):
+    store = LocalObjectStore(str(tmp_path))
+    run(store.put("x/y", b"data"))
+    leftovers = [p for p in tmp_path.rglob(".tmp-put-*")]
+    assert leftovers == []
+    assert run(store.list("")) and run(store.get("x/y")) == b"data"
