@@ -1,0 +1,87 @@
+"""Time-bucketed downsampling as segmented reductions.
+
+The reference has no downsample operator yet (the legacy engine pushes
+sum/rate into DataFusion aggregates; RFC 20220702 splits them to a query
+frontend).  Here it is a first-class device op because it IS the north-star
+workload (BASELINE.md configs 1-3, 5): `GROUP BY series, time(bucket)`
+over min/max/sum/count/avg/last.
+
+Shape discipline: output is a dense (num_groups, num_buckets) grid —
+group ids are dictionary codes, bucket ids are (ts - range_start) //
+bucket_ms.  Both counts are static per query, so jit compiles one program
+per (capacity, groups, buckets) signature, and the grid maps directly onto
+chips for the multi-chip path (one psum over partial grids).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_F32_MAX = jnp.float32(jnp.finfo(jnp.float32).max)
+
+
+@functools.partial(jax.jit, static_argnames=("num_groups", "num_buckets"))
+def time_bucket_aggregate(ts_offset: jax.Array, group_ids: jax.Array,
+                          values: jax.Array, n_valid, bucket_ms,
+                          num_groups: int, num_buckets: int) -> dict:
+    """Aggregate values into a dense (group, time-bucket) grid.
+
+    Args:
+      ts_offset: int32 (capacity,) — timestamp offsets from the query range
+        start (so bucket 0 starts at offset 0).
+      group_ids: int32 (capacity,) — dictionary codes of the group key.
+      values: float32 (capacity,).
+      n_valid: scalar int — real row count.
+      bucket_ms: scalar int32 — bucket width in the ts unit.
+      num_groups / num_buckets: static grid extents.
+
+    Returns dict of (num_groups, num_buckets) float32 arrays:
+      sum, count, min, max, avg, last (value at max ts per cell).
+    Empty cells: count 0, sum 0, min +inf, max -inf, avg/last NaN.
+    """
+    capacity = ts_offset.shape[0]
+    iota = jnp.arange(capacity, dtype=jnp.int32)
+    valid = iota < jnp.asarray(n_valid, dtype=jnp.int32)
+
+    bucket = ts_offset // jnp.asarray(bucket_ms, dtype=jnp.int32)
+    in_grid = valid & (bucket >= 0) & (bucket < num_buckets) \
+        & (group_ids >= 0) & (group_ids < num_groups)
+    num_cells = num_groups * num_buckets
+    # out-of-grid rows land in an overflow cell that is sliced away
+    seg = jnp.where(in_grid, group_ids * num_buckets + bucket, num_cells)
+
+    ones = in_grid.astype(jnp.float32)
+    count = jax.ops.segment_sum(ones, seg, num_segments=num_cells + 1)[:num_cells]
+    total = jax.ops.segment_sum(jnp.where(in_grid, values, 0.0), seg,
+                                num_segments=num_cells + 1)[:num_cells]
+    vmin = jax.ops.segment_min(jnp.where(in_grid, values, _F32_MAX), seg,
+                               num_segments=num_cells + 1)[:num_cells]
+    vmax = jax.ops.segment_max(jnp.where(in_grid, values, -_F32_MAX), seg,
+                               num_segments=num_cells + 1)[:num_cells]
+
+    # "last" = value at the highest timestamp in the cell (later row wins
+    # ties, mirroring last-value merge semantics).  Two segmented passes:
+    # max ts per cell, then max row index among rows at that ts.
+    int32_min = jnp.int32(-(2**31))
+    tmax = jax.ops.segment_max(jnp.where(in_grid, ts_offset, int32_min), seg,
+                               num_segments=num_cells + 1)
+    at_max_ts = in_grid & (ts_offset == tmax[seg])
+    last_row = jax.ops.segment_max(jnp.where(at_max_ts, iota, -1), seg,
+                                   num_segments=num_cells + 1)[:num_cells]
+    last = values[jnp.clip(last_row, 0, capacity - 1)]
+
+    grid = lambda a: a.reshape(num_groups, num_buckets)
+    count_g = grid(count)
+    empty = count_g == 0
+    nan = jnp.float32(jnp.nan)
+    return {
+        "count": count_g,
+        "sum": grid(total),
+        "min": grid(vmin),
+        "max": grid(vmax),
+        "avg": jnp.where(empty, nan, grid(total) / jnp.maximum(count_g, 1.0)),
+        "last": jnp.where(empty, nan, grid(last)),
+    }

@@ -1,0 +1,159 @@
+"""Host-side encode/decode between Arrow RecordBatches and device batches.
+
+The TPU wants fixed-width int32/float32 columns with static shapes; Arrow
+delivers int64 timestamps, utf8 strings, and u64 sequences.  The bridge:
+
+- string/binary  → order-preserving dictionary codes (np.unique) + a host
+                   dictionary for decode and predicate-constant lookup.
+- int64 ts/seq   → int32 offset from a per-batch epoch (timestamps), or
+                   order-preserving rank codes (sequences).  Ranks preserve
+                   comparison order, which is all the merge needs.
+- float64        → float32 (values; aggregation in f32, see downsample.py).
+- rows           → padded to capacity buckets (next power of two, min 128)
+                   so jit sees a small set of static shapes.
+
+Decode inverts the mapping for result batches.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+import pyarrow as pa
+
+from horaedb_tpu.common.error import Error, ensure
+
+_INT32_MIN = np.int32(-(2**31))
+_INT32_MAX = np.int32(2**31 - 1)
+
+MIN_CAPACITY = 128
+
+
+def pad_capacity(n: int) -> int:
+    """Static-shape bucket for n rows: next power of two, >= MIN_CAPACITY."""
+    cap = MIN_CAPACITY
+    while cap < n:
+        cap *= 2
+    return cap
+
+
+@dataclass(frozen=True)
+class ColumnEncoding:
+    """How one host column maps onto its device representation."""
+
+    kind: str  # "numeric" | "dict" | "offset"
+    arrow_type: pa.DataType
+    dictionary: Optional[np.ndarray] = None  # kind == "dict"
+    epoch: int = 0  # kind == "offset": host_value = epoch + device_value
+
+
+@dataclass
+class DeviceBatch:
+    """A padded, device-resident columnar batch.
+
+    `columns` maps name → (capacity,)-shaped jax/numpy array (int32 or
+    float32); rows [0, n_valid) are real, the rest padding.  `encodings`
+    carries the host-side metadata needed to decode or to translate
+    predicate constants.
+    """
+
+    columns: dict
+    encodings: dict[str, ColumnEncoding]
+    n_valid: int
+    capacity: int
+
+    @property
+    def names(self) -> list[str]:
+        return list(self.columns.keys())
+
+
+def _encode_offset(np_col: np.ndarray) -> tuple[np.ndarray, int]:
+    lo = int(np_col.min()) if len(np_col) else 0
+    span = (int(np_col.max()) - lo) if len(np_col) else 0
+    # strictly below INT32_MAX: the merge kernel reserves the max value as
+    # its padding sentinel (ops/merge.py)
+    ensure(span < int(_INT32_MAX),
+           f"int64 column span {span} exceeds int32 offset range; "
+           "narrow the scan time range or segment the batch")
+    return (np_col - lo).astype(np.int32), lo
+
+
+def _dictionary_encode(np_col: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    # np.unique returns SORTED uniques, so codes are order-preserving:
+    # code comparison == value comparison.  Load-bearing for the device
+    # sort producing the same order as the reference's arrow sort.
+    dictionary, codes = np.unique(np_col, return_inverse=True)
+    ensure(len(dictionary) <= int(_INT32_MAX), "dictionary overflow")
+    return codes.astype(np.int32), dictionary
+
+
+def encode_column(col: pa.Array, name: str) -> tuple[np.ndarray, ColumnEncoding]:
+    t = col.type
+    if pa.types.is_floating(t):
+        return (col.to_numpy(zero_copy_only=False).astype(np.float32),
+                ColumnEncoding("numeric", t))
+    if pa.types.is_integer(t):
+        np_col = col.to_numpy(zero_copy_only=False)
+        if np_col.dtype in (np.int8, np.int16, np.int32, np.uint8, np.uint16):
+            return np_col.astype(np.int32), ColumnEncoding("numeric", t)
+        # int64/uint64/uint32: shift to an epoch so the span fits int32
+        ensure(len(np_col) == 0 or int(np_col.max()) <= 2**63 - 1,
+               "u64 values beyond i64::MAX are not supported on device")
+        dev, epoch = _encode_offset(np_col.astype(np.int64))
+        return dev, ColumnEncoding("offset", t, epoch=epoch)
+    if pa.types.is_string(t) or pa.types.is_large_string(t) or pa.types.is_binary(t):
+        np_col = np.asarray(col.to_pylist(), dtype=object)
+        codes, dictionary = _dictionary_encode(np_col)
+        return codes, ColumnEncoding("dict", t, dictionary=dictionary)
+    raise Error(f"unsupported column type for device encoding: {name}: {t}")
+
+
+def encode_batch(batch: pa.RecordBatch, capacity: Optional[int] = None,
+                 device_put=None) -> DeviceBatch:
+    """Encode an Arrow batch into a padded DeviceBatch.
+
+    `device_put` (e.g. jax.device_put or a sharding-aware variant) is
+    applied to each padded column; defaults to leaving numpy arrays for
+    the caller to transfer.
+    """
+    n = batch.num_rows
+    cap = capacity if capacity is not None else pad_capacity(n)
+    ensure(cap >= n, f"capacity {cap} < rows {n}")
+    columns = {}
+    encodings = {}
+    for name, col in zip(batch.schema.names, batch.columns):
+        # No silent null-fill: a null turned into 0.0 would corrupt
+        # min/count/avg downstream.  Null masks are not carried on device
+        # yet, so reject at the boundary.
+        ensure(col.null_count == 0,
+               f"column {name!r} contains nulls; device encoding carries no "
+               "null mask — drop or fill nulls before writing")
+        dev, enc = encode_column(col, name)
+        padded = np.zeros(cap, dtype=dev.dtype)
+        padded[:n] = dev
+        columns[name] = device_put(padded) if device_put else padded
+        encodings[name] = enc
+    return DeviceBatch(columns=columns, encodings=encodings, n_valid=n, capacity=cap)
+
+
+def decode_column(dev_col: np.ndarray, enc: ColumnEncoding, n_valid: int) -> pa.Array:
+    host = np.asarray(dev_col)[:n_valid]
+    if enc.kind == "numeric":
+        return pa.array(host, type=enc.arrow_type).cast(enc.arrow_type)
+    if enc.kind == "offset":
+        return pa.array(host.astype(np.int64) + enc.epoch, type=enc.arrow_type)
+    if enc.kind == "dict":
+        return pa.array(enc.dictionary[host], type=enc.arrow_type)
+    raise Error(f"unknown encoding kind: {enc.kind}")
+
+
+def decode_to_arrow(batch: DeviceBatch, schema: Optional[pa.Schema] = None,
+                    names: Optional[list[str]] = None) -> pa.RecordBatch:
+    names = names if names is not None else batch.names
+    arrays = [decode_column(batch.columns[n], batch.encodings[n], batch.n_valid)
+              for n in names]
+    if schema is not None:
+        return pa.RecordBatch.from_arrays(arrays, schema=schema)
+    return pa.RecordBatch.from_arrays(arrays, names=names)

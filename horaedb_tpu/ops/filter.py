@@ -1,0 +1,187 @@
+"""Predicate evaluation as device masks.
+
+The reference pushes predicates into DataFusion's FilterExec + parquet
+pruning (ref: src/storage/src/read.rs:459-475).  On TPU a filter never
+reshapes data mid-pipeline — it produces a validity mask that downstream
+segmented ops consume, so shapes stay static and XLA fuses the compare
+chains into neighbouring kernels.
+
+Predicates are small host-side trees.  Constants are translated to device
+codes using the batch's ColumnEncodings (dictionary lookup / epoch shift)
+at evaluation time; a constant absent from a dictionary yields an
+all-false (Eq/In) or correct-by-order (range) mask via searchsorted.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Sequence, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from horaedb_tpu.common.error import Error
+from horaedb_tpu.ops.encode import ColumnEncoding, DeviceBatch
+
+Predicate = Union["Eq", "Ne", "Lt", "Le", "Gt", "Ge", "In", "And", "Or",
+                  "Not", "TimeRangePred"]
+
+
+@dataclass(frozen=True)
+class Eq:
+    column: str
+    value: Any
+
+
+@dataclass(frozen=True)
+class Ne:
+    column: str
+    value: Any
+
+
+@dataclass(frozen=True)
+class Lt:
+    column: str
+    value: Any
+
+
+@dataclass(frozen=True)
+class Le:
+    column: str
+    value: Any
+
+
+@dataclass(frozen=True)
+class Gt:
+    column: str
+    value: Any
+
+
+@dataclass(frozen=True)
+class Ge:
+    column: str
+    value: Any
+
+
+@dataclass(frozen=True)
+class In:
+    column: str
+    values: Sequence[Any]
+
+
+@dataclass(frozen=True)
+class And:
+    children: Sequence[Predicate]
+
+
+@dataclass(frozen=True)
+class Or:
+    children: Sequence[Predicate]
+
+
+@dataclass(frozen=True)
+class Not:
+    child: Predicate
+
+
+@dataclass(frozen=True)
+class TimeRangePred:
+    """[start, end) on a timestamp column — the scan's range predicate."""
+
+    column: str
+    start: int
+    end: int
+
+
+def _const_code_exact(enc: ColumnEncoding, value: Any):
+    """Device constant for an equality compare; None if it cannot match."""
+    if enc.kind == "numeric":
+        return value
+    if enc.kind == "offset":
+        off = int(value) - enc.epoch
+        return off if -(2**31) <= off < 2**31 else None
+    if enc.kind == "dict":
+        idx = np.searchsorted(enc.dictionary, value)
+        if idx < len(enc.dictionary) and enc.dictionary[idx] == value:
+            return int(idx)
+        return None
+    raise Error(f"unknown encoding kind: {enc.kind}")
+
+
+def _const_code_lower(enc: ColumnEncoding, value: Any):
+    """Device threshold t such that (col_value < value) == (code < t).
+
+    Works for dict codes because np.unique codes are order-preserving.
+    """
+    if enc.kind == "numeric":
+        return value
+    if enc.kind == "offset":
+        return int(np.clip(int(value) - enc.epoch, -(2**31), 2**31 - 1))
+    if enc.kind == "dict":
+        return int(np.searchsorted(enc.dictionary, value, side="left"))
+    raise Error(f"unknown encoding kind: {enc.kind}")
+
+
+def _const_code_upper(enc: ColumnEncoding, value: Any):
+    """Device threshold t such that (col_value <= value) == (code < t)."""
+    if enc.kind in ("numeric", "offset"):
+        return _const_code_lower(enc, value)
+    if enc.kind == "dict":
+        return int(np.searchsorted(enc.dictionary, value, side="right"))
+    raise Error(f"unknown encoding kind: {enc.kind}")
+
+
+def eval_predicate(pred: Predicate, batch: DeviceBatch) -> jnp.ndarray:
+    """Evaluate to a (capacity,) bool mask (padding rows unconstrained —
+    callers AND this with the batch validity mask)."""
+    if isinstance(pred, And):
+        mask = jnp.ones(batch.capacity, dtype=bool)
+        for c in pred.children:
+            mask = mask & eval_predicate(c, batch)
+        return mask
+    if isinstance(pred, Or):
+        mask = jnp.zeros(batch.capacity, dtype=bool)
+        for c in pred.children:
+            mask = mask | eval_predicate(c, batch)
+        return mask
+    if isinstance(pred, Not):
+        return ~eval_predicate(pred.child, batch)
+
+    col = batch.columns[pred.column]
+    enc = batch.encodings[pred.column]
+
+    if isinstance(pred, Eq):
+        code = _const_code_exact(enc, pred.value)
+        if code is None:
+            return jnp.zeros(batch.capacity, dtype=bool)
+        return col == code
+    if isinstance(pred, Ne):
+        code = _const_code_exact(enc, pred.value)
+        if code is None:
+            return jnp.ones(batch.capacity, dtype=bool)
+        return col != code
+    if isinstance(pred, In):
+        mask = jnp.zeros(batch.capacity, dtype=bool)
+        for v in pred.values:
+            code = _const_code_exact(enc, v)
+            if code is not None:
+                mask = mask | (col == code)
+        return mask
+    if isinstance(pred, Lt):
+        return col < _const_code_lower(enc, pred.value)
+    if isinstance(pred, Le):
+        # dict codes have no "<=" constant: use the right-bisect threshold
+        if enc.kind == "dict":
+            return col < _const_code_upper(enc, pred.value)
+        return col <= _const_code_upper(enc, pred.value)
+    if isinstance(pred, Gt):
+        if enc.kind == "dict":
+            return col >= _const_code_upper(enc, pred.value)
+        return col > _const_code_lower(enc, pred.value)
+    if isinstance(pred, Ge):
+        return col >= _const_code_lower(enc, pred.value)
+    if isinstance(pred, TimeRangePred):
+        lo = _const_code_lower(enc, pred.start)
+        hi = _const_code_lower(enc, pred.end)
+        return (col >= lo) & (col < hi)
+    raise Error(f"unknown predicate: {pred!r}")

@@ -1,0 +1,97 @@
+"""Device merge-dedup: the north-star scan kernel.
+
+Replaces the reference's streaming merge path — SortPreservingMergeExec
+feeding MergeExec's row-at-a-time `primary_key_eq` scalar loop
+(ref: src/storage/src/read.rs:154-156, 262-343) — with a single compiled
+program over the concatenation of all SST batches in a segment:
+
+  1. lexicographic sort by (pk..., seq)      — XLA variadic sort
+  2. run-boundary mask (neighbor compare)    — vectorized, replaces the
+                                               O(rows × pks) scalar loop
+  3. segmented last-select per run           — LastValueOperator semantics
+                                               (ref: operator.rs:37-44):
+                                               equal PKs keep the row with
+                                               the highest sequence
+
+Everything is static-shape: inputs are padded to capacity with a validity
+count; padding sorts to the end via an int32 sentinel.  Outputs are padded
+too (first `num_runs` rows valid), so downstream ops stay compiled.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_PAD_SENTINEL = jnp.int32(2**31 - 1)
+
+
+def sorted_run_starts(pk_cols: tuple, valid: jax.Array) -> jax.Array:
+    """Boolean mask of primary-key run starts over sorted columns.
+
+    This is the vectorized replacement for `primary_key_eq`
+    (ref: read.rs:262-287): rows i and i-1 are in the same run iff all PK
+    columns are equal.  Padding rows never start a run.
+    """
+    neq = jnp.zeros(valid.shape, dtype=bool)
+    for col in pk_cols:
+        neq = neq | (col != jnp.roll(col, 1))
+    first = jnp.zeros_like(neq).at[0].set(True)
+    return (first | neq) & valid
+
+
+@functools.partial(jax.jit, static_argnames=("num_pks", "num_keys"))
+def _merge_dedup_impl(cols: tuple, n_valid: jax.Array, num_pks: int, num_keys: int):
+    capacity = cols[0].shape[0]
+    iota = jnp.arange(capacity, dtype=jnp.int32)
+    valid = iota < n_valid
+
+    # Padding must sort last: replace pad keys with the int32 max sentinel.
+    sort_operands = []
+    for i, c in enumerate(cols):
+        if i < num_keys and c.dtype == jnp.int32:
+            sort_operands.append(jnp.where(valid, c, _PAD_SENTINEL))
+        else:
+            sort_operands.append(c)
+    sort_operands.append(valid)
+    sorted_all = jax.lax.sort(tuple(sort_operands), num_keys=num_keys, is_stable=True)
+    sorted_cols, sorted_valid = sorted_all[:-1], sorted_all[-1]
+
+    run_starts = sorted_run_starts(sorted_cols[:num_pks], sorted_valid)
+    run_ids = jnp.cumsum(run_starts.astype(jnp.int32)) - 1
+    num_runs = jnp.sum(run_starts.astype(jnp.int32))
+
+    # Last row of each run == highest seq for that PK (seq is the final
+    # sort key).  segment_max over masked row indices finds it.
+    masked_iota = jnp.where(sorted_valid, iota, jnp.int32(-1))
+    safe_run_ids = jnp.where(sorted_valid, run_ids, capacity - 1)
+    last_idx = jax.ops.segment_max(masked_iota, safe_run_ids, num_segments=capacity)
+    gather_idx = jnp.clip(last_idx, 0, capacity - 1)
+
+    out_cols = tuple(c[gather_idx] for c in sorted_cols)
+    out_valid = iota < num_runs
+    return out_cols, out_valid, num_runs
+
+
+def merge_dedup_last(pk_cols: tuple, seq: jax.Array, value_cols: tuple,
+                     n_valid) -> tuple[tuple, tuple, jax.Array, jax.Array]:
+    """Sort + dedup, keeping the last-by-sequence row per primary key.
+
+    Args:
+      pk_cols: int32 arrays (capacity,) — PK columns in schema order.
+      seq: int32 array — per-row sequence rank (order-preserving).
+      value_cols: arrays (capacity,) — carried value columns (any dtype).
+      n_valid: scalar — number of real rows.
+
+    Returns (out_pk_cols, out_value_cols, out_valid_mask, num_runs); outputs
+    are sorted by PK ascending, padded to capacity.
+    """
+    cols = tuple(pk_cols) + (seq,) + tuple(value_cols)
+    out_cols, out_valid, num_runs = _merge_dedup_impl(
+        cols, jnp.asarray(n_valid, dtype=jnp.int32),
+        num_pks=len(pk_cols), num_keys=len(pk_cols) + 1)
+    out_pks = out_cols[: len(pk_cols)]
+    out_values = out_cols[len(pk_cols) + 1:]
+    return out_pks, out_values, out_valid, num_runs

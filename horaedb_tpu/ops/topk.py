@@ -1,0 +1,38 @@
+"""Top-k over per-group aggregates (BASELINE config #4: top-k hosts by
+max(cpu) across 64 SSTs).
+
+Runs on the dense (num_groups,) aggregate vector produced by
+ops/downsample.py (or a psum-merged copy of it in the multi-chip path),
+so k-selection is a single `lax.top_k` over group scores.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("k", "largest"))
+def top_k_groups(scores: jax.Array, k: int, largest: bool = True):
+    """Return (values, group_indices) of the top-k groups.
+
+    `scores` is (num_groups,) float32; NaN scores (empty groups) always
+    lose.  k must be static; if k > num_groups the tail is NaN/-1.
+    """
+    num_groups = scores.shape[0]
+    neg_inf = jnp.float32(-jnp.inf)
+    clean = jnp.where(jnp.isnan(scores), neg_inf if largest else -neg_inf, scores)
+    work = clean if largest else -clean
+    kk = min(k, num_groups)
+    vals, idxs = jax.lax.top_k(work, kk)
+    vals = vals if largest else -vals
+    # groups that never matched anything are reported as invalid
+    invalid = jnp.isinf(vals)
+    vals = jnp.where(invalid, jnp.float32(jnp.nan), vals)
+    idxs = jnp.where(invalid, -1, idxs)
+    if kk < k:
+        vals = jnp.concatenate([vals, jnp.full(k - kk, jnp.nan, dtype=vals.dtype)])
+        idxs = jnp.concatenate([idxs, jnp.full(k - kk, -1, dtype=idxs.dtype)])
+    return vals, idxs

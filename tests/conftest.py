@@ -1,8 +1,14 @@
 """Test harness setup.
 
-Force JAX onto the CPU backend with 8 virtual devices BEFORE jax is
-imported anywhere, so multi-chip sharding (Mesh/shard_map) is testable
-without real TPU hardware.  Must happen at conftest import time.
+Tests must run on the CPU backend with 8 virtual devices so multi-chip
+sharding (Mesh/shard_map) is testable without real TPU hardware — and
+WITHOUT dialing the axon TPU tunnel (concurrent processes serialize on
+it; a bench run and a test run would deadlock each other).
+
+The axon sitecustomize hook registers the TPU plugin at interpreter
+start and forces jax_platforms="axon,cpu", so setting the env var here
+is too late; the config itself must be overridden before the first
+backend initialization.
 """
 
 import os
@@ -16,3 +22,13 @@ if "xla_force_host_platform_device_count" not in _flags:
     ).strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_sessionstart(session):
+    devices = jax.devices()
+    assert devices[0].platform == "cpu", f"tests must run on CPU, got {devices}"
+    assert len(devices) == 8, f"expected 8 virtual CPU devices, got {len(devices)}"
