@@ -41,12 +41,23 @@ def pad_capacity(n: int) -> int:
 
 @dataclass(frozen=True)
 class ColumnEncoding:
-    """How one host column maps onto its device representation."""
+    """How one host column maps onto its device representation.
+
+    kinds:
+      numeric — value used as-is (int32/float32)
+      offset  — host_value = epoch + device_value (timestamps: preserves
+                arithmetic, so bucket = offset // width works on device)
+      dict    — device value indexes `dictionary` (strings, and int64
+                columns whose span exceeds int32 — e.g. __seq__, whose
+                wall-clock-nanosecond values are near-constant-distinct
+                per file but span far more than 2^31).  np.unique codes
+                are order-preserving, which is all compares/sorts need.
+    """
 
     kind: str  # "numeric" | "dict" | "offset"
     arrow_type: pa.DataType
     dictionary: Optional[np.ndarray] = None  # kind == "dict"
-    epoch: int = 0  # kind == "offset": host_value = epoch + device_value
+    epoch: int = 0  # kind == "offset"
 
 
 @dataclass
@@ -69,14 +80,16 @@ class DeviceBatch:
         return list(self.columns.keys())
 
 
-def _encode_offset(np_col: np.ndarray) -> tuple[np.ndarray, int]:
-    lo = int(np_col.min()) if len(np_col) else 0
-    span = (int(np_col.max()) - lo) if len(np_col) else 0
+def _offset_span_ok(np_col: np.ndarray) -> bool:
+    if not len(np_col):
+        return True
     # strictly below INT32_MAX: the merge kernel reserves the max value as
     # its padding sentinel (ops/merge.py)
-    ensure(span < int(_INT32_MAX),
-           f"int64 column span {span} exceeds int32 offset range; "
-           "narrow the scan time range or segment the batch")
+    return int(np_col.max()) - int(np_col.min()) < int(_INT32_MAX)
+
+
+def _encode_offset(np_col: np.ndarray) -> tuple[np.ndarray, int]:
+    lo = int(np_col.min()) if len(np_col) else 0
     return (np_col - lo).astype(np.int32), lo
 
 
@@ -98,11 +111,17 @@ def encode_column(col: pa.Array, name: str) -> tuple[np.ndarray, ColumnEncoding]
         np_col = col.to_numpy(zero_copy_only=False)
         if np_col.dtype in (np.int8, np.int16, np.int32, np.uint8, np.uint16):
             return np_col.astype(np.int32), ColumnEncoding("numeric", t)
-        # int64/uint64/uint32: shift to an epoch so the span fits int32
+        # int64/uint64/uint32: shift to an epoch when the span fits int32
+        # (timestamps — keeps device arithmetic), else rank-encode through
+        # a sorted-unique dictionary (sequences — exact and ordered).
         ensure(len(np_col) == 0 or int(np_col.max()) <= 2**63 - 1,
                "u64 values beyond i64::MAX are not supported on device")
-        dev, epoch = _encode_offset(np_col.astype(np.int64))
-        return dev, ColumnEncoding("offset", t, epoch=epoch)
+        np64 = np_col.astype(np.int64)
+        if _offset_span_ok(np64):
+            dev, epoch = _encode_offset(np64)
+            return dev, ColumnEncoding("offset", t, epoch=epoch)
+        codes, dictionary = _dictionary_encode(np64)
+        return codes, ColumnEncoding("dict", t, dictionary=dictionary)
     if pa.types.is_string(t) or pa.types.is_large_string(t) or pa.types.is_binary(t):
         np_col = np.asarray(col.to_pylist(), dtype=object)
         codes, dictionary = _dictionary_encode(np_col)

@@ -85,13 +85,16 @@ def merge_dedup_last(pk_cols: tuple, seq: jax.Array, value_cols: tuple,
       value_cols: arrays (capacity,) — carried value columns (any dtype).
       n_valid: scalar — number of real rows.
 
-    Returns (out_pk_cols, out_value_cols, out_valid_mask, num_runs); outputs
-    are sorted by PK ascending, padded to capacity.
+    Returns (out_pk_cols, out_seq, out_value_cols, out_valid_mask, num_runs);
+    outputs are sorted by PK ascending, padded to capacity.  out_seq carries
+    each surviving row's original sequence — compaction rewrites depend on
+    it for later cross-file dedup.
     """
     cols = tuple(pk_cols) + (seq,) + tuple(value_cols)
     out_cols, out_valid, num_runs = _merge_dedup_impl(
         cols, jnp.asarray(n_valid, dtype=jnp.int32),
         num_pks=len(pk_cols), num_keys=len(pk_cols) + 1)
     out_pks = out_cols[: len(pk_cols)]
+    out_seq = out_cols[len(pk_cols)]
     out_values = out_cols[len(pk_cols) + 1:]
-    return out_pks, out_values, out_valid, num_runs
+    return out_pks, out_seq, out_values, out_valid, num_runs

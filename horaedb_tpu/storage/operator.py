@@ -1,0 +1,111 @@
+"""Merge operators: row-merge semantics for equal primary keys
+(ref: src/storage/src/operator.rs).
+
+The reference applies an operator to each PK group as it streams by.  Here
+the Overwrite path (LastValue) runs entirely on device inside
+ops.merge.merge_dedup_last, so this module provides:
+
+- the host-side reference implementations used for testing and for the
+  Append path (BytesMerge concatenates variable-length Binary values,
+  which stays on host per the fixed-width device design —
+  SURVEY.md hard part #4);
+- group-wise application over a sorted Arrow batch via vectorized numpy
+  run detection (no per-row Python loop).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pyarrow as pa
+
+from horaedb_tpu.common.error import Error, ensure
+
+
+def _run_starts_host(batch: pa.RecordBatch, num_pks: int) -> np.ndarray:
+    """Boolean run-start mask over a PK-sorted batch (host twin of
+    ops.merge.sorted_run_starts)."""
+    n = batch.num_rows
+    if n == 0:
+        return np.zeros(0, dtype=bool)
+    starts = np.zeros(n, dtype=bool)
+    starts[0] = True
+    for i in range(num_pks):
+        col = batch.column(i).to_numpy(zero_copy_only=False)
+        starts[1:] |= col[1:] != col[:-1]
+    return starts
+
+
+class LastValueOperator:
+    """Keep the last row of each group — highest sequence wins
+    (ref: operator.rs:37-44).  Overwrite mode."""
+
+    def merge_sorted_batch(self, batch: pa.RecordBatch, num_pks: int) -> pa.RecordBatch:
+        n = batch.num_rows
+        if n == 0:
+            return batch
+        starts = _run_starts_host(batch, num_pks)
+        # last index of run k = (start of run k+1) - 1; last run ends at n-1
+        last_idx = np.append(np.nonzero(starts)[0][1:] - 1, n - 1)
+        return batch.take(pa.array(last_idx))
+
+
+class BytesMergeOperator:
+    """Concatenate Binary value columns across each group, in sequence
+    order; non-value columns keep the group's first row
+    (ref: operator.rs:46-111).  Append mode."""
+
+    def __init__(self, value_idxes: list[int]):
+        self.value_idxes = value_idxes
+
+    def merge_sorted_batch(self, batch: pa.RecordBatch, num_pks: int) -> pa.RecordBatch:
+        n = batch.num_rows
+        if n == 0:
+            return batch
+        for idx in self.value_idxes:
+            t = batch.column(idx).type
+            ensure(pa.types.is_binary(t) or pa.types.is_large_binary(t),
+                   f"BytesMergeOperator requires binary columns, got {t}")
+
+        starts = _run_starts_host(batch, num_pks)
+        first_idx = np.nonzero(starts)[0]
+        group_of_row = np.cumsum(starts) - 1
+        num_groups = len(first_idx)
+
+        columns = []
+        for idx in range(batch.num_columns):
+            col = batch.column(idx)
+            if idx not in self.value_idxes:
+                columns.append(col.take(pa.array(first_idx)))
+                continue
+            # vectorized ragged concat: per-row byte lengths summed per group
+            ensure(col.null_count == 0,
+                   "BytesMergeOperator input contains nulls (write path "
+                   "rejects nulls; corrupt SST?)")
+            arr = col.cast(pa.binary()) if not pa.types.is_binary(col.type) else col
+            flat = arr.combine_chunks() if isinstance(arr, pa.ChunkedArray) else arr
+            offsets = np.frombuffer(flat.buffers()[1], dtype=np.int32,
+                                    count=n + 1, offset=flat.offset * 4)
+            row_lens = (offsets[1:] - offsets[:-1]).astype(np.int64)
+            group_lens = np.bincount(group_of_row, weights=row_lens,
+                                     minlength=num_groups).astype(np.int64)
+            values_buf = flat.buffers()[2]
+            data = np.frombuffer(values_buf, dtype=np.uint8)[
+                offsets[0]: offsets[n]] if values_buf is not None else np.zeros(0, np.uint8)
+            new_offsets = np.zeros(num_groups + 1, dtype=np.int32)
+            np.cumsum(group_lens, out=new_offsets[1:])
+            merged = pa.Array.from_buffers(
+                pa.binary(), num_groups,
+                [None, pa.py_buffer(new_offsets.tobytes()),
+                 pa.py_buffer(data.tobytes())])
+            columns.append(merged)
+        return pa.RecordBatch.from_arrays(columns, schema=batch.schema)
+
+
+def build_operator(update_mode, value_idxes: list[int]):
+    from horaedb_tpu.storage.config import UpdateMode
+
+    if update_mode is UpdateMode.OVERWRITE:
+        return LastValueOperator()
+    if update_mode is UpdateMode.APPEND:
+        return BytesMergeOperator(value_idxes)
+    raise Error(f"unknown update mode: {update_mode}")

@@ -1,0 +1,113 @@
+"""Parquet SST encode/decode on top of the ObjectStore.
+
+Maps WriteConfig onto pyarrow writer properties the way the reference maps
+its config onto parquet-rs WriterProperties (ref: src/storage/src/
+storage.rs:257-297 build_write_props): row-group size, write batch size,
+global + per-column dictionary/compression/encoding, and sorting-columns
+metadata recording the (pk..., seq) sort order.
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Optional
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from horaedb_tpu.objstore import ObjectStore
+from horaedb_tpu.storage.config import WriteConfig
+from horaedb_tpu.storage.types import StorageSchema
+
+
+def writer_options(config: WriteConfig, schema: StorageSchema) -> dict:
+    """pyarrow ParquetWriter kwargs from a WriteConfig."""
+    names = schema.arrow_schema.names
+
+    def dict_enabled(n: str) -> bool:
+        opt = config.column_options.get(n)
+        if opt is not None and opt.enable_dict is not None:
+            return opt.enable_dict
+        return config.enable_dict
+
+    per_col_dict = {n: dict_enabled(n) for n in names}
+    if all(v == config.enable_dict for v in per_col_dict.values()):
+        use_dictionary: object = config.enable_dict
+    else:
+        use_dictionary = [n for n, v in per_col_dict.items() if v]
+
+    compression: object = config.compression.value
+    per_col_comp = {
+        n: config.column_options[n].compression.value
+        for n in names
+        if n in config.column_options and config.column_options[n].compression
+    }
+    if per_col_comp:
+        compression = {n: per_col_comp.get(n, config.compression.value) for n in names}
+
+    per_col_enc = {
+        n: config.column_options[n].encoding
+        for n in names
+        if n in config.column_options and config.column_options[n].encoding
+    }
+    if per_col_enc:
+        # per-column overrides must not drop the global default elsewhere
+        column_encoding: object = (
+            {n: per_col_enc.get(n, config.encoding) for n in names}
+            if config.encoding else per_col_enc)
+    else:
+        column_encoding = config.encoding
+
+    kwargs = dict(
+        use_dictionary=use_dictionary,
+        compression=compression,
+        write_statistics=True,
+        write_batch_size=config.write_batch_size,
+    )
+    if column_encoding:
+        kwargs["column_encoding"] = column_encoding
+    if config.enable_sorting_columns:
+        kwargs["sorting_columns"] = [
+            pq.SortingColumn(i) for i in range(schema.num_primary_keys)
+        ] + [pq.SortingColumn(schema.seq_idx)]
+    return kwargs
+
+
+def encode_sst(batches: list[pa.RecordBatch], config: WriteConfig,
+               schema: StorageSchema) -> bytes:
+    """Serialize sorted, builtin-stamped batches into one Parquet file."""
+    sink = io.BytesIO()
+    writer = pq.ParquetWriter(sink, schema.arrow_schema,
+                              **writer_options(config, schema))
+    try:
+        for batch in batches:
+            writer.write_batch(batch, row_group_size=config.max_row_group_size)
+    finally:
+        writer.close()
+    return sink.getvalue()
+
+
+async def write_sst(store: ObjectStore, path: str,
+                    batches: list[pa.RecordBatch], config: WriteConfig,
+                    schema: StorageSchema) -> int:
+    """Encode + put; returns the file size in bytes."""
+    data = encode_sst(batches, config, schema)
+    await store.put(path, data)
+    return len(data)
+
+
+async def read_sst(store: ObjectStore, path: str,
+                   columns: Optional[list[str]] = None) -> pa.Table:
+    """Read an SST, optionally a column subset.
+
+    Local stores expose a filesystem path for mmap'd reads; other stores
+    go through a bytes buffer.
+    """
+    local_path = getattr(store, "local_path", None)
+    if local_path is not None:
+        import asyncio
+
+        return await asyncio.to_thread(
+            pq.read_table, local_path(path), columns=columns, memory_map=True)
+    data = await store.get(path)
+    return pq.read_table(pa.BufferReader(data), columns=columns)

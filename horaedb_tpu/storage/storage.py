@@ -1,0 +1,167 @@
+"""TimeMergeStorage facade (ref: src/storage/src/storage.rs).
+
+`CloudObjectStorage` splits data into `segment_duration` time segments.
+write() sorts a batch by PK, stamps builtin columns with the file id as
+sequence, writes one Parquet SST, and records it in the manifest
+(ref: storage.rs:188-224, 306-332).  scan() groups manifest hits by
+segment and executes one device merge-dedup program per segment
+(ref: storage.rs:334-369 + our read.py).  On-disk layout matches the
+reference (storage.rs:125-135):
+
+    {root_path}/manifest/snapshot
+    {root_path}/manifest/delta/{id}
+    {root_path}/data/{id}.sst
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass
+from typing import AsyncIterator, Optional
+
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from horaedb_tpu.common.error import ensure
+from horaedb_tpu.objstore import ObjectStore
+from horaedb_tpu.storage import parquet_io
+from horaedb_tpu.storage.config import StorageConfig
+from horaedb_tpu.storage.manifest import Manifest
+from horaedb_tpu.storage.read import ParquetReader, ScanPlan, ScanRequest
+from horaedb_tpu.storage.sst import FileMeta, SstFile, sst_path
+from horaedb_tpu.storage.types import (
+    StorageSchema,
+    TimeRange,
+    Timestamp,
+)
+
+
+@dataclass
+class WriteRequest:
+    """(ref: storage.rs:58-63)"""
+
+    batch: pa.RecordBatch  # user schema (no builtin columns)
+    time_range: TimeRange
+    # When false, the caller guarantees the batch does not cross a segment
+    # boundary (the load generator path).
+    enable_check: bool = True
+
+
+@dataclass
+class WriteResult:
+    id: int
+    seq: int
+    size: int
+
+
+class TimeMergeStorage(abc.ABC):
+    """Engine facade (ref: storage.rs:76-89)."""
+
+    @abc.abstractmethod
+    def schema(self) -> StorageSchema: ...
+
+    @abc.abstractmethod
+    async def write(self, req: WriteRequest) -> WriteResult: ...
+
+    @abc.abstractmethod
+    def scan(self, req: ScanRequest) -> AsyncIterator[pa.RecordBatch]: ...
+
+    @abc.abstractmethod
+    async def compact(self) -> None: ...
+
+
+class CloudObjectStorage(TimeMergeStorage):
+    def __init__(self, root_path: str, segment_duration_ms: int,
+                 store: ObjectStore, user_schema: pa.Schema,
+                 num_primary_keys: int, config: Optional[StorageConfig] = None):
+        config = config or StorageConfig()
+        self.root_path = root_path.rstrip("/")
+        self.segment_duration_ms = segment_duration_ms
+        self.store = store
+        self.config = config
+        self._schema = StorageSchema.try_new(user_schema, num_primary_keys,
+                                             config.update_mode)
+        self.manifest: Optional[Manifest] = None
+        self.reader = ParquetReader(store, self.root_path, self._schema,
+                                    config, segment_duration_ms)
+        self.compact_scheduler = None  # populated by open()
+
+    @classmethod
+    async def open(cls, *args, **kwargs) -> "CloudObjectStorage":
+        self = cls(*args, **kwargs)
+        self.manifest = await Manifest.open(self.root_path, self.store,
+                                            self.config.manifest)
+        await self._start_compaction()
+        return self
+
+    async def _start_compaction(self) -> None:
+        from horaedb_tpu.storage.compaction import Scheduler
+
+        self.compact_scheduler = Scheduler(self)
+        await self.compact_scheduler.start()
+
+    async def close(self) -> None:
+        if self.compact_scheduler is not None:
+            await self.compact_scheduler.stop()
+        if self.manifest is not None:
+            await self.manifest.close()
+
+    # ------------------------------------------------------------------
+
+    def schema(self) -> StorageSchema:
+        return self._schema
+
+    def _sort_batch(self, batch: pa.RecordBatch) -> pa.RecordBatch:
+        """Sort by primary keys ascending (ref: storage.rs:243-255 does
+        this via a DataFusion SortExec; arrow-native sort here)."""
+        keys = [(n, "ascending") for n in self._schema.primary_key_names]
+        return batch.take(pc.sort_indices(batch, sort_keys=keys))
+
+    async def write(self, req: WriteRequest) -> WriteResult:
+        ensure(self.manifest is not None, "storage not opened")
+        ensure(req.batch.schema.equals(self._schema.user_schema),
+               "write batch schema mismatch")
+        # Nulls are rejected at write time: the device scan path carries no
+        # null mask, so a null-bearing SST would poison every later scan
+        # and compaction of its segment.
+        for name, col in zip(req.batch.schema.names, req.batch.columns):
+            ensure(col.null_count == 0,
+                   f"write batch column {name!r} contains nulls")
+        if req.enable_check:
+            start_seg = req.time_range.start.truncate_by(self.segment_duration_ms)
+            end_seg = Timestamp(int(req.time_range.end) - 1).truncate_by(
+                self.segment_duration_ms)
+            ensure(start_seg == end_seg,
+                   f"write batch crosses segment boundary: {req.time_range}")
+        return await self._write_batch(req)
+
+    async def _write_batch(self, req: WriteRequest) -> WriteResult:
+        file_id = SstFile.allocate_id()
+        sorted_batch = self._sort_batch(req.batch)
+        stamped = self._schema.fill_builtin_columns(sorted_batch, sequence=file_id)
+        path = sst_path(self.root_path, file_id)
+        size = await parquet_io.write_sst(self.store, path, [stamped],
+                                          self.config.write, self._schema)
+        meta = FileMeta(max_sequence=file_id, num_rows=req.batch.num_rows,
+                        size=size, time_range=req.time_range)
+        await self.manifest.add_file(file_id, meta)
+        return WriteResult(id=file_id, seq=file_id, size=size)
+
+    async def scan(self, req: ScanRequest) -> AsyncIterator[pa.RecordBatch]:
+        plan = await self.build_scan_plan(req)
+        async for batch in self.reader.execute(plan):
+            yield batch
+
+    async def build_scan_plan(self, req: ScanRequest,
+                              keep_builtin: bool = False) -> ScanPlan:
+        ensure(self.manifest is not None, "storage not opened")
+        ssts = await self.manifest.find_ssts(req.range)
+        return self.reader.build_plan(ssts, req, keep_builtin=keep_builtin)
+
+    async def compact(self) -> None:
+        if self.compact_scheduler is not None:
+            await self.compact_scheduler.trigger()
+
+    @property
+    def value_idxes(self) -> list[int]:
+        return self._schema.value_idxes

@@ -75,6 +75,29 @@ class TestEncodeDecode:
         assert (np.argsort(codes) == np.argsort(seqs)).all()
         assert decode_to_arrow(dev).column(0).to_pylist() == seqs
 
+    def test_wide_span_int64_falls_back_to_rank(self):
+        """Sequences from different process starts span >> int32; they
+        must rank-encode (dict) and survive merge + decode exactly."""
+        seqs = [1_700_000_000_000_000_000, 1_700_000_000_000_000_001,
+                1_790_000_000_000_000_000]
+        b = pa.record_batch({
+            "pk": pa.array([1, 1, 1], type=pa.int32()),
+            "__seq__": pa.array(seqs, type=pa.uint64()),
+            "v": pa.array([1.0, 2.0, 3.0], type=pa.float64()),
+        })
+        dev = encode_batch(b)
+        assert dev.encodings["__seq__"].kind == "dict"
+        out_pks, out_seq, out_vals, _, nr = merge_dedup_last(
+            (dev.columns["pk"],), dev.columns["__seq__"],
+            (dev.columns["v"],), 3)
+        assert int(nr) == 1
+        assert float(np.asarray(out_vals[0])[0]) == 3.0  # max-seq row wins
+        from horaedb_tpu.ops import DeviceBatch
+        out = decode_to_arrow(
+            DeviceBatch(columns={"__seq__": out_seq}, encodings=dev.encodings,
+                        n_valid=1, capacity=dev.capacity), names=["__seq__"])
+        assert out.column(0).to_pylist() == [1_790_000_000_000_000_000]
+
 
 class TestMergeDedup:
     def np_reference(self, pks, seq, values, n):
@@ -95,7 +118,7 @@ class TestMergeDedup:
         )
         seq = np.pad(rng.permutation(n).astype(np.int32), (0, cap - n))
         vals = (np.pad(rng.random(n).astype(np.float32), (0, cap - n)),)
-        out_pks, out_vals, out_valid, num_runs = merge_dedup_last(
+        out_pks, out_seq, out_vals, out_valid, num_runs = merge_dedup_last(
             tuple(jnp.asarray(c) for c in pks), jnp.asarray(seq),
             tuple(jnp.asarray(v) for v in vals), n)
         k = int(num_runs)
@@ -121,7 +144,7 @@ class TestMergeDedup:
     def test_empty(self):
         cap = 128
         z = jnp.zeros(cap, dtype=jnp.int32)
-        _, _, out_valid, num_runs = merge_dedup_last(
+        _, _, _, out_valid, num_runs = merge_dedup_last(
             (z,), z, (jnp.zeros(cap, dtype=jnp.float32),), 0)
         assert int(num_runs) == 0 and not bool(np.any(np.asarray(out_valid)))
 
@@ -135,11 +158,13 @@ class TestMergeDedup:
         seq[:4] = [1, 2, 2, 1]
         val = np.zeros(cap, dtype=np.float32)
         val[:4] = [10.0, 20.0, 30.0, 40.0]
-        out_pks, out_vals, _, num_runs = merge_dedup_last(
+        out_pks, out_seq, out_vals, _, num_runs = merge_dedup_last(
             (jnp.asarray(pk),), jnp.asarray(seq), (jnp.asarray(val),), 4)
         assert int(num_runs) == 2
         assert np.asarray(out_pks[0])[:2].tolist() == [5, 7]
         assert np.asarray(out_vals[0])[:2].tolist() == [20.0, 30.0]
+        # surviving rows carry their original sequence
+        assert np.asarray(out_seq)[:2].tolist() == [2, 2]
 
     def test_run_starts(self):
         col = jnp.asarray(np.array([1, 1, 2, 2, 2, 3, 0, 0], dtype=np.int32))

@@ -1,0 +1,437 @@
+"""Whole-engine tests (ref tests: storage.rs:390-490, compaction picker
+tests picker.rs:201-236, plan golden test read.rs:575-617)."""
+
+import asyncio
+
+import pyarrow as pa
+import pytest
+
+from horaedb_tpu.common import Error, ReadableDuration
+from horaedb_tpu.objstore import MemoryObjectStore
+from horaedb_tpu.ops import Eq, Gt, TimeRangePred
+from horaedb_tpu.storage.compaction import Task, TimeWindowCompactionStrategy
+from horaedb_tpu.storage.config import (
+    SchedulerConfig,
+    StorageConfig,
+    UpdateMode,
+    from_dict,
+)
+from horaedb_tpu.storage.read import ScanRequest, describe_plan
+from horaedb_tpu.storage.sst import FileMeta, SstFile
+from horaedb_tpu.storage.storage import CloudObjectStorage, WriteRequest
+from horaedb_tpu.storage.types import TimeRange, Timestamp
+
+SEGMENT_MS = 3_600_000  # 1h
+
+
+def user_schema():
+    return pa.schema([
+        pa.field("host", pa.string()),
+        pa.field("ts", pa.int64()),
+        pa.field("cpu", pa.float64()),
+    ])
+
+
+def make_batch(rows):
+    hosts, tss, cpus = zip(*rows)
+    return pa.record_batch(
+        [pa.array(list(hosts)), pa.array(list(tss), type=pa.int64()),
+         pa.array(list(cpus), type=pa.float64())],
+        schema=user_schema())
+
+
+async def open_storage(store=None, update_mode=UpdateMode.OVERWRITE,
+                       config=None):
+    cfg = config or StorageConfig(update_mode=update_mode)
+    # keep background compaction quiet during tests
+    cfg.scheduler.schedule_interval = ReadableDuration.parse("1h")
+    return await CloudObjectStorage.open(
+        "db", SEGMENT_MS, store or MemoryObjectStore(), user_schema(),
+        num_primary_keys=2, config=cfg)
+
+
+async def collect(stream):
+    out = []
+    async for b in stream:
+        out.append(b)
+    return out
+
+
+def rows_of(batches):
+    out = []
+    for b in batches:
+        out.extend(zip(b.column(0).to_pylist(), b.column(1).to_pylist(),
+                       b.column(2).to_pylist()))
+    return out
+
+
+class TestWriteScan:
+    def test_write_then_scan_dedups_across_files(self):
+        """The reference's core scenario (storage.rs:390-490): two writes
+        with overlapping PKs; the later file's rows win."""
+
+        async def go():
+            s = await open_storage()
+            try:
+                await s.write(WriteRequest(
+                    make_batch([("a", 1000, 1.0), ("b", 2000, 2.0),
+                                ("c", 3000, 3.0)]),
+                    TimeRange.new(1000, 3001)))
+                await s.write(WriteRequest(
+                    make_batch([("b", 2000, 20.0), ("d", 1500, 4.0)]),
+                    TimeRange.new(1500, 2001)))
+                got = rows_of(await collect(s.scan(
+                    ScanRequest(range=TimeRange.new(0, 10_000)))))
+                assert got == [("a", 1000, 1.0), ("b", 2000, 20.0),
+                               ("c", 3000, 3.0), ("d", 1500, 4.0)]
+            finally:
+                await s.close()
+
+        asyncio.run(go())
+
+    def test_scan_with_predicate(self):
+        async def go():
+            s = await open_storage()
+            try:
+                await s.write(WriteRequest(
+                    make_batch([("a", 1000, 1.0), ("b", 2000, 2.0),
+                                ("c", 3000, 3.0)]),
+                    TimeRange.new(1000, 3001)))
+                got = rows_of(await collect(s.scan(ScanRequest(
+                    range=TimeRange.new(0, 10_000), predicate=Gt("cpu", 1.5)))))
+                assert got == [("b", 2000, 2.0), ("c", 3000, 3.0)]
+                got = rows_of(await collect(s.scan(ScanRequest(
+                    range=TimeRange.new(0, 10_000), predicate=Eq("host", "a")))))
+                assert got == [("a", 1000, 1.0)]
+                got = rows_of(await collect(s.scan(ScanRequest(
+                    range=TimeRange.new(0, 10_000),
+                    predicate=TimeRangePred("ts", 1500, 2500)))))
+                assert got == [("b", 2000, 2.0)]
+            finally:
+                await s.close()
+
+        asyncio.run(go())
+
+    def test_projection(self):
+        async def go():
+            s = await open_storage()
+            try:
+                await s.write(WriteRequest(
+                    make_batch([("a", 1000, 1.0)]), TimeRange.new(1000, 1001)))
+                batches = await collect(s.scan(ScanRequest(
+                    range=TimeRange.new(0, 10_000), projections=[2])))
+                # projection [cpu] is augmented with the forced pks (appended
+                # after the requested columns, ref: types.rs:202-215);
+                # builtins are stripped from the output
+                assert batches[0].schema.names == ["cpu", "host", "ts"]
+            finally:
+                await s.close()
+
+        asyncio.run(go())
+
+    def test_scan_range_excludes_files(self):
+        async def go():
+            s = await open_storage()
+            try:
+                await s.write(WriteRequest(
+                    make_batch([("a", 1000, 1.0)]), TimeRange.new(1000, 1001)))
+                far = 10 * SEGMENT_MS
+                await s.write(WriteRequest(
+                    make_batch([("z", far, 9.0)]), TimeRange.new(far, far + 1)))
+                got = rows_of(await collect(s.scan(
+                    ScanRequest(range=TimeRange.new(0, 2000)))))
+                assert got == [("a", 1000, 1.0)]
+            finally:
+                await s.close()
+
+        asyncio.run(go())
+
+    def test_multi_segment_scan_ordered(self):
+        async def go():
+            s = await open_storage()
+            try:
+                seg2 = SEGMENT_MS + 500
+                await s.write(WriteRequest(
+                    make_batch([("z", seg2, 9.0)]),
+                    TimeRange.new(seg2, seg2 + 1)))
+                await s.write(WriteRequest(
+                    make_batch([("a", 1000, 1.0)]), TimeRange.new(1000, 1001)))
+                batches = await collect(s.scan(
+                    ScanRequest(range=TimeRange.new(0, 10 * SEGMENT_MS))))
+                assert len(batches) == 2  # one per segment, ascending
+                assert rows_of(batches) == [("a", 1000, 1.0), ("z", seg2, 9.0)]
+            finally:
+                await s.close()
+
+        asyncio.run(go())
+
+    def test_write_cross_segment_rejected(self):
+        async def go():
+            s = await open_storage()
+            try:
+                with pytest.raises(Error, match="crosses segment"):
+                    await s.write(WriteRequest(
+                        make_batch([("a", 1000, 1.0)]),
+                        TimeRange.new(1000, SEGMENT_MS + 10)))
+                # same write with the check disabled is accepted
+                await s.write(WriteRequest(
+                    make_batch([("a", 1000, 1.0)]),
+                    TimeRange.new(1000, SEGMENT_MS + 10), enable_check=False))
+            finally:
+                await s.close()
+
+        asyncio.run(go())
+
+    def test_schema_mismatch_rejected(self):
+        async def go():
+            s = await open_storage()
+            try:
+                bad = pa.record_batch({"x": pa.array([1])})
+                with pytest.raises(Error, match="schema"):
+                    await s.write(WriteRequest(bad, TimeRange.new(0, 1)))
+            finally:
+                await s.close()
+
+        asyncio.run(go())
+
+
+class TestAppendMode:
+    def test_bytes_merge_concat(self):
+        async def go():
+            schema = pa.schema([pa.field("k", pa.string()),
+                                pa.field("payload", pa.binary())])
+            cfg = StorageConfig(update_mode=UpdateMode.APPEND)
+            cfg.scheduler.schedule_interval = ReadableDuration.parse("1h")
+            s = await CloudObjectStorage.open(
+                "db", SEGMENT_MS, MemoryObjectStore(), schema,
+                num_primary_keys=1, config=cfg)
+            try:
+                b1 = pa.record_batch([pa.array(["k1", "k2"]),
+                                      pa.array([b"ab", b"xy"], type=pa.binary())],
+                                     schema=schema)
+                b2 = pa.record_batch([pa.array(["k1"]),
+                                      pa.array([b"cd"], type=pa.binary())],
+                                     schema=schema)
+                await s.write(WriteRequest(b1, TimeRange.new(0, 10)))
+                await s.write(WriteRequest(b2, TimeRange.new(0, 10)))
+                batches = await collect(s.scan(
+                    ScanRequest(range=TimeRange.new(0, 100))))
+                got = {}
+                for b in batches:
+                    for k, v in zip(b.column(0).to_pylist(), b.column(1).to_pylist()):
+                        got[k] = v
+                assert got == {"k1": b"abcd", "k2": b"xy"}
+            finally:
+                await s.close()
+
+        asyncio.run(go())
+
+
+class TestPlanShape:
+    def test_plan_golden_text(self):
+        async def go():
+            s = await open_storage()
+            try:
+                await s.write(WriteRequest(
+                    make_batch([("a", 1000, 1.0)]), TimeRange.new(1000, 1001)))
+                await s.write(WriteRequest(
+                    make_batch([("b", 2000, 2.0)]), TimeRange.new(2000, 2001)))
+                plan = await s.build_scan_plan(ScanRequest(
+                    range=TimeRange.new(0, 10_000), predicate=Eq("host", "a")))
+                ids = sorted(f.id for seg in plan.segments for f in seg.ssts)
+                text = describe_plan(plan)
+                expected = "\n".join([
+                    "MergeScan: mode=Overwrite, keep_builtin=False",
+                    "  Segment[start=0]: DeviceMergeDedup",
+                    "    Filter: Eq(column='host', value='a')",
+                    f"    ParquetScan: files=[{ids[0]}.sst, {ids[1]}.sst], "
+                    "columns=['host', 'ts', 'cpu', '__seq__']",
+                ])
+                assert text == expected
+            finally:
+                await s.close()
+
+        asyncio.run(go())
+
+
+def mkfile(fid, start, end, size=100):
+    f = SstFile(fid, FileMeta(max_sequence=fid, num_rows=10, size=size,
+                              time_range=TimeRange.new(start, end)))
+    return f
+
+
+class TestPickerStrategy:
+    def strategy(self, **kw):
+        defaults = dict(segment_duration_ms=100, new_sst_max_size=1000,
+                        input_sst_max_num=4, input_sst_min_num=2)
+        defaults.update(kw)
+        return TimeWindowCompactionStrategy(**defaults)
+
+    def test_picks_newest_qualifying_segment(self):
+        st = self.strategy()
+        ssts = [mkfile(1, 0, 10), mkfile(2, 20, 30),          # old segment
+                mkfile(3, 100, 110), mkfile(4, 120, 130)]     # new segment
+        task = st.pick_candidate(ssts, None)
+        assert sorted(f.id for f in task.inputs) == [3, 4]
+        assert all(f.in_compaction for f in task.inputs)
+
+    def test_in_compaction_files_excluded(self):
+        st = self.strategy()
+        ssts = [mkfile(1, 0, 10), mkfile(2, 20, 30)]
+        ssts[0].mark_compaction()
+        assert st.pick_candidate(ssts, None) is None
+
+    def test_min_num_required(self):
+        st = self.strategy(input_sst_min_num=3)
+        ssts = [mkfile(1, 0, 10), mkfile(2, 20, 30)]
+        assert st.pick_candidate(ssts, None) is None
+
+    def test_size_budget_smallest_first(self):
+        st = self.strategy(new_sst_max_size=250)  # budget 275
+        ssts = [mkfile(1, 0, 10, size=100), mkfile(2, 20, 30, size=100),
+                mkfile(3, 40, 50, size=100), mkfile(4, 60, 70, size=500)]
+        task = st.pick_candidate(ssts, None)
+        assert sorted(f.id for f in task.inputs) == [1, 2]
+
+    def test_max_num_cap(self):
+        st = self.strategy(input_sst_max_num=3)
+        ssts = [mkfile(i, i * 10, i * 10 + 5) for i in range(1, 7)]
+        task = st.pick_candidate(ssts, None)
+        assert len(task.inputs) == 3
+
+    def test_ttl_expired_split_out(self):
+        st = self.strategy()
+        ssts = [mkfile(1, 0, 10), mkfile(2, 20, 30),
+                mkfile(3, 100, 110), mkfile(4, 120, 130)]
+        # expire_time=50: files ending before 50 are expired
+        task = st.pick_candidate(ssts, Timestamp(50))
+        assert sorted(f.id for f in task.expireds) == [1, 2]
+        assert sorted(f.id for f in task.inputs) == [3, 4]
+
+
+class TestCompactionEndToEnd:
+    def test_compact_merges_files_and_cleans_up(self):
+        async def go():
+            store = MemoryObjectStore()
+            cfg = from_dict(StorageConfig, {
+                "scheduler": {"schedule_interval": "1h",
+                              "input_sst_min_num": 2}})
+            s = await CloudObjectStorage.open(
+                "db", SEGMENT_MS, store, user_schema(),
+                num_primary_keys=2, config=cfg)
+            try:
+                for i, rows in enumerate([
+                    [("a", 1000, 1.0), ("b", 2000, 2.0)],
+                    [("b", 2000, 20.0), ("c", 3000, 3.0)],
+                    [("c", 3000, 30.0)],
+                ]):
+                    await s.write(WriteRequest(
+                        make_batch(rows), TimeRange.new(1000, 3001)))
+                assert len(await s.manifest.all_ssts()) == 3
+
+                task = await s.compact_scheduler.picker.pick_candidate()
+                assert task is not None and len(task.inputs) == 3
+                await s.compact_scheduler.executor.execute(task)
+
+                ssts = await s.manifest.all_ssts()
+                assert len(ssts) == 1
+                new = ssts[0]
+                assert new.meta.num_rows == 3
+                assert new.meta.time_range == TimeRange.new(1000, 3001)
+                # old objects gone, new object present
+                objs = [m.path for m in await store.list("db/data/")]
+                assert objs == [f"db/data/{new.id}.sst"]
+                # data still correct post-compaction (dedup survived)
+                got = rows_of(await collect(s.scan(
+                    ScanRequest(range=TimeRange.new(0, 10_000)))))
+                assert got == [("a", 1000, 1.0), ("b", 2000, 20.0),
+                               ("c", 3000, 30.0)]
+                # compacting again finds nothing (single file below min)
+                assert await s.compact_scheduler.picker.pick_candidate() is None
+            finally:
+                await s.close()
+
+        asyncio.run(go())
+
+    def test_scan_after_compaction_dedups_vs_new_writes(self):
+        async def go():
+            store = MemoryObjectStore()
+            cfg = from_dict(StorageConfig, {
+                "scheduler": {"schedule_interval": "1h",
+                              "input_sst_min_num": 2}})
+            s = await CloudObjectStorage.open(
+                "db", SEGMENT_MS, store, user_schema(),
+                num_primary_keys=2, config=cfg)
+            try:
+                await s.write(WriteRequest(
+                    make_batch([("a", 1000, 1.0)]), TimeRange.new(1000, 1001)))
+                await s.write(WriteRequest(
+                    make_batch([("a", 1000, 2.0)]), TimeRange.new(1000, 1001)))
+                task = await s.compact_scheduler.picker.pick_candidate()
+                await s.compact_scheduler.executor.execute(task)
+                # a write AFTER compaction must still shadow compacted rows
+                await s.write(WriteRequest(
+                    make_batch([("a", 1000, 3.0)]), TimeRange.new(1000, 1001)))
+                got = rows_of(await collect(s.scan(
+                    ScanRequest(range=TimeRange.new(0, 10_000)))))
+                assert got == [("a", 1000, 3.0)]
+            finally:
+                await s.close()
+
+        asyncio.run(go())
+
+
+class TestReviewRegressions:
+    """Regression coverage for review findings."""
+
+    def test_null_writes_rejected(self):
+        async def go():
+            s = await open_storage()
+            try:
+                bad = pa.record_batch(
+                    [pa.array(["a"]), pa.array([1000], type=pa.int64()),
+                     pa.array([None], type=pa.float64())],
+                    schema=user_schema())
+                with pytest.raises(Error, match="nulls"):
+                    await s.write(WriteRequest(bad, TimeRange.new(1000, 1001)))
+            finally:
+                await s.close()
+
+        asyncio.run(go())
+
+    def test_memory_gate_rejection_does_not_underflow(self):
+        async def go():
+            cfg = from_dict(StorageConfig, {
+                "scheduler": {"schedule_interval": "1h", "memory_limit": "1KB"}})
+            s = await open_storage(config=cfg)
+            try:
+                big = Task(inputs=[mkfile(1, 0, 10, size=4096)])
+                ex = s.compact_scheduler.executor
+                for _ in range(3):
+                    with pytest.raises(Error, match="memory"):
+                        await ex.execute(big)
+                assert ex.inused_memory == 0  # no underflow
+                assert not big.inputs[0].in_compaction  # re-pickable
+            finally:
+                await s.close()
+
+        asyncio.run(go())
+
+    def test_projected_scan_sorts_by_schema_pk_order(self):
+        async def go():
+            s = await open_storage()
+            try:
+                await s.write(WriteRequest(
+                    make_batch([("b", 1000, 1.0), ("a", 2000, 2.0)]),
+                    TimeRange.new(1000, 2001)))
+                batches = await collect(s.scan(ScanRequest(
+                    range=TimeRange.new(0, 10_000), projections=[1])))
+                # projection [ts] reorders columns, but output must still be
+                # sorted by schema PK order (host, ts)
+                b = batches[0]
+                hosts = b.column(b.schema.names.index("host")).to_pylist()
+                assert hosts == ["a", "b"]
+            finally:
+                await s.close()
+
+        asyncio.run(go())
