@@ -9,8 +9,12 @@ over min/max/sum/count/avg/last.
 Shape discipline: output is a dense (num_groups, num_buckets) grid —
 group ids are dictionary codes, bucket ids are (ts - range_start) //
 bucket_ms.  Both counts are static per query, so jit compiles one program
-per (capacity, groups, buckets) signature, and the grid maps directly onto
-chips for the multi-chip path (one psum over partial grids).
+per (capacity, groups, buckets) signature.
+
+Split into partial_aggregate / finalize_aggregate so the multi-chip path
+(parallel/scan.py) can psum/pmax partial grids across the segment mesh
+axis before finalizing — the identity elements (0, +/-inf, INT32_MIN)
+combine correctly under collectives, NaNs would not.
 """
 
 from __future__ import annotations
@@ -21,26 +25,19 @@ import jax
 import jax.numpy as jnp
 
 _F32_MAX = jnp.float32(jnp.finfo(jnp.float32).max)
+_I32_MIN = jnp.int32(-(2**31))
 
 
-@functools.partial(jax.jit, static_argnames=("num_groups", "num_buckets"))
-def time_bucket_aggregate(ts_offset: jax.Array, group_ids: jax.Array,
-                          values: jax.Array, n_valid, bucket_ms,
-                          num_groups: int, num_buckets: int) -> dict:
-    """Aggregate values into a dense (group, time-bucket) grid.
+def partial_aggregate(ts_offset: jax.Array, group_ids: jax.Array,
+                      values: jax.Array, n_valid, bucket_ms,
+                      num_groups: int, num_buckets: int) -> dict:
+    """Raw per-shard aggregate grids, all (num_groups, num_buckets):
 
-    Args:
-      ts_offset: int32 (capacity,) — timestamp offsets from the query range
-        start (so bucket 0 starts at offset 0).
-      group_ids: int32 (capacity,) — dictionary codes of the group key.
-      values: float32 (capacity,).
-      n_valid: scalar int — real row count.
-      bucket_ms: scalar int32 — bucket width in the ts unit.
-      num_groups / num_buckets: static grid extents.
+      sum (0-init), count (0), min (+F32_MAX), max (-F32_MAX),
+      last_ts (I32_MIN), last (0 where empty).
 
-    Returns dict of (num_groups, num_buckets) float32 arrays:
-      sum, count, min, max, avg, last (value at max ts per cell).
-    Empty cells: count 0, sum 0, min +inf, max -inf, avg/last NaN.
+    Combinable across shards: sum/count by +, min by min, max by max,
+    (last_ts, last) by argmax-ts with later-shard tie-break.
     """
     capacity = ts_offset.shape[0]
     iota = jnp.arange(capacity, dtype=jnp.int32)
@@ -65,23 +62,59 @@ def time_bucket_aggregate(ts_offset: jax.Array, group_ids: jax.Array,
     # "last" = value at the highest timestamp in the cell (later row wins
     # ties, mirroring last-value merge semantics).  Two segmented passes:
     # max ts per cell, then max row index among rows at that ts.
-    int32_min = jnp.int32(-(2**31))
-    tmax = jax.ops.segment_max(jnp.where(in_grid, ts_offset, int32_min), seg,
+    tmax = jax.ops.segment_max(jnp.where(in_grid, ts_offset, _I32_MIN), seg,
                                num_segments=num_cells + 1)
     at_max_ts = in_grid & (ts_offset == tmax[seg])
     last_row = jax.ops.segment_max(jnp.where(at_max_ts, iota, -1), seg,
                                    num_segments=num_cells + 1)[:num_cells]
-    last = values[jnp.clip(last_row, 0, capacity - 1)]
+    last = jnp.where(last_row >= 0,
+                     values[jnp.clip(last_row, 0, capacity - 1)], 0.0)
 
     grid = lambda a: a.reshape(num_groups, num_buckets)
-    count_g = grid(count)
-    empty = count_g == 0
-    nan = jnp.float32(jnp.nan)
     return {
-        "count": count_g,
+        "count": grid(count),
         "sum": grid(total),
         "min": grid(vmin),
         "max": grid(vmax),
-        "avg": jnp.where(empty, nan, grid(total) / jnp.maximum(count_g, 1.0)),
-        "last": jnp.where(empty, nan, grid(last)),
+        "last_ts": grid(tmax[:num_cells]),
+        "last": grid(last),
     }
+
+
+def finalize_aggregate(partial: dict) -> dict:
+    """Turn combined partial grids into user-facing aggregates.
+    Empty cells: count 0, sum 0, min +inf, max -inf, avg/last NaN."""
+    count = partial["count"]
+    empty = count == 0
+    nan = jnp.float32(jnp.nan)
+    return {
+        "count": count,
+        "sum": partial["sum"],
+        "min": partial["min"],
+        "max": partial["max"],
+        "avg": jnp.where(empty, nan, partial["sum"] / jnp.maximum(count, 1.0)),
+        "last": jnp.where(empty, nan, partial["last"]),
+    }
+
+
+@functools.partial(jax.jit, static_argnames=("num_groups", "num_buckets"))
+def time_bucket_aggregate(ts_offset: jax.Array, group_ids: jax.Array,
+                          values: jax.Array, n_valid, bucket_ms,
+                          num_groups: int, num_buckets: int) -> dict:
+    """Single-shard aggregate: partial + finalize in one compiled program.
+
+    Args:
+      ts_offset: int32 (capacity,) — timestamp offsets from the query range
+        start (so bucket 0 starts at offset 0).
+      group_ids: int32 (capacity,) — dictionary codes of the group key.
+      values: float32 (capacity,).
+      n_valid: scalar int — real row count.
+      bucket_ms: scalar int32 — bucket width in the ts unit.
+      num_groups / num_buckets: static grid extents.
+
+    Returns dict of (num_groups, num_buckets) float32 arrays:
+      sum, count, min, max, avg, last (value at max ts per cell).
+    """
+    return finalize_aggregate(partial_aggregate(
+        ts_offset, group_ids, values, n_valid, bucket_ms,
+        num_groups, num_buckets))

@@ -1,0 +1,18 @@
+"""Multi-chip execution: segment-axis mesh + shard_map scan programs.
+
+The TPU-native replacement for the reference's cross-partition merge
+(SortPreservingMergeExec under UnionExec, SURVEY.md section 2.5 P2/P3):
+time segments are independent by construction (storage.rs:342-368 builds
+one plan per segment), so segments ARE the shard axis.  Each chip
+merge-dedups and partially aggregates its own segments; only the small
+dense (group, bucket) grids cross chips, as psum/pmax/pmin collectives
+over ICI — never row data.
+"""
+
+from horaedb_tpu.parallel.mesh import segment_mesh
+from horaedb_tpu.parallel.scan import (
+    sharded_downsample_query,
+    sharded_merge_dedup,
+)
+
+__all__ = ["segment_mesh", "sharded_downsample_query", "sharded_merge_dedup"]

@@ -1,0 +1,31 @@
+"""Device mesh construction for the segment axis."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+from jax.sharding import Mesh
+
+from horaedb_tpu.common.error import ensure
+
+SEGMENT_AXIS = "seg"
+
+
+def segment_mesh(n_devices: Optional[int] = None,
+                 devices: Optional[Sequence] = None) -> Mesh:
+    """1-D mesh over the segment (time-window) axis.
+
+    A single axis is the right topology for the scan workload: segments
+    are embarrassingly parallel and only grid-sized aggregates cross the
+    axis, so a v5e-8's ring handles the psum without any 2-D layout.
+    """
+    devs = list(devices) if devices is not None else jax.devices()
+    if n_devices is not None:
+        ensure(len(devs) >= n_devices,
+               f"requested a {n_devices}-device mesh but only "
+               f"{len(devs)} devices are available")
+        devs = devs[:n_devices]
+    import numpy as np
+
+    return Mesh(np.array(devs), axis_names=(SEGMENT_AXIS,))

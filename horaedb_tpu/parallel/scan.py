@@ -1,0 +1,139 @@
+"""shard_map scan programs over the segment mesh.
+
+Data layout: host stacks per-segment device batches into
+(n_devices, capacity) arrays, sharded on the leading (segment) axis.
+Segments never share primary keys with each other in OVERWRITE semantics
+terms (a PK's rows live in one segment at a time... strictly: dedup is
+segment-scoped by design, matching the reference where each segment gets
+its own MergeExec), so:
+
+- merge-dedup is purely shard-local (no collective at all);
+- downsampling combines per-shard partial grids with psum (sum/count),
+  pmin/pmax (min/max), and an argmax-by-timestamp scheme for `last`
+  (later shard wins ties, mirroring later-file-wins);
+- top-k runs on the replicated combined grid.
+
+Collectives ride ICI inside one compiled program — the XLA analogue of
+the reference's cross-partition SortPreservingMergeExec, except only
+(groups x buckets) floats cross chips instead of row streams.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from horaedb_tpu.common.error import Error
+from horaedb_tpu.ops import downsample, merge as merge_ops
+from horaedb_tpu.ops.topk import top_k_groups
+from horaedb_tpu.parallel.mesh import SEGMENT_AXIS
+
+
+def _check_block_is_one(block) -> None:
+    """The shard programs index block [0]; a leading axis larger than the
+    mesh would silently drop segments.  Fail at trace time instead."""
+    if block.shape[0] != 1:
+        raise Error(
+            f"leading axis {block.shape[0]} exceeds the mesh: stack exactly "
+            "one segment batch per device (pad the device axis, or scan in "
+            "rounds)")
+
+
+def _combine_partials(p: dict) -> dict:
+    """Cross-shard combination of partial aggregate grids."""
+    ax = SEGMENT_AXIS
+    combined = {
+        "count": jax.lax.psum(p["count"], ax),
+        "sum": jax.lax.psum(p["sum"], ax),
+        "min": jax.lax.pmin(p["min"], ax),
+        "max": jax.lax.pmax(p["max"], ax),
+    }
+    # `last`: the shard holding the globally-latest timestamp wins; ties
+    # break toward the higher shard index (later segment).
+    g_last_ts = jax.lax.pmax(p["last_ts"], ax)
+    rank = jax.lax.axis_index(ax)
+    eligible = p["last_ts"] == g_last_ts
+    g_rank = jax.lax.pmax(jnp.where(eligible, rank, -1), ax)
+    winner = eligible & (rank == g_rank)
+    combined["last"] = jax.lax.psum(jnp.where(winner, p["last"], 0.0), ax)
+    combined["last_ts"] = g_last_ts
+    return combined
+
+
+def sharded_downsample_query(mesh, *, num_groups: int, num_buckets: int,
+                             k: int):
+    """Build the compiled multi-chip downsample+topk query.
+
+    Returns fn(ts_offset, group_ids, values, n_valid, bucket_ms) where the
+    first three args are (n_devices, capacity) int32/int32/float32 arrays
+    sharded on the leading axis, n_valid is (n_devices,) int32, and
+    bucket_ms is a replicated scalar.  Output: replicated dict of
+    (num_groups, num_buckets) finalized grids + (top_k values, indices).
+    """
+
+    def shard_fn(ts, gid, vals, n_valid, bucket_ms):
+        _check_block_is_one(ts)
+        # leading axis is the shard axis: each shard sees (1, capacity)
+        p = downsample.partial_aggregate(
+            ts[0], gid[0], vals[0], n_valid[0], bucket_ms[0],
+            num_groups=num_groups, num_buckets=num_buckets)
+        combined = _combine_partials(p)
+        final = downsample.finalize_aggregate(combined)
+        scores = jnp.max(jnp.where(final["count"] > 0, final["max"],
+                                   -jnp.inf), axis=1).astype(jnp.float32)
+        top_vals, top_idx = top_k_groups(scores, k=k)
+        return final, top_vals, top_idx
+
+    mapped = shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P(SEGMENT_AXIS, None), P(SEGMENT_AXIS, None),
+                  P(SEGMENT_AXIS, None), P(SEGMENT_AXIS), P()),
+        out_specs=(P(), P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(mapped)
+
+
+def sharded_merge_dedup(mesh, *, num_pks: int):
+    """Build the compiled multi-chip merge-dedup.
+
+    Segments are the shard axis and dedup is segment-scoped, so this is
+    shard-local compute with NO collectives — the mesh exists so the same
+    program scales from 1 to N chips and composes with the downsample
+    collectives in one jit.
+
+    Returns fn(pks, seq, values, n_valid) over (n_devices, capacity)
+    arrays; outputs keep the same sharded layout plus a per-shard
+    (n_devices,) run count.
+    """
+
+    def shard_fn(pks, seq, values, n_valid):
+        _check_block_is_one(seq)
+        out_pks, out_seq, out_vals, out_valid, num_runs = \
+            merge_ops.merge_dedup_last(
+                tuple(c[0] for c in pks), seq[0],
+                tuple(v[0] for v in values), n_valid[0])
+        expand = lambda a: a[None, :]
+        return (tuple(expand(c) for c in out_pks), expand(out_seq),
+                tuple(expand(v) for v in out_vals), expand(out_valid),
+                num_runs[None])
+
+    mapped = shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P(SEGMENT_AXIS, None), P(SEGMENT_AXIS, None),
+                  P(SEGMENT_AXIS, None), P(SEGMENT_AXIS)),
+        out_specs=(P(SEGMENT_AXIS, None), P(SEGMENT_AXIS, None),
+                   P(SEGMENT_AXIS, None), P(SEGMENT_AXIS, None),
+                   P(SEGMENT_AXIS)),
+        check_vma=False,
+    )
+    return jax.jit(mapped)
+
+
+def shard_leading_axis(mesh, arr):
+    """Place an (n_devices, ...) host array sharded over the segment axis."""
+    return jax.device_put(arr, NamedSharding(mesh, P(SEGMENT_AXIS)))

@@ -278,8 +278,13 @@ class Scheduler:
                                        timeout=self.interval_s)
             except (TimeoutError, asyncio.TimeoutError):
                 pass
-            # picker must run serially (in_compaction marking is the lock)
-            task = await self.picker.pick_candidate()
+            # picker must run serially (in_compaction marking is the lock);
+            # transient store errors must not kill the loop
+            try:
+                task = await self.picker.pick_candidate()
+            except Exception:
+                logger.exception("compaction pick failed; will retry")
+                continue
             if task is not None:
                 try:
                     self._tasks.put_nowait(task)

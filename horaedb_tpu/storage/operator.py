@@ -21,15 +21,17 @@ import pyarrow as pa
 from horaedb_tpu.common.error import Error, ensure
 
 
-def _run_starts_host(batch: pa.RecordBatch, num_pks: int) -> np.ndarray:
+def _run_starts_host(batch: pa.RecordBatch, pk_indices: list[int]) -> np.ndarray:
     """Boolean run-start mask over a PK-sorted batch (host twin of
-    ops.merge.sorted_run_starts)."""
+    ops.merge.sorted_run_starts).  pk_indices are explicit because a
+    projection may have reordered columns — PKs are NOT necessarily the
+    first columns of the batch."""
     n = batch.num_rows
     if n == 0:
         return np.zeros(0, dtype=bool)
     starts = np.zeros(n, dtype=bool)
     starts[0] = True
-    for i in range(num_pks):
+    for i in pk_indices:
         col = batch.column(i).to_numpy(zero_copy_only=False)
         starts[1:] |= col[1:] != col[:-1]
     return starts
@@ -39,11 +41,12 @@ class LastValueOperator:
     """Keep the last row of each group — highest sequence wins
     (ref: operator.rs:37-44).  Overwrite mode."""
 
-    def merge_sorted_batch(self, batch: pa.RecordBatch, num_pks: int) -> pa.RecordBatch:
+    def merge_sorted_batch(self, batch: pa.RecordBatch,
+                           pk_indices: list[int]) -> pa.RecordBatch:
         n = batch.num_rows
         if n == 0:
             return batch
-        starts = _run_starts_host(batch, num_pks)
+        starts = _run_starts_host(batch, pk_indices)
         # last index of run k = (start of run k+1) - 1; last run ends at n-1
         last_idx = np.append(np.nonzero(starts)[0][1:] - 1, n - 1)
         return batch.take(pa.array(last_idx))
@@ -57,7 +60,8 @@ class BytesMergeOperator:
     def __init__(self, value_idxes: list[int]):
         self.value_idxes = value_idxes
 
-    def merge_sorted_batch(self, batch: pa.RecordBatch, num_pks: int) -> pa.RecordBatch:
+    def merge_sorted_batch(self, batch: pa.RecordBatch,
+                           pk_indices: list[int]) -> pa.RecordBatch:
         n = batch.num_rows
         if n == 0:
             return batch
@@ -66,7 +70,7 @@ class BytesMergeOperator:
             ensure(pa.types.is_binary(t) or pa.types.is_large_binary(t),
                    f"BytesMergeOperator requires binary columns, got {t}")
 
-        starts = _run_starts_host(batch, num_pks)
+        starts = _run_starts_host(batch, pk_indices)
         first_idx = np.nonzero(starts)[0]
         group_of_row = np.cumsum(starts) - 1
         num_groups = len(first_idx)

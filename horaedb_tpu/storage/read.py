@@ -134,7 +134,7 @@ class ParquetReader:
         table = await self._read_segment_table(seg)
         if table.num_rows == 0:
             return None
-        batch = table.combine_chunks().to_batches()[0] if table.num_rows else None
+        batch = table.combine_chunks().to_batches()[0]
         if plan.mode is UpdateMode.OVERWRITE:
             merged = self._merge_on_device(batch, seg, plan)
         else:
@@ -190,10 +190,13 @@ class ParquetReader:
         sort_keys = [(n, "ascending") for n in pk_names + [SEQ_COLUMN_NAME]]
         idx = pa.compute.sort_indices(batch, sort_keys=sort_keys)
         batch = batch.take(idx)
-        value_idxes = [batch.schema.names.index(n) for n in batch.schema.names
+        names = batch.schema.names
+        value_idxes = [names.index(n) for n in names
                        if n not in pk_names and n != SEQ_COLUMN_NAME]
         op = build_operator(plan.mode, value_idxes)
-        merged = op.merge_sorted_batch(batch, num_pks=len(pk_names))
+        # explicit indices: a projection may have reordered columns
+        merged = op.merge_sorted_batch(
+            batch, pk_indices=[names.index(n) for n in pk_names])
         if plan.predicate is not None:
             mask = _eval_predicate_host(plan.predicate, merged)
             merged = merged.filter(pa.array(mask))

@@ -435,3 +435,41 @@ class TestReviewRegressions:
                 await s.close()
 
         asyncio.run(go())
+
+
+class TestAppendModeProjection:
+    def test_bytes_merge_with_reordering_projection(self):
+        """Projection puts the value column first; host merge must still
+        group by the true PK (review regression)."""
+
+        async def go():
+            schema = pa.schema([pa.field("k", pa.string()),
+                                pa.field("payload", pa.binary())])
+            cfg = StorageConfig(update_mode=UpdateMode.APPEND)
+            cfg.scheduler.schedule_interval = ReadableDuration.parse("1h")
+            s = await CloudObjectStorage.open(
+                "db", SEGMENT_MS, MemoryObjectStore(), schema,
+                num_primary_keys=1, config=cfg)
+            try:
+                b1 = pa.record_batch([pa.array(["k1", "k2"]),
+                                      pa.array([b"ab", b"xy"], type=pa.binary())],
+                                     schema=schema)
+                b2 = pa.record_batch([pa.array(["k1"]),
+                                      pa.array([b"cd"], type=pa.binary())],
+                                     schema=schema)
+                await s.write(WriteRequest(b1, TimeRange.new(0, 10)))
+                await s.write(WriteRequest(b2, TimeRange.new(0, 10)))
+                batches = await collect(s.scan(ScanRequest(
+                    range=TimeRange.new(0, 100), projections=[1])))
+                got = {}
+                for b in batches:
+                    ki = b.schema.names.index("k")
+                    pi = b.schema.names.index("payload")
+                    for k, v in zip(b.column(ki).to_pylist(),
+                                    b.column(pi).to_pylist()):
+                        got[k] = v
+                assert got == {"k1": b"abcd", "k2": b"xy"}
+            finally:
+                await s.close()
+
+        asyncio.run(go())
