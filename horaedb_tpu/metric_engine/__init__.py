@@ -1,0 +1,13 @@
+"""Prometheus-style metric layer over TimeMergeStorage instances
+(ref: src/metric_engine + docs/rfcs/20240827-metric-engine.md).
+
+Four index tables + one data table, each its own TimeMergeStorage with
+segment-duration-implied dates (RFC:86-137); the write pipeline is
+MetricManager -> IndexManager -> SampleManager (ref: metric_engine
+README diagram; manager bodies are todo!() in the reference, so the
+behavior here is built from the RFC)."""
+
+from horaedb_tpu.metric_engine.types import Label, Sample, metric_id_of, series_key_of, tsid_of
+from horaedb_tpu.metric_engine.engine import MetricEngine
+
+__all__ = ["Label", "MetricEngine", "Sample", "metric_id_of", "series_key_of", "tsid_of"]

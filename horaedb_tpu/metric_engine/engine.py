@@ -1,0 +1,395 @@
+"""MetricEngine: the manager pipeline and the five tables.
+
+Write path (ref: metric_engine README pipeline; bodies built from RFC):
+  samples -> MetricManager.populate_metric_ids
+          -> IndexManager.populate_series_ids (+ index/series/tags rows)
+          -> SampleManager.persist (data table rows)
+
+Tables (RFC:106-137), each a TimeMergeStorage with the same segment
+duration — the RFC's `Date` dimension is implied by the segment, so
+index entries are re-registered once per (segment, series), exactly how
+VictoriaMetrics scopes its inverted index by date:
+
+  metrics {metric_name, field_name | metric_id, field_id, field_type}
+  series  {metric_id, tsid | series_key}
+  tags    {metric_id, tag_key, tag_value | exists}      (label_values)
+  index   {metric_id, tag_key, tag_value, tsid | exists} (inverted index)
+  data    {metric_id, tsid, field_id, timestamp | value}
+
+Stage-1 divergence from the RFC, by design: data rows carry plain
+(timestamp, value) columns instead of the RFC's opaque 30-minute
+compressed chunks (RFC:218-231) — fixed-width columns are what the TPU
+scan path wants; the chunk encoding belongs to the Append/BytesMerge
+path and can layer on later without changing this API.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+import numpy as np
+import pyarrow as pa
+
+from horaedb_tpu.common.error import Error, ensure
+from horaedb_tpu.objstore import ObjectStore
+from horaedb_tpu.ops import And, Eq, In, TimeRangePred
+from horaedb_tpu.ops.downsample import time_bucket_aggregate
+from horaedb_tpu.storage.config import StorageConfig
+from horaedb_tpu.storage.read import ScanRequest
+from horaedb_tpu.storage.storage import CloudObjectStorage, WriteRequest
+from horaedb_tpu.storage.types import TimeRange, Timestamp
+from horaedb_tpu.metric_engine.types import (
+    Sample,
+    field_id_of,
+    metric_id_of,
+    series_key_of,
+    tsid_of,
+)
+
+_TABLE_SCHEMAS = {
+    "metrics": (pa.schema([
+        ("metric_name", pa.string()), ("field_name", pa.string()),
+        ("metric_id", pa.uint64()), ("field_id", pa.uint64()),
+        ("field_type", pa.int32()),
+    ]), 2),
+    "series": (pa.schema([
+        ("metric_id", pa.uint64()), ("tsid", pa.uint64()),
+        ("series_key", pa.binary()),
+    ]), 2),
+    "tags": (pa.schema([
+        ("metric_id", pa.uint64()), ("tag_key", pa.string()),
+        ("tag_value", pa.string()), ("exists", pa.int32()),
+    ]), 3),
+    "index": (pa.schema([
+        ("metric_id", pa.uint64()), ("tag_key", pa.string()),
+        ("tag_value", pa.string()), ("tsid", pa.uint64()),
+        ("exists", pa.int32()),
+    ]), 4),
+    "data": (pa.schema([
+        ("metric_id", pa.uint64()), ("tsid", pa.uint64()),
+        ("field_id", pa.uint64()), ("timestamp", pa.int64()),
+        ("value", pa.float64()),
+    ]), 4),
+}
+
+FIELD_TYPE_FLOAT = 0
+# keep per-(segment) registration dedup state for this many newest segments;
+# older entries can never be useful again and would grow without bound
+_SEEN_SEGMENTS_KEPT = 4
+
+
+async def _collect(stream) -> list[pa.RecordBatch]:
+    return [b async for b in stream]
+
+
+def _empty_result() -> pa.Table:
+    return pa.table({"tsid": pa.array([], type=pa.uint64()),
+                     "timestamp": pa.array([], type=pa.int64()),
+                     "value": pa.array([], type=pa.float64())})
+
+
+class _SegmentSeen:
+    """Bounded (segment -> seen keys) registration cache.  Keys are added
+    only AFTER the registration write succeeds, so a failed write is
+    retried on the next ingest instead of being skipped forever."""
+
+    def __init__(self, keep: int = _SEEN_SEGMENTS_KEPT):
+        self._by_segment: dict[int, set] = {}
+        self._keep = keep
+
+    def __contains__(self, seg_key: tuple) -> bool:
+        seg, key = seg_key
+        return key in self._by_segment.get(seg, ())
+
+    def add(self, seg: int, key) -> None:
+        self._by_segment.setdefault(seg, set()).add(key)
+        if len(self._by_segment) > self._keep:
+            for old in sorted(self._by_segment)[: len(self._by_segment) - self._keep]:
+                del self._by_segment[old]
+
+
+class MetricManager:
+    """name -> MetricId resolution + metrics-table registration
+    (ref: metric/mod.rs:25-50, body from RFC)."""
+
+    def __init__(self, table: CloudObjectStorage, segment_ms: int):
+        self.table = table
+        self.segment_ms = segment_ms
+        self._seen = _SegmentSeen()
+
+    async def populate_metric_ids(self, samples: list[Sample]) -> None:
+        by_seg: dict[int, dict] = {}
+        for s in samples:
+            s.name_id = metric_id_of(s.name)
+            seg = int(Timestamp(s.timestamp).truncate_by(self.segment_ms))
+            key = (s.name, s.field_name)
+            if (seg, key) not in self._seen:
+                by_seg.setdefault(seg, {})[key] = s.name_id
+        for seg, items in by_seg.items():
+            names = [k[0] for k in items]
+            fnames = [k[1] for k in items]
+            batch = pa.record_batch(
+                [pa.array(names),
+                 pa.array(fnames),
+                 pa.array(list(items.values()), type=pa.uint64()),
+                 pa.array([field_id_of(f) for f in fnames], type=pa.uint64()),
+                 pa.array([FIELD_TYPE_FLOAT] * len(items), type=pa.int32())],
+                schema=self.table.schema().user_schema)
+            # registration rows cover the WHOLE segment so any query window
+            # inside the segment finds them (Date == segment, RFC:104)
+            await self.table.write(WriteRequest(
+                batch, TimeRange.new(seg, seg + self.segment_ms)))
+            # mark seen only after a durable write — retries must re-register
+            for key in items:
+                self._seen.add(seg, key)
+
+    async def resolve(self, metric_name: str,
+                      time_range: TimeRange) -> Optional[int]:
+        """metric name -> id via the metrics table (cache-through)."""
+        batches = await _collect(self.table.scan(ScanRequest(
+            range=time_range, predicate=Eq("metric_name", metric_name))))
+        for b in batches:
+            if b.num_rows:
+                return b.column(b.schema.names.index("metric_id"))[0].as_py()
+        return None
+
+
+class IndexManager:
+    """TSID resolution + series/tags/index registration per segment
+    (ref: index/mod.rs:25-44, body from RFC:86-137)."""
+
+    def __init__(self, series: CloudObjectStorage, tags: CloudObjectStorage,
+                 index: CloudObjectStorage, segment_ms: int):
+        self.series = series
+        self.tags = tags
+        self.index = index
+        self.segment_ms = segment_ms
+        self._seen = _SegmentSeen()  # (segment, tsid)
+
+    async def populate_series_ids(self, samples: list[Sample]) -> None:
+        new: dict[int, dict[int, Sample]] = {}
+        for s in samples:
+            ensure(s.name_id is not None, "populate_metric_ids must run first")
+            s.series_id = tsid_of(s.name, s.labels)
+            seg = int(Timestamp(s.timestamp).truncate_by(self.segment_ms))
+            if (seg, s.series_id) not in self._seen:
+                new.setdefault(seg, {})[s.series_id] = s
+        for seg, by_tsid in new.items():
+            await self._register(seg, list(by_tsid.values()))
+            # mark seen only after durable registration (retry on failure)
+            for tsid in by_tsid:
+                self._seen.add(seg, tsid)
+
+    async def _register(self, seg: int, samples: list[Sample]) -> None:
+        # whole-segment range: see MetricManager.populate_metric_ids
+        rng = TimeRange.new(seg, seg + self.segment_ms)
+        series_schema = self.series.schema().user_schema
+        mids, tsids, keys = [], [], []
+        t_mids, t_keys, t_vals = [], [], []
+        i_mids, i_keys, i_vals, i_tsids = [], [], [], []
+        for s in samples:
+            mids.append(s.name_id)
+            tsids.append(s.series_id)
+            keys.append(series_key_of(s.name, s.labels))
+            for lb in s.labels:
+                t_mids.append(s.name_id)
+                t_keys.append(lb.name)
+                t_vals.append(lb.value)
+                i_mids.append(s.name_id)
+                i_keys.append(lb.name)
+                i_vals.append(lb.value)
+                i_tsids.append(s.series_id)
+        await self.series.write(WriteRequest(pa.record_batch(
+            [pa.array(mids, type=pa.uint64()), pa.array(tsids, type=pa.uint64()),
+             pa.array(keys, type=pa.binary())], schema=series_schema), rng))
+        if t_mids:
+            ones = pa.array([1] * len(t_mids), type=pa.int32())
+            await self.tags.write(WriteRequest(pa.record_batch(
+                [pa.array(t_mids, type=pa.uint64()), pa.array(t_keys),
+                 pa.array(t_vals), ones],
+                schema=self.tags.schema().user_schema), rng))
+            await self.index.write(WriteRequest(pa.record_batch(
+                [pa.array(i_mids, type=pa.uint64()), pa.array(i_keys),
+                 pa.array(i_vals), pa.array(i_tsids, type=pa.uint64()),
+                 pa.array([1] * len(i_mids), type=pa.int32())],
+                schema=self.index.schema().user_schema), rng))
+
+    async def find_tsids(self, metric_id: int,
+                         filters: list[tuple[str, str]],
+                         time_range: TimeRange) -> Optional[set[int]]:
+        """Inverted-index lookup: intersect TSID sets per label filter.
+        Returns None when no filters were given (= all series)."""
+        if not filters:
+            return None
+        result: Optional[set[int]] = None
+        for key, value in filters:
+            pred = And([Eq("metric_id", metric_id), Eq("tag_key", key),
+                        Eq("tag_value", value)])
+            tsids: set[int] = set()
+            for b in await _collect(self.index.scan(ScanRequest(
+                    range=time_range, predicate=pred))):
+                col = b.column(b.schema.names.index("tsid"))
+                tsids.update(col.to_pylist())
+            result = tsids if result is None else (result & tsids)
+            if not result:
+                return set()
+        return result
+
+    async def label_values(self, metric_id: int, tag_key: str,
+                           time_range: TimeRange) -> list[str]:
+        """(RFC: tags table accelerates LabelValues)."""
+        vals: set[str] = set()
+        for b in await _collect(self.tags.scan(ScanRequest(
+                range=time_range,
+                predicate=And([Eq("metric_id", metric_id),
+                               Eq("tag_key", tag_key)])))):
+            col = b.column(b.schema.names.index("tag_value"))
+            vals.update(col.to_pylist())
+        return sorted(vals)
+
+    async def resolve_series_keys(self, metric_id: int, tsids: list[int],
+                                  time_range: TimeRange) -> dict[int, bytes]:
+        pred = And([Eq("metric_id", metric_id),
+                    In("tsid", tsids)]) if tsids else Eq("metric_id", metric_id)
+        out: dict[int, bytes] = {}
+        for b in await _collect(self.series.scan(ScanRequest(
+                range=time_range, predicate=pred))):
+            t = b.column(b.schema.names.index("tsid")).to_pylist()
+            k = b.column(b.schema.names.index("series_key")).to_pylist()
+            out.update(zip(t, k))
+        return out
+
+
+class SampleManager:
+    """Data-table persistence (ref: data/mod.rs:25-44, body from RFC)."""
+
+    def __init__(self, table: CloudObjectStorage, segment_ms: int):
+        self.table = table
+        self.segment_ms = segment_ms
+
+    async def persist(self, samples: list[Sample]) -> None:
+        by_seg: dict[int, list[Sample]] = {}
+        for s in samples:
+            ensure(s.series_id is not None, "populate_series_ids must run first")
+            seg = int(Timestamp(s.timestamp).truncate_by(self.segment_ms))
+            by_seg.setdefault(seg, []).append(s)
+        for seg, seg_samples in sorted(by_seg.items()):
+            lo = min(s.timestamp for s in seg_samples)
+            hi = max(s.timestamp for s in seg_samples)
+            batch = pa.record_batch(
+                [pa.array([s.name_id for s in seg_samples], type=pa.uint64()),
+                 pa.array([s.series_id for s in seg_samples], type=pa.uint64()),
+                 pa.array([field_id_of(s.field_name) for s in seg_samples],
+                          type=pa.uint64()),
+                 pa.array([s.timestamp for s in seg_samples], type=pa.int64()),
+                 pa.array([s.value for s in seg_samples], type=pa.float64())],
+                schema=self.table.schema().user_schema)
+            await self.table.write(WriteRequest(
+                batch, TimeRange.new(lo, hi + 1)))
+
+
+class MetricEngine:
+    """The user-facing metric API over five storage instances."""
+
+    def __init__(self, tables: dict[str, CloudObjectStorage], segment_ms: int):
+        self.tables = tables
+        self.segment_ms = segment_ms
+        self.metric_manager = MetricManager(tables["metrics"], segment_ms)
+        self.index_manager = IndexManager(tables["series"], tables["tags"],
+                                          tables["index"], segment_ms)
+        self.sample_manager = SampleManager(tables["data"], segment_ms)
+
+    @classmethod
+    async def open(cls, root_path: str, store: ObjectStore,
+                   segment_ms: int = 2 * 3600 * 1000,
+                   config: Optional[StorageConfig] = None) -> "MetricEngine":
+        tables = {}
+        for name, (schema, num_pks) in _TABLE_SCHEMAS.items():
+            tables[name] = await CloudObjectStorage.open(
+                f"{root_path}/{name}", segment_ms, store, schema, num_pks,
+                config or StorageConfig())
+        return cls(tables, segment_ms)
+
+    async def close(self) -> None:
+        for t in self.tables.values():
+            await t.close()
+
+    # ---- write ------------------------------------------------------------
+
+    async def write(self, samples: list[Sample]) -> None:
+        """The three-stage pipeline (ref: metric_engine README diagram)."""
+        if not samples:
+            return
+        await self.metric_manager.populate_metric_ids(samples)
+        await self.index_manager.populate_series_ids(samples)
+        await self.sample_manager.persist(samples)
+
+    # ---- read -------------------------------------------------------------
+
+    async def query(self, metric: str, filters: list[tuple[str, str]],
+                    time_range: TimeRange, field: str = "value") -> pa.Table:
+        """Raw samples of one field of a metric matching all label filters,
+        as an Arrow table (tsid, timestamp, value)."""
+        mid = await self.metric_manager.resolve(metric, time_range)
+        if mid is None:
+            return _empty_result()
+        tsids = await self.index_manager.find_tsids(mid, filters, time_range)
+        if tsids is not None and not tsids:
+            return _empty_result()
+        preds = [Eq("metric_id", mid),
+                 Eq("field_id", field_id_of(field)),
+                 TimeRangePred("timestamp", int(time_range.start),
+                               int(time_range.end))]
+        if tsids is not None:
+            preds.append(In("tsid", sorted(tsids)))
+        batches = await _collect(self.tables["data"].scan(ScanRequest(
+            range=time_range, predicate=And(preds))))
+        if not batches:
+            return _empty_result()
+        tbl = pa.Table.from_batches(batches)
+        return tbl.select(["tsid", "timestamp", "value"])
+
+    async def resolve_series(self, metric: str, tsids: list[int],
+                             time_range: TimeRange) -> dict[int, bytes]:
+        """tsid -> human-readable series key, via the series table."""
+        mid = await self.metric_manager.resolve(metric, time_range)
+        if mid is None:
+            return {}
+        return await self.index_manager.resolve_series_keys(
+            mid, tsids, time_range)
+
+    async def query_downsample(self, metric: str,
+                               filters: list[tuple[str, str]],
+                               time_range: TimeRange, bucket_ms: int,
+                               field: str = "value") -> dict:
+        """GROUP BY series, time(bucket) — the north-star query.  Returns
+        {tsid -> {agg -> list per bucket}} plus the bucket grid metadata."""
+        span = int(time_range.end) - int(time_range.start)
+        ensure(span < 2**31,
+               f"query window of {span}ms exceeds the int32 offset range "
+               "(~24.8 days); split the query into smaller windows")
+        tbl = await self.query(metric, filters, time_range, field=field)
+        n = tbl.num_rows
+        num_buckets = -(-(int(time_range.end) - int(time_range.start)) // bucket_ms)
+        if n == 0:
+            return {"tsids": [], "num_buckets": num_buckets, "aggs": {}}
+        tsid_np = tbl.column("tsid").to_numpy()
+        uniq_tsids, gid = np.unique(tsid_np, return_inverse=True)
+        ts_np = tbl.column("timestamp").to_numpy() - int(time_range.start)
+        val_np = tbl.column("value").to_numpy()
+        cap = 1 << max(7, (n - 1).bit_length())
+        pad = lambda a, d: np.pad(a.astype(d), (0, cap - n))
+        aggs = time_bucket_aggregate(
+            pad(ts_np, np.int32), pad(gid, np.int32), pad(val_np, np.float32),
+            n, bucket_ms, num_groups=len(uniq_tsids), num_buckets=num_buckets)
+        return {"tsids": [int(t) for t in uniq_tsids],
+                "num_buckets": num_buckets,
+                "aggs": {k: np.asarray(v) for k, v in aggs.items()}}
+
+    async def label_values(self, metric: str, tag_key: str,
+                           time_range: TimeRange) -> list[str]:
+        mid = await self.metric_manager.resolve(metric, time_range)
+        if mid is None:
+            return []
+        return await self.index_manager.label_values(mid, tag_key, time_range)

@@ -1,0 +1,331 @@
+"""Metric engine tests (the reference's managers are todo!(); scenarios
+come from RFC 20240827's example section: http_requests with
+url/code/job labels)."""
+
+import asyncio
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from horaedb_tpu.common.seahash import hash64
+from horaedb_tpu.metric_engine import (
+    Label,
+    MetricEngine,
+    Sample,
+    metric_id_of,
+    series_key_of,
+    tsid_of,
+)
+from horaedb_tpu.objstore import MemoryObjectStore
+from horaedb_tpu.storage.read import ScanRequest
+from horaedb_tpu.storage.types import TimeRange
+
+HOUR = 3_600_000
+T0 = 1_700_000_000_000
+
+
+def sample(name, labels, ts, value):
+    return Sample(name=name, labels=[Label(k, v) for k, v in labels],
+                  timestamp=ts, value=value)
+
+
+def http_samples():
+    return [
+        sample("http_requests", [("url", "/api/put"), ("code", "200"),
+                                 ("job", "proxy")], T0 + 1000, 100.0),
+        sample("http_requests", [("url", "/api/query"), ("code", "200"),
+                                 ("job", "proxy")], T0 + 2000, 10.0),
+        sample("http_requests", [("url", "/api/put"), ("code", "500"),
+                                 ("job", "proxy")], T0 + 3000, 1.0),
+        sample("grpc_requests", [("job", "proxy")], T0 + 1000, 7.0),
+    ]
+
+
+async def open_engine(store=None):
+    return await MetricEngine.open("metrics_db", store or MemoryObjectStore(),
+                                   segment_ms=2 * HOUR)
+
+
+class TestSeaHash:
+    def test_deterministic_and_distinct(self):
+        a = hash64(b"http_requests")
+        assert a == hash64(b"http_requests")
+        assert a != hash64(b"grpc_requests")
+        assert a != hash64(b"http_requests ")
+
+    def test_chunking_boundaries(self):
+        # exercise 8-byte lane and 32-byte block boundaries
+        seen = set()
+        for n in [0, 1, 7, 8, 9, 16, 31, 32, 33, 64, 100]:
+            h = hash64(bytes(range(n % 256))[:n] * 1)
+            seen.add(h)
+        assert len(seen) == 11  # no collisions among sizes
+
+    def test_ids(self):
+        s = http_samples()[0]
+        assert metric_id_of("http_requests") < 2**63
+        key = series_key_of(s.name, s.labels)
+        # sorted label order, metric-scoped
+        assert key == b"http_requests{code=200,job=proxy,url=/api/put}"
+        assert tsid_of(s.name, s.labels) == hash64(key) & (2**63 - 1)
+        # label order must not matter
+        assert tsid_of(s.name, list(reversed(s.labels))) == \
+            tsid_of(s.name, s.labels)
+
+
+class TestWriteQuery:
+    def test_write_then_query_with_filters(self):
+        async def go():
+            e = await open_engine()
+            try:
+                await e.write(http_samples())
+                rng = TimeRange.new(T0, T0 + HOUR)
+
+                tbl = await e.query("http_requests", [], rng)
+                assert tbl.num_rows == 3
+                assert sorted(tbl.column("value").to_pylist()) == [1.0, 10.0, 100.0]
+
+                tbl = await e.query("http_requests", [("code", "200")], rng)
+                assert sorted(tbl.column("value").to_pylist()) == [10.0, 100.0]
+
+                tbl = await e.query("http_requests",
+                                    [("code", "200"), ("url", "/api/put")], rng)
+                assert tbl.column("value").to_pylist() == [100.0]
+                assert tbl.column("tsid").to_pylist() == \
+                    [tsid_of("http_requests",
+                             [Label("url", "/api/put"), Label("code", "200"),
+                              Label("job", "proxy")])]
+
+                # no match
+                tbl = await e.query("http_requests", [("code", "404")], rng)
+                assert tbl.num_rows == 0
+                tbl = await e.query("nope", [], rng)
+                assert tbl.num_rows == 0
+            finally:
+                await e.close()
+
+        asyncio.run(go())
+
+    def test_same_series_overwrite_dedup(self):
+        """Same (series, ts) written twice: last write wins — the engine's
+        cross-file dedup reaches through the metric layer."""
+
+        async def go():
+            e = await open_engine()
+            try:
+                s1 = http_samples()[:1]
+                await e.write(s1)
+                s2 = [sample("http_requests",
+                             [("url", "/api/put"), ("code", "200"),
+                              ("job", "proxy")], T0 + 1000, 999.0)]
+                await e.write(s2)
+                tbl = await e.query("http_requests", [("url", "/api/put")],
+                                    TimeRange.new(T0, T0 + HOUR))
+                vals = tbl.column("value").to_pylist()
+                assert vals == [999.0]
+            finally:
+                await e.close()
+
+        asyncio.run(go())
+
+    def test_label_values(self):
+        async def go():
+            e = await open_engine()
+            try:
+                await e.write(http_samples())
+                rng = TimeRange.new(T0, T0 + HOUR)
+                assert await e.label_values("http_requests", "url", rng) == \
+                    ["/api/put", "/api/query"]
+                assert await e.label_values("http_requests", "code", rng) == \
+                    ["200", "500"]
+                assert await e.label_values("http_requests", "nope", rng) == []
+            finally:
+                await e.close()
+
+        asyncio.run(go())
+
+    def test_time_range_filtering(self):
+        async def go():
+            e = await open_engine()
+            try:
+                await e.write(http_samples())
+                tbl = await e.query("http_requests", [],
+                                    TimeRange.new(T0 + 1500, T0 + 2500))
+                assert tbl.column("value").to_pylist() == [10.0]
+            finally:
+                await e.close()
+
+        asyncio.run(go())
+
+    def test_multi_segment_series_reregistration(self):
+        """A series active in two segments must be indexed in both (the
+        RFC's Date-scoped index via segment duration)."""
+
+        async def go():
+            e = await open_engine()
+            try:
+                labels = [("host", "web-1")]
+                await e.write([sample("cpu", labels, T0 + 1000, 1.0)])
+                t_next = T0 + 2 * HOUR + 1000  # next segment
+                await e.write([sample("cpu", labels, t_next, 2.0)])
+                # query restricted to the SECOND segment still finds the series
+                tbl = await e.query("cpu", [("host", "web-1")],
+                                    TimeRange.new(T0 + 2 * HOUR, T0 + 4 * HOUR))
+                assert tbl.column("value").to_pylist() == [2.0]
+                # and a spanning query finds both points
+                tbl = await e.query("cpu", [("host", "web-1")],
+                                    TimeRange.new(T0, T0 + 4 * HOUR))
+                assert sorted(tbl.column("value").to_pylist()) == [1.0, 2.0]
+            finally:
+                await e.close()
+
+        asyncio.run(go())
+
+    def test_query_downsample(self):
+        async def go():
+            e = await open_engine()
+            try:
+                samples = []
+                for host, base in [("web-1", 10.0), ("web-2", 50.0)]:
+                    for i in range(10):
+                        samples.append(sample(
+                            "cpu", [("host", host)],
+                            T0 + i * 60_000, base + i))
+                await e.write(samples)
+                out = await e.query_downsample(
+                    "cpu", [], TimeRange.new(T0, T0 + 600_000),
+                    bucket_ms=300_000)
+                assert out["num_buckets"] == 2
+                assert len(out["tsids"]) == 2
+                aggs = out["aggs"]
+                # each series: buckets of 5 points each
+                np.testing.assert_array_equal(aggs["count"],
+                                              [[5, 5], [5, 5]])
+                by_tsid = dict(zip(out["tsids"], aggs["sum"]))
+                web1 = tsid_of("cpu", [Label("host", "web-1")])
+                web2 = tsid_of("cpu", [Label("host", "web-2")])
+                assert by_tsid[web1].tolist() == [60.0, 85.0]   # 10..14, 15..19
+                assert by_tsid[web2].tolist() == [260.0, 285.0]
+            finally:
+                await e.close()
+
+        asyncio.run(go())
+
+    def test_persistence_across_reopen(self):
+        async def go():
+            store = MemoryObjectStore()
+            e = await open_engine(store)
+            await e.write(http_samples())
+            await e.close()
+
+            e2 = await MetricEngine.open("metrics_db", store,
+                                         segment_ms=2 * HOUR)
+            try:
+                rng = TimeRange.new(T0, T0 + HOUR)
+                tbl = await e2.query("http_requests", [("job", "proxy")], rng)
+                assert tbl.num_rows == 3
+                assert await e2.label_values("http_requests", "code", rng) == \
+                    ["200", "500"]
+            finally:
+                await e2.close()
+
+        asyncio.run(go())
+
+
+class TestReviewRegressions:
+    def test_distinct_fields_do_not_collide(self):
+        async def go():
+            e = await open_engine()
+            try:
+                labels = [("host", "a")]
+                await e.write([
+                    Sample("mem", [Label("host", "a")], T0 + 1000, 1.0,
+                           field_name="used"),
+                    Sample("mem", [Label("host", "a")], T0 + 1000, 2.0,
+                           field_name="free"),
+                ])
+                rng = TimeRange.new(T0, T0 + HOUR)
+                used = await e.query("mem", labels, rng, field="used")
+                free = await e.query("mem", labels, rng, field="free")
+                assert used.column("value").to_pylist() == [1.0]
+                assert free.column("value").to_pylist() == [2.0]
+            finally:
+                await e.close()
+
+        asyncio.run(go())
+
+    def test_failed_registration_retried(self):
+        """A failed index write must not poison the seen-cache."""
+
+        async def go():
+            e = await open_engine()
+            try:
+                s = [sample("cpu", [("host", "x")], T0 + 1000, 1.0)]
+                # sabotage the index table write once
+                orig = e.index_manager.index.write
+                calls = {"n": 0}
+
+                async def flaky(req):
+                    calls["n"] += 1
+                    if calls["n"] == 1:
+                        raise RuntimeError("transient store error")
+                    return await orig(req)
+
+                e.index_manager.index.write = flaky
+                with pytest.raises(RuntimeError):
+                    await e.write(s)
+                # retry succeeds and the series becomes queryable
+                await e.write(s)
+                tbl = await e.query("cpu", [("host", "x")],
+                                    TimeRange.new(T0, T0 + HOUR))
+                assert tbl.column("value").to_pylist() == [1.0]
+            finally:
+                await e.close()
+
+        asyncio.run(go())
+
+    def test_downsample_window_span_guarded(self):
+        async def go():
+            e = await open_engine()
+            try:
+                from horaedb_tpu.common import Error
+                with pytest.raises(Error, match="24.8 days"):
+                    await e.query_downsample(
+                        "cpu", [], TimeRange.new(0, 40 * 24 * 3600 * 1000),
+                        bucket_ms=3_600_000)
+            finally:
+                await e.close()
+
+        asyncio.run(go())
+
+    def test_resolve_series(self):
+        async def go():
+            e = await open_engine()
+            try:
+                await e.write(http_samples())
+                rng = TimeRange.new(T0, T0 + HOUR)
+                tbl = await e.query("http_requests", [("code", "500")], rng)
+                tsid = tbl.column("tsid")[0].as_py()
+                keys = await e.resolve_series("http_requests", [tsid], rng)
+                assert keys[tsid] == \
+                    b"http_requests{code=500,job=proxy,url=/api/put}"
+            finally:
+                await e.close()
+
+        asyncio.run(go())
+
+    def test_seen_cache_bounded(self):
+        async def go():
+            e = await open_engine()
+            try:
+                # write into 8 distinct segments; cache keeps only newest 4
+                for i in range(8):
+                    await e.write([sample("cpu", [("h", "x")],
+                                          T0 + i * 2 * HOUR, float(i))])
+                segs = e.index_manager._seen._by_segment
+                assert len(segs) <= 4
+            finally:
+                await e.close()
+
+        asyncio.run(go())
