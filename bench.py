@@ -1,0 +1,133 @@
+#!/usr/bin/env python
+"""Driver benchmark: BASELINE config #1 — single-table
+`avg(value) GROUP BY time(1m)` over 10M rows, 1 tag.
+
+Measures the TPU scan-compute path (device-resident columns -> compiled
+filter+downsample program) against the CPU baseline (numpy bincount
+aggregation of the same query — our stand-in for the reference's CPU
+analytic path, since the reference publishes no numbers; BASELINE.md).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": <tpu p50 ms>, "unit": "ms",
+   "vs_baseline": <tpu_p50 / cpu_p50>}   (lower is better; north star
+   for the full path is <= 0.5)
+
+Env knobs: BENCH_ROWS (default 10_000_000), BENCH_ITERS (default 20).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def cpu_baseline(ts_off, gid, vals, bucket_ms, num_groups, num_buckets, iters):
+    """numpy: avg per (group, minute-bucket) via bincount."""
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        bucket = ts_off // bucket_ms
+        cell = gid.astype(np.int64) * num_buckets + bucket
+        sums = np.bincount(cell, weights=vals, minlength=num_groups * num_buckets)
+        counts = np.bincount(cell, minlength=num_groups * num_buckets)
+        with np.errstate(invalid="ignore"):
+            avg = sums / counts
+        avg.sum()  # force materialization
+        times.append(time.perf_counter() - t0)
+    return float(np.percentile(times, 50))
+
+
+def main() -> None:
+    rows = int(os.environ.get("BENCH_ROWS", 10_000_000))
+    iters = int(os.environ.get("BENCH_ITERS", 20))
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from horaedb_tpu.bench.tsbs import TsbsConfig, generate_cpu_arrays
+
+    # 100 hosts, 1 field, span sized to produce `rows` points
+    interval = 10_000
+    num_hosts = 100
+    span = (rows // num_hosts) * interval
+    cfg = TsbsConfig(num_hosts=num_hosts, num_fields=1, interval_ms=interval,
+                     span_ms=span)
+    t0 = time.perf_counter()
+    cols = generate_cpu_arrays(cfg)
+    n = len(cols["ts"])
+    bucket_ms = 60_000
+    num_buckets = -(-span // bucket_ms)
+    ts_off = (cols["ts"] - cfg.start_ms).astype(np.int64)
+    gid = cols["host_id"]
+    vals = cols["usage_user"].astype(np.float32)
+    log(f"generated {n:,} rows in {time.perf_counter()-t0:.1f}s; "
+        f"{num_hosts} hosts x {num_buckets} buckets")
+
+    # ---- CPU baseline ------------------------------------------------------
+    cpu_p50 = cpu_baseline(ts_off, gid, vals.astype(np.float64), bucket_ms,
+                           num_hosts, num_buckets, max(3, iters // 4))
+    log(f"cpu baseline p50: {cpu_p50*1e3:.2f} ms "
+        f"({n/cpu_p50/1e6:.0f}M rows/s)")
+
+    # ---- TPU path ----------------------------------------------------------
+    import jax
+    import jax.numpy as jnp
+
+    from horaedb_tpu.ops.downsample import time_bucket_aggregate
+
+    dev = jax.devices()[0]
+    log(f"device: {dev} ({dev.platform})")
+
+    ensure_fits = ts_off.max()
+    assert ensure_fits < 2**31, "ts offsets must fit int32"
+    cap = 1 << (n - 1).bit_length()
+    pad = lambda a, d: np.pad(a.astype(d), (0, cap - n))
+    d_ts = jax.device_put(pad(ts_off, np.int32), dev)
+    d_gid = jax.device_put(pad(gid, np.int32), dev)
+    d_vals = jax.device_put(pad(vals, np.float32), dev)
+
+    t0 = time.perf_counter()
+    out = time_bucket_aggregate(d_ts, d_gid, d_vals, n, bucket_ms,
+                                num_groups=num_hosts, num_buckets=num_buckets)
+    jax.block_until_ready(out["avg"])
+    log(f"compile+first run: {time.perf_counter()-t0:.1f}s")
+
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = time_bucket_aggregate(d_ts, d_gid, d_vals, n, bucket_ms,
+                                    num_groups=num_hosts,
+                                    num_buckets=num_buckets)
+        jax.block_until_ready(out["avg"])
+        times.append(time.perf_counter() - t0)
+    tpu_p50 = float(np.percentile(times, 50))
+    log(f"device p50: {tpu_p50*1e3:.2f} ms ({n/tpu_p50/1e6:.0f}M rows/s/chip)")
+
+    # sanity: the timed kernel's counts AND averages must match numpy
+    bucket = ts_off // bucket_ms
+    cell = gid.astype(np.int64) * num_buckets + bucket
+    counts = np.bincount(cell, minlength=num_hosts * num_buckets)
+    sums = np.bincount(cell, weights=vals.astype(np.float64),
+                       minlength=num_hosts * num_buckets)
+    assert int(np.asarray(out["count"]).sum()) == n
+    np.testing.assert_array_equal(
+        np.asarray(out["count"]).reshape(-1), counts)
+    occupied = counts > 0
+    np.testing.assert_allclose(
+        np.asarray(out["avg"], dtype=np.float64).reshape(-1)[occupied],
+        (sums / np.maximum(counts, 1))[occupied], rtol=2e-4)
+
+    print(json.dumps({
+        "metric": f"single-table avg GROUP BY time(1m), {n/1e6:.0f}M rows, p50",
+        "value": round(tpu_p50 * 1e3, 3),
+        "unit": "ms",
+        "vs_baseline": round(tpu_p50 / cpu_p50, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

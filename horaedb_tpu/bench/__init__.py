@@ -1,0 +1,3 @@
+"""Benchmark suite: TSBS-style data generation + the 5 BASELINE configs
+(ref: src/benchmarks is a criterion harness without recorded results;
+BASELINE.md defines the workloads we must stand up)."""

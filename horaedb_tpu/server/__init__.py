@@ -1,0 +1,5 @@
+"""HTTP server + config (ref: src/server)."""
+
+from horaedb_tpu.server.config import ServerConfig, load_config
+
+__all__ = ["ServerConfig", "load_config"]

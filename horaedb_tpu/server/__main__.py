@@ -1,0 +1,3 @@
+from horaedb_tpu.server.main import main
+
+main()

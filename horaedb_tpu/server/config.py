@@ -1,0 +1,112 @@
+"""Server configuration (ref: src/server/src/config.rs:21-175).
+
+Same layered-TOML shape: port, test write-load generator knobs, and the
+metric-engine section holding the object-store choice plus the
+TimeMergeStorage config.  S3 config keys parse (the reference defines
+them fully, config.rs:82-160) but, like the reference (main.rs:112),
+selecting S3 is rejected at startup.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from horaedb_tpu.common import Error, ReadableDuration, ensure
+from horaedb_tpu.storage.config import StorageConfig, _check_scalar
+from horaedb_tpu.storage.config import from_dict as storage_from_dict
+
+
+@dataclass
+class TestConfig:
+    """Write-load generator (ref: config.rs:48-57)."""
+
+    enable_write: bool = False
+    write_worker_num: int = 1
+    write_interval: ReadableDuration = field(
+        default_factory=lambda: ReadableDuration.from_millis(500))
+
+
+@dataclass
+class S3Config:
+    """Parsed for compatibility; unsupported at runtime like the
+    reference (main.rs:112)."""
+
+    region: str = ""
+    key_id: str = ""
+    key_secret: str = ""
+    endpoint: str = ""
+    bucket: str = ""
+
+
+@dataclass
+class ObjectStoreConfig:
+    kind: str = "Local"  # "Local" | "S3Like"
+    data_dir: str = "/tmp/horaedb-tpu"
+    s3: Optional[S3Config] = None
+
+
+@dataclass
+class MetricEngineConfig:
+    segment_duration: ReadableDuration = field(
+        default_factory=lambda: ReadableDuration.parse("2h"))
+    object_store: ObjectStoreConfig = field(default_factory=ObjectStoreConfig)
+    time_merge_storage: StorageConfig = field(default_factory=StorageConfig)
+
+
+@dataclass
+class ServerConfig:
+    port: int = 5000
+    test: TestConfig = field(default_factory=TestConfig)
+    metric_engine: MetricEngineConfig = field(default_factory=MetricEngineConfig)
+
+
+def _dc_from_dict(cls: type, data: dict[str, Any]) -> Any:
+    names = {f.name: f for f in dataclasses.fields(cls)}
+    unknown = set(data) - set(names)
+    if unknown:
+        raise Error(f"unknown config keys for {cls.__name__}: {sorted(unknown)}")
+    kwargs: dict[str, Any] = {}
+    for key, value in data.items():
+        where = f"{cls.__name__}.{key}"
+        if key in ("write_interval", "segment_duration"):
+            if not isinstance(value, ReadableDuration):
+                ensure(isinstance(value, str),
+                       f'{where} expects a duration string like "2h"')
+                value = ReadableDuration.parse(value)
+            kwargs[key] = value
+        elif key == "test":
+            ensure(isinstance(value, dict), f"{where} expects a config table")
+            kwargs[key] = _dc_from_dict(TestConfig, value)
+        elif key == "metric_engine":
+            ensure(isinstance(value, dict), f"{where} expects a config table")
+            kwargs[key] = _dc_from_dict(MetricEngineConfig, value)
+        elif key == "object_store":
+            ensure(isinstance(value, dict), f"{where} expects a config table")
+            kwargs[key] = _dc_from_dict(ObjectStoreConfig, value)
+        elif key == "s3":
+            ensure(isinstance(value, dict), f"{where} expects a config table")
+            kwargs[key] = _dc_from_dict(S3Config, value)
+        elif key == "time_merge_storage":
+            ensure(isinstance(value, dict), f"{where} expects a config table")
+            kwargs[key] = storage_from_dict(StorageConfig, value)
+        else:
+            # scalar fields: validate against the declared type at load time
+            kwargs[key] = _check_scalar(cls, names[key], value, where)
+    return cls(**kwargs)
+
+
+def load_config(path: Optional[str] = None) -> ServerConfig:
+    if path is None:
+        return ServerConfig()
+    import tomllib
+
+    with open(path, "rb") as f:
+        data = tomllib.load(f)
+    cfg = _dc_from_dict(ServerConfig, data)
+    if cfg.metric_engine.object_store.kind not in ("Local",):
+        # parity with the reference: S3 parses but is not supported yet
+        raise Error(
+            f"object store {cfg.metric_engine.object_store.kind!r} not supported yet")
+    return cfg

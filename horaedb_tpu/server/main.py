@@ -1,0 +1,219 @@
+"""HTTP server (ref: src/server/src/main.rs).
+
+Endpoints (reference parity + the query surface the reference lacks —
+main.rs:59-80 notes "No query/read endpoint exists yet"):
+
+  GET  /         hello
+  GET  /toggle   pause/resume the test write-load generator
+  GET  /compact  trigger compaction on every table
+  GET  /metrics  Prometheus text metrics
+  POST /write    JSON samples: {"samples": [{"name", "labels": {k:v},
+                 "timestamp", "value"}]}
+  POST /query    JSON: {"metric", "filters": {k:v}, "start", "end",
+                 optional "bucket_ms" -> downsample grid}
+  GET  /label_values?metric=...&key=...&start=...&end=...
+
+Run: python -m horaedb_tpu.server --config docs/example.toml
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import math
+import random
+from typing import Optional
+
+from aiohttp import web
+
+from horaedb_tpu.common import Error, now_ms
+from horaedb_tpu.metric_engine import Label, MetricEngine, Sample
+from horaedb_tpu.objstore import LocalObjectStore
+from horaedb_tpu.server.config import ServerConfig, load_config
+from horaedb_tpu.storage.types import TimeRange
+from horaedb_tpu.utils import registry
+
+logger = logging.getLogger(__name__)
+
+
+class ServerState:
+    def __init__(self, engine: MetricEngine, config: ServerConfig):
+        self.engine = engine
+        self.config = config
+        self.write_enabled = True
+        self._generator_tasks: list[asyncio.Task] = []
+
+    # ---- write-load generator (ref: main.rs:187-233) ----------------------
+
+    def start_generators(self) -> None:
+        for worker in range(self.config.test.write_worker_num):
+            self._generator_tasks.append(
+                asyncio.create_task(self._generate_load(worker),
+                                    name=f"write-gen-{worker}"))
+
+    async def stop_generators(self) -> None:
+        for t in self._generator_tasks:
+            t.cancel()
+        for t in self._generator_tasks:
+            try:
+                await t
+            except asyncio.CancelledError:
+                pass
+        self._generator_tasks = []
+
+    async def _generate_load(self, worker: int) -> None:
+        interval = self.config.test.write_interval.seconds
+        rng = random.Random(worker)
+        while True:
+            await asyncio.sleep(interval)
+            if not self.write_enabled:
+                continue
+            now = now_ms()
+            samples = [
+                Sample(name=f"bench.metric{worker}",
+                       labels=[Label("host", f"host-{rng.randrange(100):03d}")],
+                       timestamp=now + i % 1000, value=rng.random())
+                for i in range(1000)
+            ]
+            try:
+                await self.engine.write(samples)
+            except Exception:
+                logger.exception("write-load generator failed")
+
+
+def build_app(state: ServerState) -> web.Application:
+    routes = web.RouteTableDef()
+
+    @routes.get("/")
+    async def hello(_req: web.Request) -> web.Response:
+        return web.Response(text="Hello, horaedb-tpu!")
+
+    @routes.get("/toggle")
+    async def toggle(_req: web.Request) -> web.Response:
+        state.write_enabled = not state.write_enabled
+        return web.Response(text=f"write_enabled={state.write_enabled}")
+
+    @routes.get("/compact")
+    async def compact(_req: web.Request) -> web.Response:
+        for table in state.engine.tables.values():
+            await table.compact()
+        return web.Response(text="compaction triggered")
+
+    @routes.get("/metrics")
+    async def metrics(_req: web.Request) -> web.Response:
+        return web.Response(text=registry.render(),
+                            content_type="text/plain")
+
+    @routes.post("/write")
+    async def write(req: web.Request) -> web.Response:
+        try:
+            body = await req.json()
+            samples = [
+                Sample(name=s["name"],
+                       labels=[Label(k, str(v))
+                               for k, v in sorted(s.get("labels", {}).items())],
+                       timestamp=int(s["timestamp"]), value=float(s["value"]),
+                       field_name=s.get("field", "value"))
+                for s in body["samples"]
+            ]
+        except (KeyError, TypeError, ValueError) as e:
+            return web.json_response({"error": f"bad request: {e}"}, status=400)
+        try:
+            await state.engine.write(samples)
+        except Error as e:
+            return web.json_response({"error": str(e)}, status=400)
+        return web.json_response({"written": len(samples)})
+
+    @routes.post("/query")
+    async def query(req: web.Request) -> web.Response:
+        try:
+            body = await req.json()
+            metric = body["metric"]
+            filters = sorted(body.get("filters", {}).items())
+            rng = TimeRange.new(int(body["start"]), int(body["end"]))
+            bucket_ms = body.get("bucket_ms")
+            field = body.get("field", "value")
+        except (KeyError, TypeError, ValueError) as e:
+            return web.json_response({"error": f"bad request: {e}"}, status=400)
+        try:
+            if bucket_ms:
+                out = await state.engine.query_downsample(
+                    metric, filters, rng, int(bucket_ms), field=field)
+                aggs = {k: _grid_json(v) for k, v in out["aggs"].items()}
+                return web.json_response({
+                    "tsids": [str(t) for t in out["tsids"]],
+                    "num_buckets": out["num_buckets"], "aggs": aggs})
+            tbl = await state.engine.query(metric, filters, rng, field=field)
+            return web.json_response({
+                "tsids": [str(t) for t in tbl.column("tsid").to_pylist()],
+                "timestamps": tbl.column("timestamp").to_pylist(),
+                "values": tbl.column("value").to_pylist()})
+        except Error as e:
+            return web.json_response({"error": str(e)}, status=400)
+
+    @routes.get("/label_values")
+    async def label_values(req: web.Request) -> web.Response:
+        try:
+            metric = req.query["metric"]
+            key = req.query["key"]
+            rng = TimeRange.new(int(req.query["start"]), int(req.query["end"]))
+        except (KeyError, ValueError) as e:
+            return web.json_response({"error": f"bad request: {e}"}, status=400)
+        vals = await state.engine.label_values(metric, key, rng)
+        return web.json_response({"values": vals})
+
+    app = web.Application()
+    app.add_routes(routes)
+    return app
+
+
+def _grid_json(grid) -> list:
+    out = []
+    for row in grid.tolist():
+        out.append([None if isinstance(x, float) and math.isnan(x) else x
+                    for x in row])
+    return out
+
+
+async def run_server(config: ServerConfig,
+                     ready: Optional[asyncio.Event] = None) -> None:
+    store = LocalObjectStore(config.metric_engine.object_store.data_dir)
+    engine = await MetricEngine.open(
+        "metrics", store,
+        segment_ms=config.metric_engine.segment_duration.millis,
+        config=config.metric_engine.time_merge_storage)
+    state = ServerState(engine, config)
+    if config.test.enable_write:
+        state.start_generators()
+
+    app = build_app(state)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", config.port)
+    await site.start()
+    logger.info("listening on 127.0.0.1:%d", config.port)
+    if ready is not None:
+        ready.set()
+    try:
+        while True:
+            await asyncio.sleep(3600)
+    finally:
+        await state.stop_generators()
+        await runner.cleanup()
+        await engine.close()
+
+
+def main() -> None:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s:%(lineno)d %(message)s")
+    parser = argparse.ArgumentParser("horaedb-tpu-server")
+    parser.add_argument("--config", default=None, help="TOML config path")
+    args = parser.parse_args()
+    config = load_config(args.config)
+    asyncio.run(run_server(config))
+
+
+if __name__ == "__main__":
+    main()

@@ -42,7 +42,14 @@ from horaedb_tpu.storage.types import (
 if TYPE_CHECKING:
     from horaedb_tpu.storage.storage import CloudObjectStorage
 
+from horaedb_tpu.utils import registry
+
 logger = logging.getLogger(__name__)
+
+_COMPACTIONS = registry.counter(
+    "compaction_completed_total", "compaction tasks completed")
+_COMPACTION_ROWS = registry.counter(
+    "compaction_rows_rewritten_total", "rows rewritten by compaction")
 
 
 @dataclass
@@ -210,6 +217,9 @@ class Executor:
         to_deletes = [f.id for f in task.expireds] + [f.id for f in task.inputs]
         await storage.manifest.update(ManifestUpdate(
             to_adds=[SstFile(file_id, meta)], to_deletes=to_deletes))
+
+        _COMPACTIONS.inc()
+        _COMPACTION_ROWS.inc(num_rows)
 
         # From here on, errors must not propagate (manifest already updated).
         results = await asyncio.gather(

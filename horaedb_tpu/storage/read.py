@@ -25,6 +25,7 @@ tests, the analogue of the reference's DisplayableExecutionPlan test
 from __future__ import annotations
 
 import asyncio
+import time
 from dataclasses import dataclass
 from typing import AsyncIterator, Optional
 
@@ -46,6 +47,12 @@ from horaedb_tpu.storage.types import (
     TimeRange,
 )
 from horaedb_tpu.storage import parquet_io
+from horaedb_tpu.utils import registry
+
+_SCAN_LATENCY = registry.histogram(
+    "storage_scan_seconds", "merge-scan latency per segment")
+_ROWS_SCANNED = registry.counter(
+    "storage_rows_scanned_total", "rows produced by merge-scan")
 
 
 @dataclass
@@ -117,8 +124,11 @@ class ParquetReader:
 
     async def execute(self, plan: ScanPlan) -> AsyncIterator[pa.RecordBatch]:
         for seg in plan.segments:
+            t0 = time.perf_counter()
             batch = await self._execute_segment(seg, plan)
+            _SCAN_LATENCY.observe(time.perf_counter() - t0)
             if batch is not None and batch.num_rows:
+                _ROWS_SCANNED.inc(batch.num_rows)
                 yield batch
 
     async def _read_segment_table(self, seg: SegmentPlan) -> pa.Table:

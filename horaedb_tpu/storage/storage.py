@@ -16,6 +16,7 @@ reference (storage.rs:125-135):
 from __future__ import annotations
 
 import abc
+import time
 from dataclasses import dataclass
 from typing import AsyncIterator, Optional
 
@@ -34,6 +35,12 @@ from horaedb_tpu.storage.types import (
     TimeRange,
     Timestamp,
 )
+from horaedb_tpu.utils import registry
+
+_WRITE_LATENCY = registry.histogram(
+    "storage_write_seconds", "write path latency")
+_ROWS_WRITTEN = registry.counter(
+    "storage_rows_written_total", "rows written")
 
 
 @dataclass
@@ -136,6 +143,7 @@ class CloudObjectStorage(TimeMergeStorage):
         return await self._write_batch(req)
 
     async def _write_batch(self, req: WriteRequest) -> WriteResult:
+        t0 = time.perf_counter()
         file_id = SstFile.allocate_id()
         sorted_batch = self._sort_batch(req.batch)
         stamped = self._schema.fill_builtin_columns(sorted_batch, sequence=file_id)
@@ -145,6 +153,8 @@ class CloudObjectStorage(TimeMergeStorage):
         meta = FileMeta(max_sequence=file_id, num_rows=req.batch.num_rows,
                         size=size, time_range=req.time_range)
         await self.manifest.add_file(file_id, meta)
+        _WRITE_LATENCY.observe(time.perf_counter() - t0)
+        _ROWS_WRITTEN.inc(req.batch.num_rows)
         return WriteResult(id=file_id, seq=file_id, size=size)
 
     async def scan(self, req: ScanRequest) -> AsyncIterator[pa.RecordBatch]:

@@ -1,0 +1,5 @@
+"""Cross-cutting utilities: observability registry."""
+
+from horaedb_tpu.utils.metrics import Counter, Histogram, MetricsRegistry, registry
+
+__all__ = ["Counter", "Histogram", "MetricsRegistry", "registry"]

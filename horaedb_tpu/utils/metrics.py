@@ -1,0 +1,131 @@
+"""Minimal counters/histograms registry.
+
+The reference has logging only (SURVEY.md section 5: "Our build should
+add a minimal counters/histograms registry from day one since the
+north-star metric is a latency").  Exposed by the server at /metrics in
+Prometheus text format.
+"""
+
+from __future__ import annotations
+
+import bisect
+import random
+import threading
+from typing import Optional
+
+_DEFAULT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                    0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+class Counter:
+    __slots__ = ("name", "help", "_value", "_lock")
+
+    def __init__(self, name: str, help_: str = ""):
+        self.name = name
+        self.help = help_
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def render(self) -> str:
+        return (f"# HELP {self.name} {self.help}\n"
+                f"# TYPE {self.name} counter\n"
+                f"{self.name} {self._value}\n")
+
+
+_RESERVOIR_SIZE = 4096
+
+
+class Histogram:
+    __slots__ = ("name", "help", "buckets", "_counts", "_sum", "_count",
+                 "_lock", "_samples", "_rng")
+
+    def __init__(self, name: str, help_: str = "",
+                 buckets: tuple = _DEFAULT_BUCKETS):
+        self.name = name
+        self.help = help_
+        self.buckets = buckets
+        self._counts = [0] * (len(buckets) + 1)
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+        # true reservoir sample (Vitter's algorithm R): every observation
+        # has equal probability of being in the quantile sample, so
+        # quantiles track steady state, not start-up
+        self._samples: list[float] = []
+        self._rng = random.Random(0x5EA)
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            idx = bisect.bisect_left(self.buckets, value)
+            self._counts[idx] += 1
+            self._sum += value
+            self._count += 1
+            if len(self._samples) < _RESERVOIR_SIZE:
+                self._samples.append(value)
+            else:
+                j = self._rng.randrange(self._count)
+                if j < _RESERVOIR_SIZE:
+                    self._samples[j] = value
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    def quantile(self, q: float) -> Optional[float]:
+        with self._lock:
+            if not self._samples:
+                return None
+            s = sorted(self._samples)
+            return s[min(len(s) - 1, int(q * len(s)))]
+
+    def render(self) -> str:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} histogram"]
+        acc = 0
+        for b, c in zip(self.buckets, self._counts):
+            acc += c
+            out.append(f'{self.name}_bucket{{le="{b}"}} {acc}')
+        out.append(f'{self.name}_bucket{{le="+Inf"}} {self._count}')
+        out.append(f"{self.name}_sum {self._sum}")
+        out.append(f"{self.name}_count {self._count}")
+        return "\n".join(out) + "\n"
+
+
+class MetricsRegistry:
+    def __init__(self) -> None:
+        self._metrics: dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = Counter(name, help_)
+                self._metrics[name] = m
+            assert isinstance(m, Counter)
+            return m
+
+    def histogram(self, name: str, help_: str = "",
+                  buckets: tuple = _DEFAULT_BUCKETS) -> Histogram:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = Histogram(name, help_, buckets)
+                self._metrics[name] = m
+            assert isinstance(m, Histogram)
+            return m
+
+    def render(self) -> str:
+        with self._lock:
+            return "".join(m.render() for m in self._metrics.values())
+
+
+registry = MetricsRegistry()

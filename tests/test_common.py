@@ -85,3 +85,29 @@ class TestReadableSize:
         assert ReadableSize.gb(2).bytes == 2 * 1024**3
         assert ReadableSize.mb(3).bytes == 3 * 1024**2
         assert ReadableSize.kb(5).bytes == 5 * 1024
+
+
+class TestMetricsRegistry:
+    def test_counter_and_histogram(self):
+        from horaedb_tpu.utils.metrics import MetricsRegistry
+        reg = MetricsRegistry()
+        c = reg.counter("reqs_total", "requests")
+        c.inc(); c.inc(2)
+        assert c.value == 3
+        h = reg.histogram("lat_seconds", "latency")
+        for v in [0.001, 0.002, 0.004, 0.1]:
+            h.observe(v)
+        assert h.count == 4
+        assert 0.001 <= h.quantile(0.5) <= 0.004
+        text = reg.render()
+        assert "reqs_total 3" in text and "lat_seconds_count 4" in text
+
+    def test_histogram_reservoir_tracks_steady_state(self):
+        from horaedb_tpu.utils.metrics import Histogram
+        h = Histogram("x")
+        for _ in range(5000):
+            h.observe(0.001)  # warm-up era
+        for _ in range(50000):
+            h.observe(1.0)    # steady state is much slower
+        # a frozen first-N sample would report ~0.001 forever
+        assert h.quantile(0.5) == 1.0

@@ -1,0 +1,155 @@
+"""HTTP server tests (ref: src/server endpoints + our query surface)."""
+
+import asyncio
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from horaedb_tpu.metric_engine import MetricEngine
+from horaedb_tpu.objstore import MemoryObjectStore
+from horaedb_tpu.server.config import ServerConfig, load_config
+from horaedb_tpu.server.main import ServerState, build_app
+from horaedb_tpu.common import Error
+
+T0 = 1_700_000_000_000
+HOUR = 3_600_000
+
+
+async def make_client():
+    engine = await MetricEngine.open("m", MemoryObjectStore(),
+                                     segment_ms=2 * HOUR)
+    state = ServerState(engine, ServerConfig())
+    client = TestClient(TestServer(build_app(state)))
+    await client.start_server()
+    return client, state, engine
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class TestEndpoints:
+    def test_hello_toggle_compact_metrics(self):
+        async def go():
+            client, state, engine = await make_client()
+            try:
+                r = await client.get("/")
+                assert r.status == 200 and "horaedb-tpu" in await r.text()
+                r = await client.get("/toggle")
+                assert "write_enabled=False" in await r.text()
+                assert state.write_enabled is False
+                r = await client.get("/compact")
+                assert r.status == 200
+                r = await client.get("/metrics")
+                assert r.status == 200
+            finally:
+                await client.close()
+                await engine.close()
+
+        run(go())
+
+    def test_write_then_query_roundtrip(self):
+        async def go():
+            client, _state, engine = await make_client()
+            try:
+                samples = [
+                    {"name": "cpu", "labels": {"host": "a"},
+                     "timestamp": T0 + i * 60_000, "value": float(i)}
+                    for i in range(5)
+                ] + [
+                    {"name": "cpu", "labels": {"host": "b"},
+                     "timestamp": T0, "value": 99.0}
+                ]
+                r = await client.post("/write", json={"samples": samples})
+                assert r.status == 200 and (await r.json())["written"] == 6
+
+                r = await client.post("/query", json={
+                    "metric": "cpu", "filters": {"host": "a"},
+                    "start": T0, "end": T0 + HOUR})
+                body = await r.json()
+                assert r.status == 200
+                assert body["values"] == [0.0, 1.0, 2.0, 3.0, 4.0]
+
+                r = await client.get("/label_values", params={
+                    "metric": "cpu", "key": "host",
+                    "start": str(T0), "end": str(T0 + HOUR)})
+                assert (await r.json())["values"] == ["a", "b"]
+            finally:
+                await client.close()
+                await engine.close()
+
+        run(go())
+
+    def test_downsample_query(self):
+        async def go():
+            client, _state, engine = await make_client()
+            try:
+                samples = [
+                    {"name": "cpu", "labels": {"host": "a"},
+                     "timestamp": T0 + i * 60_000, "value": float(i)}
+                    for i in range(10)
+                ]
+                await client.post("/write", json={"samples": samples})
+                r = await client.post("/query", json={
+                    "metric": "cpu", "filters": {},
+                    "start": T0, "end": T0 + 600_000,
+                    "bucket_ms": 300_000})
+                body = await r.json()
+                assert body["num_buckets"] == 2
+                assert body["aggs"]["count"] == [[5.0, 5.0]]
+                assert body["aggs"]["avg"] == [[2.0, 7.0]]
+            finally:
+                await client.close()
+                await engine.close()
+
+        run(go())
+
+    def test_bad_requests(self):
+        async def go():
+            client, _state, engine = await make_client()
+            try:
+                r = await client.post("/write", json={"nope": []})
+                assert r.status == 400
+                r = await client.post("/query", json={"metric": "x"})
+                assert r.status == 400
+                r = await client.get("/label_values", params={"metric": "x"})
+                assert r.status == 400
+            finally:
+                await client.close()
+                await engine.close()
+
+        run(go())
+
+
+class TestConfig:
+    def test_example_toml_loads(self):
+        cfg = load_config("docs/example.toml")
+        assert cfg.port == 5000
+        assert cfg.metric_engine.segment_duration.millis == 2 * HOUR
+        assert cfg.metric_engine.time_merge_storage.manifest.hard_merge_threshold == 90
+
+    def test_s3_rejected(self, tmp_path):
+        p = tmp_path / "s3.toml"
+        p.write_text('[metric_engine.object_store]\nkind = "S3Like"\n')
+        with pytest.raises(Error, match="not supported yet"):
+            load_config(str(p))
+
+    def test_unknown_key_rejected(self, tmp_path):
+        p = tmp_path / "bad.toml"
+        p.write_text("prot = 5000\n")
+        with pytest.raises(Error, match="unknown config keys"):
+            load_config(str(p))
+
+
+class TestConfigValidation:
+    def test_wrong_scalar_types_fail_at_load(self, tmp_path):
+        p = tmp_path / "bad.toml"
+        p.write_text("port = '5000'\n")
+        with pytest.raises(Error, match="integer"):
+            load_config(str(p))
+        p.write_text("[metric_engine]\nsegment_duration = 7200000\n")
+        with pytest.raises(Error, match="duration string"):
+            load_config(str(p))
+        p.write_text("[test]\nenable_write = 'false'\n")
+        with pytest.raises(Error, match="boolean"):
+            load_config(str(p))
