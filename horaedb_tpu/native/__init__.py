@@ -1,0 +1,180 @@
+"""ctypes bindings for the C++ host-path kernels (native/horaedb_native.cpp).
+
+The library is built on demand with the in-image g++ toolchain and cached
+next to the source; every entry point has a numpy fallback, so the
+framework works (slower) if no compiler is present.  `available()` reports
+which path is active.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libhoraedb_native.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_lib_tried = False
+_lock = threading.Lock()
+
+# Single source of truth for the snapshot wire format (mirrored by
+# SnapshotRecordC in native/horaedb_native.cpp and cross-checked by the
+# spec-twin classes in storage/manifest/encoding.py + golden tests).
+SNAPSHOT_MAGIC = 0xCAFE_1234
+SNAPSHOT_VERSION = 1
+RECORD_DTYPE = np.dtype(
+    [("id", "<u8"), ("start", "<i8"), ("end", "<i8"),
+     ("size", "<u4"), ("num_rows", "<u4")], align=False)
+
+_HEADER_LEN = 14
+_RECORD_LEN = RECORD_DTYPE.itemsize
+assert _RECORD_LEN == 32
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_tried
+    with _lock:
+        if _lib_tried:
+            return _lib
+        _lib_tried = True
+        # always run make: it is a no-op when the .so is newer than the
+        # source, and rebuilds automatically after source edits
+        try:
+            subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                           capture_output=True, timeout=120)
+        except (OSError, subprocess.SubprocessError) as e:
+            logger.warning("native build failed: %s", e)
+            if not os.path.exists(_LIB_PATH):
+                logger.warning("using numpy fallbacks")
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError as e:
+            logger.warning("native load failed, using numpy fallbacks: %s", e)
+            return None
+        lib.snapshot_encode.restype = ctypes.c_longlong
+        lib.snapshot_encode.argtypes = [ctypes.c_void_p, ctypes.c_size_t,
+                                        ctypes.c_void_p, ctypes.c_size_t]
+        lib.snapshot_decode.restype = ctypes.c_longlong
+        lib.snapshot_decode.argtypes = [ctypes.c_void_p, ctypes.c_size_t,
+                                        ctypes.c_void_p, ctypes.c_size_t]
+        lib.run_starts_i64.restype = None
+        lib.run_starts_i64.argtypes = [ctypes.POINTER(ctypes.c_void_p),
+                                       ctypes.c_int, ctypes.c_size_t,
+                                       ctypes.c_void_p]
+        lib.run_last_indices.restype = ctypes.c_size_t
+        lib.run_last_indices.argtypes = [ctypes.c_void_p, ctypes.c_size_t,
+                                         ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+# ---------------------------------------------------------------------------
+# snapshot codec
+# ---------------------------------------------------------------------------
+
+
+def snapshot_encode(records: np.ndarray) -> bytes:
+    """records: RECORD_DTYPE structured array -> snapshot bytes."""
+    records = np.ascontiguousarray(records, dtype=RECORD_DTYPE)
+    n = len(records)
+    lib = _load()
+    out = np.empty(_HEADER_LEN + n * _RECORD_LEN, dtype=np.uint8)
+    if lib is not None:
+        written = lib.snapshot_encode(
+            records.ctypes.data_as(ctypes.c_void_p), n,
+            out.ctypes.data_as(ctypes.c_void_p), out.nbytes)
+        assert written == out.nbytes
+        return out.tobytes()
+    # numpy fallback: header + raw little-endian struct bytes (the dtype
+    # layout IS the wire layout)
+    import struct
+
+    header = struct.pack("<IBBQ", SNAPSHOT_MAGIC, SNAPSHOT_VERSION, 0,
+                         n * _RECORD_LEN)
+    return header + records.tobytes()
+
+
+def snapshot_decode(buf: bytes) -> np.ndarray:
+    """snapshot bytes -> RECORD_DTYPE structured array (validates header)."""
+    from horaedb_tpu.common.error import Error, ensure
+
+    if not buf:
+        return np.empty(0, dtype=RECORD_DTYPE)
+    lib = _load()
+    n_max = max(0, (len(buf) - _HEADER_LEN)) // _RECORD_LEN
+    if lib is not None:
+        out = np.empty(n_max, dtype=RECORD_DTYPE)
+        src = np.frombuffer(buf, dtype=np.uint8)
+        n = lib.snapshot_decode(src.ctypes.data_as(ctypes.c_void_p), len(buf),
+                                out.ctypes.data_as(ctypes.c_void_p), n_max)
+        if n == -2:
+            raise Error("invalid bytes to convert to header")
+        ensure(n >= 0, f"snapshot decode failed (code {n}): length mismatch")
+        return out[:n]
+    import struct
+
+    ensure(len(buf) >= _HEADER_LEN, "snapshot header truncated")
+    magic, _ver, _flag, length = struct.unpack_from("<IBBQ", buf)
+    ensure(magic == SNAPSHOT_MAGIC, "invalid bytes to convert to header")
+    body = buf[_HEADER_LEN:]
+    ensure(length == len(body) and length % _RECORD_LEN == 0,
+           f"snapshot length mismatch: header={length}, body={len(body)}")
+    return np.frombuffer(body, dtype=RECORD_DTYPE).copy()
+
+
+# ---------------------------------------------------------------------------
+# run detection (host merge fallback)
+# ---------------------------------------------------------------------------
+
+
+def run_starts_i64(cols: list[np.ndarray]) -> np.ndarray:
+    """Run-start mask over sorted int64 key columns."""
+    n = len(cols[0]) if cols else 0
+    if n == 0:
+        return np.zeros(0, dtype=bool)
+    lib = _load()
+    if lib is not None:
+        c_cols = [np.ascontiguousarray(c, dtype=np.int64) for c in cols]
+        ptrs = (ctypes.c_void_p * len(c_cols))(
+            *[c.ctypes.data_as(ctypes.c_void_p).value for c in c_cols])
+        out = np.zeros(n, dtype=np.uint8)
+        lib.run_starts_i64(ptrs, len(c_cols), n,
+                           out.ctypes.data_as(ctypes.c_void_p))
+        return out.astype(bool)
+    starts = np.zeros(n, dtype=bool)
+    starts[0] = True
+    for c in cols:
+        c = np.asarray(c)
+        starts[1:] |= c[1:] != c[:-1]
+    return starts
+
+
+def run_last_indices(starts: np.ndarray) -> np.ndarray:
+    """Last row index per run from a run-start mask."""
+    n = len(starts)
+    if n == 0:
+        return np.zeros(0, dtype=np.int64)
+    lib = _load()
+    if lib is not None:
+        starts_u8 = np.ascontiguousarray(starts, dtype=np.uint8)
+        out = np.empty(n, dtype=np.int64)
+        k = lib.run_last_indices(starts_u8.ctypes.data_as(ctypes.c_void_p), n,
+                                 out.ctypes.data_as(ctypes.c_void_p))
+        return out[:k]
+    idx = np.nonzero(starts)[0]
+    return np.append(idx[1:] - 1, n - 1)

@@ -16,6 +16,9 @@ from __future__ import annotations
 import struct
 from dataclasses import dataclass, field
 
+import numpy as np
+
+from horaedb_tpu import native
 from horaedb_tpu.common import protowire as pw
 from horaedb_tpu.common.error import ensure
 from horaedb_tpu.storage.sst import FileId, FileMeta, SstFile
@@ -150,15 +153,21 @@ def decode_manifest_update(buf: bytes) -> ManifestUpdate:
 _HEADER_STRUCT = struct.Struct("<IBBQ")
 _RECORD_STRUCT = struct.Struct("<QqqII")
 
-SNAPSHOT_MAGIC = 0xCAFE_1234
-SNAPSHOT_VERSION = 1
+# wire constants are single-sourced in horaedb_tpu.native
+SNAPSHOT_MAGIC = native.SNAPSHOT_MAGIC
+SNAPSHOT_VERSION = native.SNAPSHOT_VERSION
 HEADER_LENGTH = _HEADER_STRUCT.size  # 14
 RECORD_LENGTH = _RECORD_STRUCT.size  # 32
+assert RECORD_LENGTH == native.RECORD_DTYPE.itemsize
 
 
 @dataclass
 class SnapshotHeader:
-    """14-byte snapshot header (ref: encoding.rs:90-153)."""
+    """14-byte snapshot header (ref: encoding.rs:90-153).
+
+    Spec twin: SnapshotHeader/SnapshotRecord are the independent Python
+    statement of the wire format, used by tests to cross-check the native
+    codec; production encode/decode goes through horaedb_tpu.native."""
 
     magic: int = SNAPSHOT_MAGIC
     version: int = SNAPSHOT_VERSION
@@ -197,47 +206,34 @@ class SnapshotRecord:
         return cls(id=fid, time_range=TimeRange.new(start, end),
                    size=size, num_rows=num_rows)
 
-    @classmethod
-    def from_sst(cls, f: SstFile) -> "SnapshotRecord":
-        return cls(id=f.id, time_range=f.meta.time_range,
-                   size=f.meta.size, num_rows=f.meta.num_rows)
-
-    def to_sst(self) -> SstFile:
-        # max_sequence == file id by construction (ref: encoding.rs:243-252)
-        return SstFile(self.id, FileMeta(
-            max_sequence=self.id, num_rows=self.num_rows, size=self.size,
-            time_range=self.time_range,
-        ))
-
 
 class Snapshot:
-    """Full SST listing: header + record array (ref: encoding.rs:283-344)."""
+    """Full SST listing: header + record array (ref: encoding.rs:283-344).
 
-    def __init__(self, records: list[SnapshotRecord] | None = None):
-        self.records: list[SnapshotRecord] = records or []
+    Array-backed: records live in a numpy structured array whose memory
+    layout IS the wire layout, so encode/decode are a header plus one
+    memcpy (through the C++ codec in native/ when built, numpy otherwise)
+    instead of per-record Python packing — this codec is the reference's
+    own benchmark target (src/benchmarks/benches/bench.rs).
+    """
+
+    def __init__(self, records: "np.ndarray | None" = None):
+        self.records = (records if records is not None
+                        else np.empty(0, dtype=native.RECORD_DTYPE))
 
     @classmethod
     def from_bytes(cls, buf: bytes) -> "Snapshot":
-        if not buf:
-            return cls()
-        header = SnapshotHeader.from_bytes(buf)
-        body = buf[HEADER_LENGTH:]
-        ensure(
-            header.length == len(body) and header.length % RECORD_LENGTH == 0,
-            f"snapshot length mismatch: header={header.length}, body={len(body)}",
-        )
-        records = [
-            SnapshotRecord.from_bytes(body, off)
-            for off in range(0, len(body), RECORD_LENGTH)
-        ]
-        return cls(records)
+        return cls(native.snapshot_decode(buf))
 
     def into_bytes(self) -> bytes:
-        header = SnapshotHeader(length=len(self.records) * RECORD_LENGTH)
-        out = bytearray(header.to_bytes())
-        for r in self.records:
-            out.extend(r.to_bytes())
-        return bytes(out)
+        return native.snapshot_encode(self.records)
+
+    def __len__(self) -> int:
+        return len(self.records)
+
+    @property
+    def ids(self) -> list[int]:
+        return self.records["id"].tolist()
 
     def add_records(self, files: list[SstFile]) -> None:
         """Add files, replacing any record with the same id.
@@ -248,17 +244,27 @@ class Snapshot:
         """
         if not files:
             return
-        incoming = {f.id for f in files}
-        self.records = [r for r in self.records if r.id not in incoming]
-        self.records.extend(SnapshotRecord.from_sst(f) for f in files)
+        incoming = np.array(
+            [(f.id, int(f.meta.time_range.start), int(f.meta.time_range.end),
+              f.meta.size, f.meta.num_rows) for f in files],
+            dtype=native.RECORD_DTYPE)
+        keep = ~np.isin(self.records["id"], incoming["id"])
+        self.records = np.concatenate([self.records[keep], incoming])
 
     def delete_records(self, to_deletes: list[FileId]) -> None:
         """Delete by id; ids already absent are ignored (replay tolerance —
         the reference only debug-asserts here, encoding.rs:313-321)."""
         if not to_deletes:
             return
-        dels = set(to_deletes)
-        self.records = [r for r in self.records if r.id not in dels]
+        dels = np.asarray(to_deletes, dtype=np.uint64)
+        self.records = self.records[~np.isin(self.records["id"], dels)]
 
     def into_ssts(self) -> list[SstFile]:
-        return [r.to_sst() for r in self.records]
+        # max_sequence == file id by construction (ref: encoding.rs:243-252)
+        return [
+            SstFile(int(r["id"]), FileMeta(
+                max_sequence=int(r["id"]), num_rows=int(r["num_rows"]),
+                size=int(r["size"]),
+                time_range=TimeRange.new(int(r["start"]), int(r["end"]))))
+            for r in self.records
+        ]

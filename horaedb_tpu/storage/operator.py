@@ -18,6 +18,7 @@ from __future__ import annotations
 import numpy as np
 import pyarrow as pa
 
+from horaedb_tpu import native
 from horaedb_tpu.common.error import Error, ensure
 
 
@@ -25,14 +26,28 @@ def _run_starts_host(batch: pa.RecordBatch, pk_indices: list[int]) -> np.ndarray
     """Boolean run-start mask over a PK-sorted batch (host twin of
     ops.merge.sorted_run_starts).  pk_indices are explicit because a
     projection may have reordered columns — PKs are NOT necessarily the
-    first columns of the batch."""
+    first columns of the batch.
+
+    Integer key columns go through the C++ kernel (native/); string and
+    other types fall back to numpy object comparison.
+    """
     n = batch.num_rows
     if n == 0:
         return np.zeros(0, dtype=bool)
-    starts = np.zeros(n, dtype=bool)
-    starts[0] = True
+    int_cols: list[np.ndarray] = []
+    other_cols: list[np.ndarray] = []
     for i in pk_indices:
         col = batch.column(i).to_numpy(zero_copy_only=False)
+        if np.issubdtype(col.dtype, np.integer):
+            int_cols.append(col.astype(np.int64, copy=False))
+        else:
+            other_cols.append(col)
+    if int_cols:
+        starts = native.run_starts_i64(int_cols)
+    else:
+        starts = np.zeros(n, dtype=bool)
+        starts[0] = True
+    for col in other_cols:
         starts[1:] |= col[1:] != col[:-1]
     return starts
 
@@ -47,8 +62,7 @@ class LastValueOperator:
         if n == 0:
             return batch
         starts = _run_starts_host(batch, pk_indices)
-        # last index of run k = (start of run k+1) - 1; last run ends at n-1
-        last_idx = np.append(np.nonzero(starts)[0][1:] - 1, n - 1)
+        last_idx = native.run_last_indices(starts)
         return batch.take(pa.array(last_idx))
 
 

@@ -98,9 +98,9 @@ def test_background_merge_convergence():
             assert m.deltas_num == 0
             assert await store.list("root/manifest/delta/") == []
             snap = await _read_snapshot(store, "root/manifest/snapshot")
-            assert sorted(r.id for r in snap.records) == list(range(5))
+            assert sorted(snap.ids) == list(range(5))
             mem = await m.all_ssts()
-            assert sorted(f.id for f in mem) == sorted(r.id for r in snap.records)
+            assert sorted(f.id for f in mem) == sorted(snap.ids)
         finally:
             await m.close()
 
@@ -126,7 +126,7 @@ def test_recovery_folds_deltas():
             assert [f.id for f in ssts] == [2]
             assert await store.list("root/manifest/delta/") == []
             snap = await _read_snapshot(store, "root/manifest/snapshot")
-            assert [r.id for r in snap.records] == [2]
+            assert snap.ids == [2]
         finally:
             await m2.close()
 
