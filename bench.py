@@ -12,7 +12,8 @@ Prints ONE JSON line:
    "vs_baseline": <tpu_p50 / cpu_p50>}   (lower is better; north star
    for the full path is <= 0.5)
 
-Env knobs: BENCH_ROWS (default 10_000_000), BENCH_ITERS (default 20).
+Env knobs: BENCH_ROWS (default 10_000_000), BENCH_ITERS (default 20),
+BENCH_CONFIG (default 1; 2-5 delegate to horaedb_tpu.bench.suite).
 """
 
 import json
@@ -46,8 +47,20 @@ def cpu_baseline(ts_off, gid, vals, bucket_ms, num_groups, num_buckets, iters):
 def main() -> None:
     rows = int(os.environ.get("BENCH_ROWS", 10_000_000))
     iters = int(os.environ.get("BENCH_ITERS", 20))
+    try:
+        config = int(os.environ.get("BENCH_CONFIG", 1))
+    except ValueError:
+        sys.exit(f"BENCH_CONFIG must be 1-5, got "
+                 f"{os.environ.get('BENCH_CONFIG')!r}")
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    if config != 1:
+        from horaedb_tpu.bench.suite import RUNNERS
+
+        if config not in RUNNERS:
+            sys.exit(f"BENCH_CONFIG must be 1-5, got {config}")
+        print(json.dumps(RUNNERS[config](rows, iters)))
+        return
     from horaedb_tpu.bench.tsbs import TsbsConfig, generate_cpu_arrays
 
     # 100 hosts, 1 field, span sized to produce `rows` points

@@ -1,0 +1,451 @@
+"""The five BASELINE benchmark configs (BASELINE.md):
+
+  1. single-table avg GROUP BY time(1m)            -> bench.py (driver default)
+  2. TSBS cpu-only, WHERE host=? + range, min/max/avg downsample
+  3. TSBS devops-100, 10 fields, tag filter + GROUP BY host, time(5m)
+  4. multi-SST merge-scan: top-k hosts by max(cpu) across 64 SSTs
+  5. compaction rollup: 1s -> 1h over 30d, all aggregators, write-back
+
+Each run_configN returns {metric, value (p50 ms), unit, vs_baseline
+(device_p50 / cpu_p50, lower is better)}.  Sizes are scaled by `rows`
+so the suite runs anywhere; the driver's headline numbers come from
+bench.py.
+
+CLI: python -m horaedb_tpu.bench.suite --config 2 [--rows N] [--iters K]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import functools
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _p50(fn, iters: int) -> float:
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        fn()
+        times.append(time.perf_counter() - t0)
+    return float(np.percentile(times, 50))
+
+
+def _pad_pow2(a: np.ndarray, dtype) -> np.ndarray:
+    # same capacity rule as the engine's encode path — benches must compile
+    # the same program shapes the engine uses
+    from horaedb_tpu.ops.encode import pad_capacity
+
+    n = len(a)
+    return np.pad(a.astype(dtype), (0, pad_capacity(n) - n))
+
+
+def _check_i32_span(ts_off: np.ndarray, what: str) -> None:
+    from horaedb_tpu.common.error import ensure
+
+    ensure(int(ts_off.max(initial=0)) < 2**31,
+           f"{what}: ts offsets exceed int32 — lower --rows (the device "
+           "path buckets int32 offsets; larger spans must be segmented)")
+
+
+# ---------------------------------------------------------------------------
+# config 2: single-host filter + min/max/avg downsample
+# ---------------------------------------------------------------------------
+
+
+def run_config2(rows: int, iters: int) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from horaedb_tpu.bench.tsbs import TsbsConfig, generate_cpu_arrays
+    from horaedb_tpu.ops.downsample import time_bucket_aggregate
+
+    hosts = 100
+    interval = 10_000
+    cfg = TsbsConfig(num_hosts=hosts, num_fields=3, interval_ms=interval,
+                     span_ms=(rows // hosts) * interval)
+    cols = generate_cpu_arrays(cfg, shuffle=True)
+    n = len(cols["ts"])
+    target_host = 42
+    # query window: middle half of the span
+    q_start = cfg.start_ms + cfg.span_ms // 4
+    q_end = q_start + cfg.span_ms // 2
+    bucket = 60_000
+    num_buckets = -(-(q_end - q_start) // bucket)
+
+    ts_off = cols["ts"] - q_start
+    _check_i32_span(ts_off, "config2")
+    in_range = (ts_off >= 0) & (ts_off < (q_end - q_start))
+    is_host = cols["host_id"] == target_host
+    vals = cols["usage_user"].astype(np.float32)
+
+    # device: WHERE host=? becomes group -1 for non-matching rows
+    gid = np.where(is_host & in_range, 0, -1).astype(np.int32)
+    d_ts = jax.device_put(_pad_pow2(np.clip(ts_off, 0, None), np.int32))
+    d_gid = jax.device_put(_pad_pow2(gid, np.int32))
+    d_vals = jax.device_put(_pad_pow2(vals, np.float32))
+
+    def device_run():
+        out = time_bucket_aggregate(d_ts, d_gid, d_vals, n, bucket,
+                                    num_groups=1, num_buckets=num_buckets)
+        jax.block_until_ready(out["avg"])
+        return out
+
+    out = device_run()  # compile
+    dev_p50 = _p50(device_run, iters)
+
+    def cpu_run():
+        m = is_host & in_range
+        b = ts_off[m] // bucket
+        v = vals[m].astype(np.float64)
+        sums = np.bincount(b, weights=v, minlength=num_buckets)
+        counts = np.bincount(b, minlength=num_buckets)
+        mins = np.full(num_buckets, np.inf)
+        np.minimum.at(mins, b, v)
+        maxs = np.full(num_buckets, -np.inf)
+        np.maximum.at(maxs, b, v)
+        return sums, counts, mins, maxs
+
+    cpu_p50 = _p50(cpu_run, max(3, iters // 4))
+
+    sums, counts, mins, maxs = cpu_run()
+    occ = counts > 0
+    np.testing.assert_allclose(np.asarray(out["min"])[0][occ], mins[occ],
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(out["max"])[0][occ], maxs[occ],
+                               rtol=1e-5)
+    _log(f"config2: n={n:,} dev={dev_p50*1e3:.2f}ms cpu={cpu_p50*1e3:.2f}ms")
+    return {"metric": f"TSBS cpu-only WHERE host + min/max/avg, {n/1e6:.1f}M rows, p50",
+            "value": round(dev_p50 * 1e3, 3), "unit": "ms",
+            "vs_baseline": round(dev_p50 / cpu_p50, 4)}
+
+
+# ---------------------------------------------------------------------------
+# config 3: devops-100, 10 fields, region filter + GROUP BY host, time(5m)
+# ---------------------------------------------------------------------------
+
+
+def run_config3(rows: int, iters: int) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from horaedb_tpu.bench.tsbs import REGIONS, TsbsConfig, generate_cpu_arrays
+
+    hosts = 100
+    fields = 10
+    interval = 10_000
+    cfg = TsbsConfig(num_hosts=hosts, num_fields=fields, interval_ms=interval,
+                     span_ms=(rows // hosts) * interval)
+    cols = generate_cpu_arrays(cfg, shuffle=True)
+    n = len(cols["ts"])
+    bucket = 300_000  # 5m
+    num_buckets = -(-cfg.span_ms // bucket)
+    ts_off = (cols["ts"] - cfg.start_ms).astype(np.int64)
+    _check_i32_span(ts_off, "config3")
+    # region tag filter: hosts are round-robin across 9 regions
+    host_region = np.arange(hosts) % len(REGIONS)
+    target_region = 0
+    host_in_region = host_region[cols["host_id"]] == target_region
+    gid = np.where(host_in_region, cols["host_id"], -1).astype(np.int32)
+    from horaedb_tpu.bench.tsbs import CPU_FIELDS
+
+    field_mat = np.stack([cols[CPU_FIELDS[f]] for f in range(fields)],
+                         axis=1).astype(np.float32)  # (n, 10)
+
+    from horaedb_tpu.ops.encode import pad_capacity
+
+    cap = pad_capacity(n)
+    d_ts = jax.device_put(_pad_pow2(ts_off, np.int32))
+    d_gid = jax.device_put(_pad_pow2(gid, np.int32))
+    d_fields = jax.device_put(
+        np.pad(field_mat, ((0, cap - n), (0, 0))))
+
+    num_cells = hosts * num_buckets
+
+    @functools.partial(jax.jit, static_argnames=("num_groups", "num_buckets"))
+    def multi_field_avg(ts, g, fm, n_valid, bucket_ms, num_groups, num_buckets):
+        iota = jnp.arange(ts.shape[0], dtype=jnp.int32)
+        valid = iota < n_valid
+        b = ts // bucket_ms
+        in_grid = valid & (g >= 0) & (b >= 0) & (b < num_buckets)
+        seg = jnp.where(in_grid, g * num_buckets + b, num_groups * num_buckets)
+        counts = jax.ops.segment_sum(in_grid.astype(jnp.float32), seg,
+                                     num_segments=num_groups * num_buckets + 1)
+        sums = jax.ops.segment_sum(
+            jnp.where(in_grid[:, None], fm, 0.0), seg,
+            num_segments=num_groups * num_buckets + 1)
+        avg = sums[:-1] / jnp.maximum(counts[:-1, None], 1.0)
+        return avg, counts[:-1]
+
+    def device_run():
+        avg, counts = multi_field_avg(d_ts, d_gid, d_fields, n, bucket,
+                                      num_groups=hosts, num_buckets=num_buckets)
+        jax.block_until_ready(avg)
+        return avg, counts
+
+    avg, counts = device_run()
+    dev_p50 = _p50(device_run, iters)
+
+    def cpu_run():
+        m = host_in_region
+        cell = cols["host_id"][m].astype(np.int64) * num_buckets + ts_off[m] // bucket
+        counts = np.bincount(cell, minlength=num_cells)
+        sums = np.stack([
+            np.bincount(cell, weights=field_mat[m, f].astype(np.float64),
+                        minlength=num_cells)
+            for f in range(fields)
+        ], axis=1)
+        return sums / np.maximum(counts[:, None], 1)
+
+    cpu_p50 = _p50(cpu_run, max(3, iters // 4))
+    ref = cpu_run()
+    got = np.asarray(avg, dtype=np.float64)
+    occ = np.asarray(counts) > 0
+    np.testing.assert_allclose(got[occ], ref[occ], rtol=2e-4)
+    _log(f"config3: n={n:,}x{fields}f dev={dev_p50*1e3:.2f}ms cpu={cpu_p50*1e3:.2f}ms")
+    return {"metric": f"TSBS devops-100 10-field GROUP BY host,time(5m), {n/1e6:.1f}M rows, p50",
+            "value": round(dev_p50 * 1e3, 3), "unit": "ms",
+            "vs_baseline": round(dev_p50 / cpu_p50, 4)}
+
+
+# ---------------------------------------------------------------------------
+# config 4: multi-SST merge-scan through the real engine, top-k by max(cpu)
+# ---------------------------------------------------------------------------
+
+
+def run_config4(rows: int, iters: int, num_ssts: int = 64) -> dict:
+    import pyarrow as pa
+
+    import jax
+    import jax.numpy as jnp
+
+    from horaedb_tpu.objstore import MemoryObjectStore
+    from horaedb_tpu.ops import encode_batch
+    from horaedb_tpu.ops.merge import merge_dedup_last
+    from horaedb_tpu.ops.downsample import time_bucket_aggregate
+    from horaedb_tpu.ops.topk import top_k_groups
+    from horaedb_tpu.storage.config import StorageConfig, from_dict
+    from horaedb_tpu.storage.read import ScanRequest
+    from horaedb_tpu.storage.storage import CloudObjectStorage, WriteRequest
+    from horaedb_tpu.storage.types import TimeRange
+
+    hosts = 100
+    rng = np.random.default_rng(0)
+    per_sst = max(1, rows // num_ssts)
+    span = 3_000_000
+    T0 = (1_700_000_000_000 // 3_600_000) * 3_600_000  # segment-aligned
+    schema = pa.schema([("host", pa.string()), ("ts", pa.int64()),
+                       ("cpu", pa.float64())])
+
+    # keep the exact written rows for the CPU baseline + cross-check
+    all_h = np.empty(per_sst * num_ssts, dtype=np.int64)
+    all_ts = np.empty(per_sst * num_ssts, dtype=np.int64)
+    all_v = np.empty(per_sst * num_ssts, dtype=np.float64)
+
+    async def setup():
+        cfg = from_dict(StorageConfig, {"scheduler": {"schedule_interval": "1h"}})
+        s = await CloudObjectStorage.open("bench", 3_600_000,
+                                         MemoryObjectStore(), schema, 2, cfg)
+        names = np.array([f"host_{i}" for i in range(hosts)], dtype=object)
+        for i in range(num_ssts):
+            h = rng.integers(0, hosts, per_sst)
+            ts = T0 + rng.integers(0, span, per_sst)
+            v = rng.random(per_sst) * 100
+            sl = slice(i * per_sst, (i + 1) * per_sst)
+            all_h[sl], all_ts[sl], all_v[sl] = h, ts, v
+            batch = pa.record_batch(
+                [pa.array(names[h]), pa.array(ts, type=pa.int64()),
+                 pa.array(v, type=pa.float64())],
+                schema=schema)
+            await s.write(WriteRequest(batch, TimeRange.new(T0, T0 + span)))
+        return s
+
+    async def query_once(s):
+        """Full device pipeline: scan (parquet decode + device merge-dedup)
+        -> downsample -> top-k.  This is what the metric times."""
+        batches = []
+        async for b in s.scan(ScanRequest(range=TimeRange.new(T0, T0 + span))):
+            batches.append(b)
+        merged = pa.Table.from_batches(batches).combine_chunks()
+        dev = encode_batch(merged.to_batches()[0], device_put=jax.device_put)
+        aggs = time_bucket_aggregate(
+            dev.columns["ts"], dev.columns["host"], dev.columns["cpu"],
+            dev.n_valid, span, num_groups=hosts, num_buckets=1)
+        scores = jnp.where(aggs["count"][:, 0] > 0, aggs["max"][:, 0],
+                           -jnp.inf).astype(jnp.float32)
+        top_vals, top_idx = top_k_groups(scores, k=10)
+        jax.block_until_ready(top_vals)
+        n_out = sum(b.num_rows for b in batches)
+        return n_out, np.asarray(top_idx), dev.encodings["host"].dictionary
+
+    async def bench():
+        s = await setup()
+        try:
+            n_out, top_idx, host_dict = await query_once(s)  # warm/compile
+            times = []
+            for _ in range(iters):
+                t0 = time.perf_counter()
+                n_out, top_idx, host_dict = await query_once(s)
+                times.append(time.perf_counter() - t0)
+            return float(np.percentile(times, 50)), n_out, top_idx, host_dict
+        finally:
+            await s.close()
+
+    dev_p50, n_out, top_idx, host_dict = asyncio.run(bench())
+
+    # CPU baseline on THE SAME rows: in-memory lexsort+dedup+top-k.  Note
+    # this is conservative in the device's disfavor: the CPU side skips
+    # the parquet read the device pipeline pays for.
+    def cpu_run():
+        order = np.lexsort((all_ts, all_h))
+        hs, tss = all_h[order], all_ts[order]
+        keep = np.ones(len(hs), dtype=bool)
+        keep[1:] = (hs[1:] != hs[:-1]) | (tss[1:] != tss[:-1])
+        # last-by-write-order wins: within equal keys keep the LAST original
+        # row; lexsort is stable so take the final row of each dup run
+        last_keep = np.ones(len(hs), dtype=bool)
+        last_keep[:-1] = (hs[:-1] != hs[1:]) | (tss[:-1] != tss[1:])
+        vs = all_v[order][last_keep]
+        maxs = np.full(hosts, -np.inf)
+        np.maximum.at(maxs, hs[last_keep], vs)
+        return int(keep.sum()), set(np.argsort(maxs)[-10:].tolist())
+
+    cpu_p50 = _p50(cpu_run, max(2, iters // 4))
+    ref_n, ref_top = cpu_run()
+
+    # cross-check: dedup count and top-k set must match numpy on same data
+    assert n_out == ref_n, (n_out, ref_n)
+    got_hosts = {str(host_dict[i]) for i in np.asarray(top_idx)}
+    assert got_hosts == {f"host_{g}" for g in ref_top}, (got_hosts, ref_top)
+
+    _log(f"config4: {num_ssts} SSTs, {len(all_h):,} rows in, {n_out:,} out; "
+         f"full-pipeline dev={dev_p50*1e3:.1f}ms cpu-in-mem={cpu_p50*1e3:.1f}ms")
+    return {"metric": f"multi-SST merge-scan top-k, {num_ssts} SSTs {len(all_h)/1e6:.1f}M rows, p50",
+            "value": round(dev_p50 * 1e3, 3), "unit": "ms",
+            "vs_baseline": round(dev_p50 / cpu_p50, 4)}
+
+
+# ---------------------------------------------------------------------------
+# config 5: compaction-path rollup 1s -> 1h over 30d, write-back
+# ---------------------------------------------------------------------------
+
+
+def run_config5(rows: int, iters: int) -> dict:
+    import pyarrow as pa
+
+    import jax
+
+    from horaedb_tpu.objstore import MemoryObjectStore
+    from horaedb_tpu.ops.downsample import time_bucket_aggregate
+    from horaedb_tpu.storage.config import StorageConfig, from_dict
+    from horaedb_tpu.storage.storage import CloudObjectStorage, WriteRequest
+    from horaedb_tpu.storage.types import TimeRange
+
+    # 30d of 1s data, in SECONDS to fit int32 offsets; series count scales
+    # with the requested row budget
+    span_s = 30 * 24 * 3600
+    num_series = max(1, rows // span_s)
+    n = num_series * span_s if num_series * span_s <= rows * 2 else rows
+    rng = np.random.default_rng(1)
+    sid = np.repeat(np.arange(num_series, dtype=np.int32), span_s)[:n]
+    ts_s = np.tile(np.arange(span_s, dtype=np.int64), num_series)[:n]
+    vals = rng.random(n).astype(np.float32) * 100
+    bucket_s = 3600
+    num_buckets = span_s // bucket_s
+
+    d_ts = jax.device_put(_pad_pow2(ts_s, np.int32))
+    d_sid = jax.device_put(_pad_pow2(sid, np.int32))
+    d_vals = jax.device_put(_pad_pow2(vals, np.float32))
+
+    rollup_schema = pa.schema([
+        ("series", pa.int64()), ("bucket_ts", pa.int64()),
+        ("min", pa.float64()), ("max", pa.float64()), ("sum", pa.float64()),
+        ("count", pa.float64()), ("avg", pa.float64()), ("last", pa.float64()),
+    ])
+
+    async def write_back(aggs):
+        cfg = from_dict(StorageConfig, {"scheduler": {"schedule_interval": "1h"}})
+        s = await CloudObjectStorage.open("rollup", 10**9, MemoryObjectStore(),
+                                         rollup_schema, 2, cfg)
+        try:
+            series_col = np.repeat(np.arange(num_series, dtype=np.int64),
+                                   num_buckets)
+            bucket_col = np.tile(
+                np.arange(num_buckets, dtype=np.int64) * bucket_s * 1000,
+                num_series)
+            arrays = [pa.array(series_col), pa.array(bucket_col)]
+            for key in ("min", "max", "sum", "count", "avg", "last"):
+                arrays.append(pa.array(
+                    np.nan_to_num(np.asarray(aggs[key], dtype=np.float64)
+                                  ).reshape(-1)))
+            batch = pa.record_batch(arrays, schema=rollup_schema)
+            await s.write(WriteRequest(
+                batch, TimeRange.new(0, span_s * 1000), enable_check=False))
+            return batch.num_rows
+        finally:
+            await s.close()
+
+    def rollup():
+        aggs = time_bucket_aggregate(d_ts, d_sid, d_vals, n, bucket_s,
+                                     num_groups=num_series,
+                                     num_buckets=num_buckets)
+        jax.block_until_ready(aggs["avg"])
+        return aggs
+
+    aggs = rollup()  # compile
+    written = asyncio.run(write_back(aggs))  # warm storage path
+
+    # the timed iteration is the FULL rollup: aggregate + write-back
+    def rollup_and_writeback():
+        nonlocal aggs
+        aggs = rollup()
+        asyncio.run(write_back(aggs))
+
+    dev_p50 = _p50(rollup_and_writeback, iters)
+
+    def cpu_run():
+        cell = sid.astype(np.int64) * num_buckets + ts_s // bucket_s
+        ncells = num_series * num_buckets
+        counts = np.bincount(cell, minlength=ncells)
+        sums = np.bincount(cell, weights=vals.astype(np.float64),
+                           minlength=ncells)
+        mins = np.full(ncells, np.inf)
+        np.minimum.at(mins, cell, vals)
+        maxs = np.full(ncells, -np.inf)
+        np.maximum.at(maxs, cell, vals)
+        return sums, counts, mins, maxs
+
+    cpu_p50 = _p50(cpu_run, max(2, iters // 4))
+    sums, counts, mins, maxs = cpu_run()
+    np.testing.assert_allclose(
+        np.asarray(aggs["sum"], dtype=np.float64).reshape(-1), sums, rtol=2e-4)
+    _log(f"config5: {n:,} rows -> {written:,} rollup rows "
+         f"(agg+writeback dev={dev_p50*1e3:.1f}ms, cpu agg-only={cpu_p50*1e3:.1f}ms)")
+    return {"metric": f"compaction rollup 1s->1h 30d all aggs + write-back, {n/1e6:.1f}M rows, p50",
+            "value": round(dev_p50 * 1e3, 3), "unit": "ms",
+            "vs_baseline": round(dev_p50 / cpu_p50, 4)}
+
+
+RUNNERS = {2: run_config2, 3: run_config3, 4: run_config4, 5: run_config5}
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser("horaedb-tpu bench suite")
+    parser.add_argument("--config", type=int, required=True, choices=[2, 3, 4, 5])
+    parser.add_argument("--rows", type=int, default=2_000_000)
+    parser.add_argument("--iters", type=int, default=10)
+    args = parser.parse_args()
+    result = RUNNERS[args.config](args.rows, args.iters)
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

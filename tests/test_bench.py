@@ -1,0 +1,42 @@
+"""Fast smoke tests for the bench suite: every config must run and
+self-validate (each runner cross-checks device output against numpy
+before reporting) at tiny scale."""
+
+import pytest
+
+from horaedb_tpu.bench.suite import RUNNERS
+from horaedb_tpu.bench.tsbs import TsbsConfig, cpu_record_batch, generate_cpu_arrays
+
+
+class TestTsbsGen:
+    def test_shapes_and_determinism(self):
+        cfg = TsbsConfig(num_hosts=4, num_fields=2, interval_ms=1000,
+                         span_ms=10_000)
+        a = generate_cpu_arrays(cfg)
+        b = generate_cpu_arrays(cfg)
+        assert len(a["ts"]) == 4 * 10
+        assert (a["usage_user"] == b["usage_user"]).all()
+
+    def test_shuffle_preserves_rows(self):
+        cfg = TsbsConfig(num_hosts=3, num_fields=1, interval_ms=1000,
+                         span_ms=5_000)
+        plain = generate_cpu_arrays(cfg, shuffle=False)
+        mixed = generate_cpu_arrays(cfg, shuffle=True)
+        assert sorted(zip(plain["host_id"], plain["ts"])) == \
+            sorted(zip(mixed["host_id"], mixed["ts"]))
+
+    def test_record_batch_with_region(self):
+        cfg = TsbsConfig(num_hosts=10, num_fields=3, interval_ms=1000,
+                         span_ms=3_000)
+        b = cpu_record_batch(cfg, include_region=True)
+        assert b.schema.names[:3] == ["host", "region", "ts"]
+        assert b.num_rows == 30
+        assert len(set(b.column(1).to_pylist())) > 1
+
+
+@pytest.mark.parametrize("config", sorted(RUNNERS))
+def test_suite_configs_run(config):
+    result = RUNNERS[config](rows=20_000, iters=2)
+    assert result["unit"] == "ms"
+    assert result["value"] > 0
+    assert result["vs_baseline"] > 0
