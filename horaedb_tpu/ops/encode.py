@@ -3,7 +3,8 @@
 The TPU wants fixed-width int32/float32 columns with static shapes; Arrow
 delivers int64 timestamps, utf8 strings, and u64 sequences.  The bridge:
 
-- string/binary  → order-preserving dictionary codes (np.unique) + a host
+- string/binary  → order-preserving dictionary codes (Arrow C++
+                   dictionary_encode, re-ranked to sorted order) + a host
                    dictionary for decode and predicate-constant lookup.
 - int64 ts/seq   → int32 offset from a per-batch epoch (timestamps), or
                    order-preserving rank codes (sequences).  Ranks preserve
@@ -22,6 +23,7 @@ from typing import Optional
 
 import numpy as np
 import pyarrow as pa
+import pyarrow.compute as pc
 
 from horaedb_tpu.common.error import Error, ensure
 
@@ -50,8 +52,10 @@ class ColumnEncoding:
       dict    — device value indexes `dictionary` (strings, and int64
                 columns whose span exceeds int32 — e.g. __seq__, whose
                 wall-clock-nanosecond values are near-constant-distinct
-                per file but span far more than 2^31).  np.unique codes
-                are order-preserving, which is all compares/sorts need.
+                per file but span far more than 2^31).  The dictionary is
+                sorted, so codes are order-preserving — all compares and
+                sorts need (strings via _dictionary_encode_arrow, int64
+                via np.unique).
     """
 
     kind: str  # "numeric" | "dict" | "offset"
@@ -102,6 +106,26 @@ def _dictionary_encode(np_col: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
     return codes.astype(np.int32), dictionary
 
 
+def _dictionary_encode_arrow(col: pa.Array) -> tuple[np.ndarray, np.ndarray]:
+    """Order-preserving dictionary codes via Arrow's C++ kernels.
+
+    pyarrow's dictionary_encode assigns codes by first appearance; we
+    re-rank them by sorted dictionary order so code comparison == value
+    comparison (same contract as _dictionary_encode) without touching
+    per-row Python objects.
+    """
+    dict_arr = pc.dictionary_encode(col)
+    if isinstance(dict_arr, pa.ChunkedArray):
+        dict_arr = dict_arr.combine_chunks()
+    codes = dict_arr.indices.to_numpy(zero_copy_only=False)
+    dictionary = dict_arr.dictionary.to_numpy(zero_copy_only=False)
+    ensure(len(dictionary) <= int(_INT32_MAX), "dictionary overflow")
+    order = np.argsort(dictionary)  # sorts only the uniques
+    rank = np.empty(len(order), dtype=np.int32)
+    rank[order] = np.arange(len(order), dtype=np.int32)
+    return rank[codes], dictionary[order]
+
+
 def encode_column(col: pa.Array, name: str) -> tuple[np.ndarray, ColumnEncoding]:
     t = col.type
     if pa.types.is_floating(t):
@@ -123,8 +147,7 @@ def encode_column(col: pa.Array, name: str) -> tuple[np.ndarray, ColumnEncoding]
         codes, dictionary = _dictionary_encode(np64)
         return codes, ColumnEncoding("dict", t, dictionary=dictionary)
     if pa.types.is_string(t) or pa.types.is_large_string(t) or pa.types.is_binary(t):
-        np_col = np.asarray(col.to_pylist(), dtype=object)
-        codes, dictionary = _dictionary_encode(np_col)
+        codes, dictionary = _dictionary_encode_arrow(col)
         return codes, ColumnEncoding("dict", t, dictionary=dictionary)
     raise Error(f"unsupported column type for device encoding: {name}: {t}")
 
@@ -164,6 +187,13 @@ def decode_column(dev_col: np.ndarray, enc: ColumnEncoding, n_valid: int) -> pa.
     if enc.kind == "offset":
         return pa.array(host.astype(np.int64) + enc.epoch, type=enc.arrow_type)
     if enc.kind == "dict":
+        if enc.dictionary.dtype == object:
+            # string/binary: build a DictionaryArray (one C++ gather) and
+            # cast instead of materializing Python objects per row
+            dict_values = pa.array(enc.dictionary, type=enc.arrow_type)
+            darr = pa.DictionaryArray.from_arrays(
+                pa.array(host, type=pa.int32()), dict_values)
+            return darr.cast(enc.arrow_type)
         return pa.array(enc.dictionary[host], type=enc.arrow_type)
     raise Error(f"unknown encoding kind: {enc.kind}")
 

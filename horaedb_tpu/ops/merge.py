@@ -48,18 +48,17 @@ def _merge_dedup_impl(cols: tuple, n_valid: jax.Array, num_pks: int, num_keys: i
     iota = jnp.arange(capacity, dtype=jnp.int32)
     valid = iota < n_valid
 
-    # Padding must sort last: replace pad keys with the int32 max sentinel.
-    sort_operands = []
-    for i, c in enumerate(cols):
-        if i < num_keys and c.dtype == jnp.int32:
-            sort_operands.append(jnp.where(valid, c, _PAD_SENTINEL))
-        else:
-            sort_operands.append(c)
-    sort_operands.append(valid)
-    sorted_all = jax.lax.sort(tuple(sort_operands), num_keys=num_keys, is_stable=True)
-    sorted_cols, sorted_valid = sorted_all[:-1], sorted_all[-1]
+    # Sort ONLY the key columns plus a row-index permutation; value columns
+    # are fetched afterwards with a single fused gather.  With V value
+    # columns this moves V arrays out of the O(n log n) sort and into an
+    # O(n) gather.  Padding must sort last: pad keys become the int32 max
+    # sentinel.
+    keys = tuple(jnp.where(valid, c, _PAD_SENTINEL) for c in cols[:num_keys])
+    sorted_all = jax.lax.sort(keys + (iota,), num_keys=num_keys, is_stable=True)
+    sorted_keys, perm = sorted_all[:-1], sorted_all[-1]
+    sorted_valid = perm < n_valid
 
-    run_starts = sorted_run_starts(sorted_cols[:num_pks], sorted_valid)
+    run_starts = sorted_run_starts(sorted_keys[:num_pks], sorted_valid)
     run_ids = jnp.cumsum(run_starts.astype(jnp.int32)) - 1
     num_runs = jnp.sum(run_starts.astype(jnp.int32))
 
@@ -70,7 +69,9 @@ def _merge_dedup_impl(cols: tuple, n_valid: jax.Array, num_pks: int, num_keys: i
     last_idx = jax.ops.segment_max(masked_iota, safe_run_ids, num_segments=capacity)
     gather_idx = jnp.clip(last_idx, 0, capacity - 1)
 
-    out_cols = tuple(c[gather_idx] for c in sorted_cols)
+    # compose the two gathers: original row of the winning sorted position
+    src_rows = perm[gather_idx]
+    out_cols = tuple(c[src_rows] for c in cols)
     out_valid = iota < num_runs
     return out_cols, out_valid, num_runs
 
