@@ -92,12 +92,23 @@ class ManifestConfig:
 
 
 @dataclass
+class ScanConfig:
+    """Device scan execution knobs (no reference analogue — the TPU
+    build's HBM-budget control, SURVEY.md hard part #5)."""
+
+    # max rows per compiled device window; segments larger than this are
+    # processed as PK-range-partitioned windows
+    max_window_rows: int = 1 << 20
+
+
+@dataclass
 class StorageConfig:
     """Top-level engine config (ref: config.rs:157-164)."""
 
     write: WriteConfig = field(default_factory=WriteConfig)
     manifest: ManifestConfig = field(default_factory=ManifestConfig)
     scheduler: SchedulerConfig = field(default_factory=SchedulerConfig)
+    scan: ScanConfig = field(default_factory=ScanConfig)
     update_mode: UpdateMode = UpdateMode.OVERWRITE
 
 
@@ -109,6 +120,7 @@ _NESTED = {
     "write": WriteConfig,
     "manifest": ManifestConfig,
     "scheduler": SchedulerConfig,
+    "scan": ScanConfig,
 }
 
 

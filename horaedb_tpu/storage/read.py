@@ -162,31 +162,74 @@ class ParquetReader:
         return [n for n in self.schema.primary_key_names if n in present]
 
     def _merge_on_device(self, batch: pa.RecordBatch, seg: SegmentPlan,
-                         plan: ScanPlan) -> pa.RecordBatch:
-        dev = encode.encode_batch(batch, device_put=jax.device_put)
+                         plan: ScanPlan) -> Optional[pa.RecordBatch]:
+        """Device merge with bounded HBM: segments above
+        scan.max_window_rows are split into PK-code-range windows, each a
+        complete set of PK groups, merged independently and concatenated
+        in order (windows are PK-ascending, so global order is preserved).
+        The streaming analogue of the reference's pull-based MergeStream
+        (SURVEY.md hard part #5)."""
+        dev = encode.encode_batch(batch)  # host-resident numpy columns
         pk_names = self._pk_names_in(batch.schema.names)
         ensure(len(pk_names) == self.schema.num_primary_keys,
                "projection lost primary key columns")
-        n_valid = dev.n_valid
-        pks = tuple(dev.columns[n] for n in pk_names)
-        seq = dev.columns[SEQ_COLUMN_NAME]
         value_names = [n for n in batch.schema.names
                        if n not in pk_names and n != SEQ_COLUMN_NAME]
-        values = tuple(dev.columns[n] for n in value_names)
+        n = dev.n_valid
+        host_cols = {name: np.asarray(c)[:n] for name, c in dev.columns.items()}
+
+        window = self.config.scan.max_window_rows
+        if n <= window:
+            selections: list[Optional[np.ndarray]] = [None]
+        else:
+            selections = _plan_pk_windows(host_cols[pk_names[0]], window)
+
+        out_names = list(batch.schema.names)  # preserve projection order
+        parts: list[pa.RecordBatch] = []
+        for sel in selections:
+            if sel is None:
+                # single-window fast path: encode_batch already padded
+                padded, n_win, cap = dev.columns, n, dev.capacity
+            else:
+                sub = {k: v[sel] for k, v in host_cols.items()}
+                n_win = len(sel)
+                cap = encode.pad_capacity(n_win)
+                padded = {k: np.pad(v, (0, cap - n_win))
+                          for k, v in sub.items()}
+            part = self._merge_window(padded, n_win, cap, pk_names,
+                                      value_names, dev.encodings, out_names,
+                                      plan)
+            if part is not None and part.num_rows:
+                parts.append(part)
+        if not parts:
+            return None
+        if len(parts) == 1:
+            return parts[0]
+        return pa.Table.from_batches(parts).combine_chunks().to_batches()[0]
+
+    def _merge_window(self, padded_cols: dict, n: int, cap: int,
+                      pk_names: list[str], value_names: list[str],
+                      encodings: dict, out_names: list[str],
+                      plan: ScanPlan) -> Optional[pa.RecordBatch]:
+        if n == 0:
+            return None
+        dev_cols = {name: jax.device_put(c) for name, c in padded_cols.items()}
+        pks = tuple(dev_cols[name] for name in pk_names)
+        seq = dev_cols[SEQ_COLUMN_NAME]
+        values = tuple(dev_cols[name] for name in value_names)
         out_pks, out_seq, out_values, out_valid, num_runs = \
-            merge_ops.merge_dedup_last(pks, seq, values, n_valid)
+            merge_ops.merge_dedup_last(pks, seq, values, n)
 
         k = int(num_runs)
         out_batch = encode.DeviceBatch(
-            columns={**{n: a for n, a in zip(pk_names, out_pks)},
+            columns={**{name: a for name, a in zip(pk_names, out_pks)},
                      SEQ_COLUMN_NAME: out_seq,
-                     **{n: a for n, a in zip(value_names, out_values)}},
-            encodings=dev.encodings, n_valid=k, capacity=dev.capacity)
+                     **{name: a for name, a in zip(value_names, out_values)}},
+            encodings=encodings, n_valid=k, capacity=cap)
 
         # Predicates apply AFTER dedup: filtering before would break
         # last-value semantics when the predicate touches value columns
         # (a filtered-out newer row must still shadow an older row).
-        out_names = list(batch.schema.names)  # preserve projection order
         if plan.predicate is not None:
             mask = filter_ops.eval_predicate(plan.predicate, out_batch)
             sel = np.flatnonzero(np.asarray(mask)[:k])
@@ -211,6 +254,37 @@ class ParquetReader:
             mask = _eval_predicate_host(plan.predicate, merged)
             merged = merged.filter(pa.array(mask))
         return merged
+
+
+def _plan_pk_windows(pk1_codes: np.ndarray, window: int) -> list[np.ndarray]:
+    """Partition rows into PK-range windows of <= `window` rows.
+
+    Rows sharing a first-PK code always land in one window (dedup only
+    needs equal-PK rows co-located; later PK columns refine within a
+    code).  Greedy packing over the contiguous code histogram; a single
+    code with more rows than `window` gets a window of its own (which may
+    exceed the budget — correctness over the soft limit).  Windows are
+    code-ascending, so concatenated outputs stay globally PK-sorted.
+    """
+    # factorize to dense ranks: cost scales with DISTINCT keys, not the
+    # code value span (offset-encoded int PKs can span ~2^31 sparsely)
+    _, inv, counts = np.unique(pk1_codes, return_inverse=True,
+                               return_counts=True)
+    order = np.argsort(inv, kind="stable")
+    boundaries = np.concatenate([[0], np.cumsum(counts)])
+    windows: list[np.ndarray] = []
+    start_key = 0
+    acc = 0
+    for key in range(len(counts)):
+        c = int(counts[key])
+        if acc and acc + c > window:
+            windows.append(order[boundaries[start_key]:boundaries[key]])
+            start_key = key
+            acc = 0
+        acc += c
+    if acc:
+        windows.append(order[boundaries[start_key]:])
+    return [w for w in windows if len(w)]
 
 
 def _eval_predicate_host(pred, batch: pa.RecordBatch) -> np.ndarray:
