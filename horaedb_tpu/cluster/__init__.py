@@ -1,0 +1,21 @@
+"""Sharding / scale-out layer (ref: RFC 20240827:20-76, SURVEY.md P6).
+
+The reference designs (but does not implement) a range-partitioned
+cluster: one `root` super-table whose rows are series, range-partitioned
+by hash(metric + sorted_tags); a Region is the shard unit; partition
+split rules carry per-rule TTLs so writes route by the freshest rule
+while queries fan out to every rule whose lifetime intersects the query
+window.  This module implements that design over in-process MetricEngine
+regions; a multi-host deployment swaps RegionBackend for an HTTP client
+speaking the server's /write + /query endpoints (DCN plane).
+"""
+
+from horaedb_tpu.cluster.router import (
+    MAX_TTL,
+    PartitionRule,
+    RoutingTable,
+    routing_key,
+)
+from horaedb_tpu.cluster.cluster import Cluster
+
+__all__ = ["Cluster", "MAX_TTL", "PartitionRule", "RoutingTable", "routing_key"]

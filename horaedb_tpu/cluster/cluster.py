@@ -1,0 +1,117 @@
+"""Cluster facade: routed writes + scatter-gather queries over regions.
+
+Each region is a full MetricEngine (its own tables, manifest, compaction)
+under `{root}/region_{id}`.  Series are partitioned by routing_key, so in
+a steady-state layout each series lives in one region and gather is a
+plain concatenation.  During a split's TTL window the SAME series can
+have pre-split rows in the old region and post-split rows in the new one
+— rows for one tsid may then arrive from two regions (still no duplicate
+(series, timestamp) points, since each write went to exactly one region);
+consumers must not assume per-region series disjointness until the old
+rule ages out.  (The reference's legacy system forwards via HoraeMeta +
+gRPC the same way, SURVEY.md P6.)
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+import pyarrow as pa
+
+from horaedb_tpu.common.error import ensure
+from horaedb_tpu.common.time_ext import now_ms
+from horaedb_tpu.cluster.router import RoutingTable, routing_key
+from horaedb_tpu.metric_engine import MetricEngine, Sample
+from horaedb_tpu.objstore import ObjectStore
+from horaedb_tpu.storage.config import StorageConfig
+from horaedb_tpu.storage.types import TimeRange
+
+
+class Cluster:
+    def __init__(self, regions: dict[int, MetricEngine],
+                 routing: RoutingTable, root_path: str, store: ObjectStore,
+                 segment_ms: int, config: Optional[StorageConfig]):
+        self.regions = regions
+        self.routing = routing
+        self._root_path = root_path
+        self._store = store
+        self._segment_ms = segment_ms
+        self._config = config
+
+    @classmethod
+    async def open(cls, root_path: str, store: ObjectStore,
+                   num_regions: int = 2,
+                   segment_ms: int = 2 * 3600 * 1000,
+                   config: Optional[StorageConfig] = None,
+                   routing: Optional[RoutingTable] = None) -> "Cluster":
+        routing = routing or RoutingTable.uniform(list(range(num_regions)))
+        regions = {}
+        for rid in routing.region_ids():
+            regions[rid] = await MetricEngine.open(
+                f"{root_path}/region_{rid}", store, segment_ms=segment_ms,
+                config=config)
+        return cls(regions, routing, root_path, store, segment_ms, config)
+
+    async def close(self) -> None:
+        for e in self.regions.values():
+            await e.close()
+
+    async def add_region(self, region_id: int) -> None:
+        """Provision the engine for a region created by a split; layout
+        parameters come from the cluster so regions can't diverge."""
+        ensure(region_id not in self.regions, f"region {region_id} exists")
+        self.regions[region_id] = await MetricEngine.open(
+            f"{self._root_path}/region_{region_id}", self._store,
+            segment_ms=self._segment_ms, config=self._config)
+
+    # ---- write ------------------------------------------------------------
+
+    async def write(self, samples: list[Sample]) -> None:
+        now = now_ms()
+        by_region: dict[int, list[Sample]] = {}
+        for s in samples:
+            rid = self.routing.route_write(
+                routing_key(s.name, s.labels), now)
+            by_region.setdefault(rid, []).append(s)
+        # validate every target BEFORE writing anything: a region created
+        # by split() must be provisioned via add_region() first, and a
+        # partial multi-region write would be hard to unwind
+        missing = [rid for rid in by_region if rid not in self.regions]
+        ensure(not missing,
+               f"routing targets unprovisioned regions {missing}; call "
+               "add_region() after split()")
+        await asyncio.gather(*(
+            self.regions[rid].write(batch)
+            for rid, batch in by_region.items()))
+
+    # ---- read (scatter-gather) --------------------------------------------
+
+    def _query_regions(self, metric: str, filters: list[tuple[str, str]],
+                       time_range: TimeRange) -> list[int]:
+        # a query pins to one key only if the filters form a full series
+        # key, which we can't know without the schema — so fan out to all
+        # rules alive for the window (RFC accepts全 Region scatter)
+        return self.routing.route_query(None, int(time_range.start),
+                                        int(time_range.end))
+
+    async def query(self, metric: str, filters: list[tuple[str, str]],
+                    time_range: TimeRange, field: str = "value") -> pa.Table:
+        rids = self._query_regions(metric, filters, time_range)
+        tables = await asyncio.gather(*(
+            self.regions[rid].query(metric, filters, time_range, field=field)
+            for rid in rids if rid in self.regions))
+        # all regions share one result schema, so concat handles the
+        # empty case too — no refetch needed
+        return pa.concat_tables(tables)
+
+    async def label_values(self, metric: str, tag_key: str,
+                           time_range: TimeRange) -> list[str]:
+        rids = self._query_regions(metric, [], time_range)
+        results = await asyncio.gather(*(
+            self.regions[rid].label_values(metric, tag_key, time_range)
+            for rid in rids if rid in self.regions))
+        out: set[str] = set()
+        for r in results:
+            out.update(r)
+        return sorted(out)

@@ -1,0 +1,173 @@
+"""Cluster layer tests (ref design: RFC 20240827:20-76 — range partition,
+split rules with TTL, scatter-gather)."""
+
+import asyncio
+
+import pytest
+
+from horaedb_tpu.cluster import (
+    MAX_TTL,
+    Cluster,
+    PartitionRule,
+    RoutingTable,
+    routing_key,
+)
+from horaedb_tpu.common import Error
+from horaedb_tpu.metric_engine import Label, Sample
+from horaedb_tpu.objstore import MemoryObjectStore
+from horaedb_tpu.storage.types import TimeRange
+
+T0 = 1_700_000_000_000
+HOUR = 3_600_000
+DAY = 24 * HOUR
+
+
+def sample(name, labels, ts, value):
+    return Sample(name=name, labels=[Label(k, v) for k, v in labels],
+                  timestamp=ts, value=value)
+
+
+class TestRoutingTable:
+    def test_uniform_covers_key_space(self):
+        rt = RoutingTable.uniform([0, 1, 2])
+        assert rt.rules[0].start_key == 0
+        assert rt.rules[-1].end_key == 1 << 63
+        for i in range(len(rt.rules) - 1):
+            assert rt.rules[i].end_key == rt.rules[i + 1].start_key
+
+    def test_route_write_stable(self):
+        rt = RoutingTable.uniform([0, 1, 2, 3])
+        key = routing_key("cpu", [Label("host", "web-1")])
+        r1 = rt.route_write(key, now_ms=T0)
+        # same series, labels in different order -> same region
+        key2 = routing_key("cpu", [Label("host", "web-1")])
+        assert rt.route_write(key2, now_ms=T0) == r1
+
+    def test_split_routing(self):
+        """RFC's split scenario: writes route to the new rule; queries
+        fan out to old + new until the old rule's TTL lapses."""
+        rt = RoutingTable.uniform([1])
+        pivot = 1 << 62
+        rt.split(region_id=1, pivot_key=pivot, new_region_id=4,
+                 now_ms=T0, table_ttl_ms=30 * DAY)
+        # writes below the pivot stay in region 1, above go to region 4
+        assert rt.route_write(pivot - 1, T0 + 1) == 1
+        assert rt.route_write(pivot + 1, T0 + 1) == 4
+        # query shortly after the split consults both (old rule alive)
+        assert set(rt.route_query(pivot + 1, T0 + HOUR, T0 + 2 * HOUR)) == {1, 4}
+        # query far beyond the TTL consults only the new region
+        late = T0 + 31 * DAY
+        assert rt.route_query(pivot + 1, late, late + HOUR) == [4]
+        assert rt.route_query(pivot - 1, late, late + HOUR) == [1]
+
+    def test_split_validations(self):
+        rt = RoutingTable.uniform([1])
+        with pytest.raises(Error, match="strictly inside"):
+            rt.split(1, 0, 2, T0, DAY)
+        with pytest.raises(Error, match="live rule"):
+            rt.split(9, 1 << 62, 2, T0, DAY)
+
+    def test_gc_expired(self):
+        rt = RoutingTable.uniform([1])
+        rt.split(1, 1 << 62, 2, now_ms=T0, table_ttl_ms=DAY)
+        assert len(rt.rules) == 3
+        dead = rt.gc_expired(T0 + 2 * DAY)
+        assert len(dead) == 1 and dead[0].ttl_expire_at == T0 + DAY
+        assert len(rt.rules) == 2
+        assert all(r.ttl_expire_at == MAX_TTL for r in rt.rules)
+
+    def test_write_after_all_rules_expired(self):
+        rt = RoutingTable(rules=[PartitionRule(0, 1 << 63, 1,
+                                               ttl_expire_at=T0)])
+        with pytest.raises(Error, match="no live partition rule"):
+            rt.route_write(5, T0 + 1)
+
+
+class TestCluster:
+    def test_partitioned_write_and_scatter_gather(self):
+        async def go():
+            c = await Cluster.open("cluster", MemoryObjectStore(),
+                                   num_regions=4, segment_ms=2 * HOUR)
+            try:
+                samples = [
+                    sample("cpu", [("host", f"h{i:03d}")], T0 + 1000, float(i))
+                    for i in range(64)
+                ]
+                await c.write(samples)
+                # series spread across regions
+                counts = []
+                rng = TimeRange.new(T0, T0 + HOUR)
+                for rid, engine in c.regions.items():
+                    t = await engine.query("cpu", [], rng)
+                    counts.append(t.num_rows)
+                assert sum(counts) == 64
+                assert sum(1 for n in counts if n > 0) >= 2  # actually sharded
+
+                # scatter-gather returns everything exactly once
+                t = await c.query("cpu", [], rng)
+                assert t.num_rows == 64
+                assert sorted(t.column("value").to_pylist()) == \
+                    [float(i) for i in range(64)]
+                # filtered query routes + gathers correctly
+                t = await c.query("cpu", [("host", "h007")], rng)
+                assert t.column("value").to_pylist() == [7.0]
+                # label_values unions across regions
+                vals = await c.label_values("cpu", "host", rng)
+                assert len(vals) == 64
+            finally:
+                await c.close()
+
+        asyncio.run(go())
+
+    def test_split_and_new_region(self):
+        async def go():
+            store = MemoryObjectStore()
+            c = await Cluster.open("cluster", store, num_regions=1,
+                                   segment_ms=2 * HOUR)
+            try:
+                await c.write([sample("cpu", [("host", "a")], T0 + 1000, 1.0)])
+                from horaedb_tpu.common.time_ext import now_ms
+                c.routing.split(0, 1 << 62, 1, now_ms(), 30 * DAY)
+                # writes BEFORE provisioning the new region fail loud
+                with pytest.raises(Error, match="unprovisioned"):
+                    await c.write([
+                        sample("cpu", [("host", f"y{i}")], T0 + 1500, 0.0)
+                        for i in range(32)
+                    ])
+                await c.add_region(1)
+                # writes land per the new routing; everything stays queryable
+                await c.write([
+                    sample("cpu", [("host", f"x{i}")], T0 + 2000, float(i))
+                    for i in range(32)
+                ])
+                t = await c.query("cpu", [], TimeRange.new(T0, T0 + HOUR))
+                assert t.num_rows == 33
+                r1 = await c.regions[1].query("cpu", [],
+                                              TimeRange.new(T0, T0 + HOUR))
+                assert r1.num_rows > 0  # the new region took real traffic
+            finally:
+                await c.close()
+
+        asyncio.run(go())
+
+
+class TestStrictTimeRouting:
+    def test_strict_prunes_post_window_rules(self):
+        rt = RoutingTable.uniform([1])
+        rt.strict_time_routing = True
+        pivot = 1 << 62
+        split_time = T0 + 10 * DAY
+        rt.split(1, pivot, 4, now_ms=split_time, table_ttl_ms=30 * DAY)
+        # historical window entirely before the split: only the old region
+        assert rt.route_query(pivot + 1, T0, T0 + DAY) == [1]
+        # window after the split: both (old rule still within TTL)
+        after = split_time + DAY
+        assert set(rt.route_query(pivot + 1, after, after + DAY)) == {1, 4}
+
+    def test_default_fan_out_tolerates_backfill(self):
+        rt = RoutingTable.uniform([1])
+        pivot = 1 << 62
+        rt.split(1, pivot, 4, now_ms=T0 + 10 * DAY, table_ttl_ms=30 * DAY)
+        # default (backfill-safe): historical window still consults the
+        # new region, where late-arriving old-timestamp writes now land
+        assert set(rt.route_query(pivot + 1, T0, T0 + DAY)) == {1, 4}
