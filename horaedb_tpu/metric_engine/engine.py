@@ -28,15 +28,13 @@ from __future__ import annotations
 import asyncio
 from typing import Optional
 
-import numpy as np
 import pyarrow as pa
 
 from horaedb_tpu.common.error import Error, ensure
 from horaedb_tpu.objstore import ObjectStore
 from horaedb_tpu.ops import And, Eq, In, TimeRangePred
-from horaedb_tpu.ops.downsample import time_bucket_aggregate
 from horaedb_tpu.storage.config import StorageConfig
-from horaedb_tpu.storage.read import ScanRequest
+from horaedb_tpu.storage.read import AggregateSpec, ScanRequest
 from horaedb_tpu.storage.storage import CloudObjectStorage, WriteRequest
 from horaedb_tpu.storage.types import TimeRange, Timestamp
 from horaedb_tpu.metric_engine.types import (
@@ -327,24 +325,35 @@ class MetricEngine:
 
     # ---- read -------------------------------------------------------------
 
-    async def query(self, metric: str, filters: list[tuple[str, str]],
-                    time_range: TimeRange, field: str = "value") -> pa.Table:
-        """Raw samples of one field of a metric matching all label filters,
-        as an Arrow table (tsid, timestamp, value)."""
+    async def _resolve_data_predicate(self, metric: str,
+                                      filters: list[tuple[str, str]],
+                                      time_range: TimeRange, field: str):
+        """Shared resolve + data-table predicate construction for both
+        raw and downsample queries; None means provably empty."""
         mid = await self.metric_manager.resolve(metric, time_range)
         if mid is None:
-            return _empty_result()
+            return None
         tsids = await self.index_manager.find_tsids(mid, filters, time_range)
         if tsids is not None and not tsids:
-            return _empty_result()
+            return None
         preds = [Eq("metric_id", mid),
                  Eq("field_id", field_id_of(field)),
                  TimeRangePred("timestamp", int(time_range.start),
                                int(time_range.end))]
         if tsids is not None:
             preds.append(In("tsid", sorted(tsids)))
+        return And(preds)
+
+    async def query(self, metric: str, filters: list[tuple[str, str]],
+                    time_range: TimeRange, field: str = "value") -> pa.Table:
+        """Raw samples of one field of a metric matching all label filters,
+        as an Arrow table (tsid, timestamp, value)."""
+        pred = await self._resolve_data_predicate(metric, filters,
+                                                 time_range, field)
+        if pred is None:
+            return _empty_result()
         batches = await _collect(self.tables["data"].scan(ScanRequest(
-            range=time_range, predicate=And(preds))))
+            range=time_range, predicate=pred)))
         if not batches:
             return _empty_result()
         tbl = pa.Table.from_batches(batches)
@@ -363,29 +372,29 @@ class MetricEngine:
                                filters: list[tuple[str, str]],
                                time_range: TimeRange, bucket_ms: int,
                                field: str = "value") -> dict:
-        """GROUP BY series, time(bucket) — the north-star query.  Returns
-        {tsid -> {agg -> list per bucket}} plus the bucket grid metadata."""
+        """GROUP BY series, time(bucket) — the north-star query, executed
+        as an aggregate pushdown: the data-table merge output is
+        downsampled on device without ever materializing rows as Arrow.
+        Returns {tsids, num_buckets, aggs: {agg -> (series, bucket) grid}}.
+        """
         span = int(time_range.end) - int(time_range.start)
         ensure(span < 2**31,
                f"query window of {span}ms exceeds the int32 offset range "
                "(~24.8 days); split the query into smaller windows")
-        tbl = await self.query(metric, filters, time_range, field=field)
-        n = tbl.num_rows
-        num_buckets = -(-(int(time_range.end) - int(time_range.start)) // bucket_ms)
-        if n == 0:
+        num_buckets = -(-span // bucket_ms)
+        pred = await self._resolve_data_predicate(metric, filters,
+                                                  time_range, field)
+        if pred is None:
             return {"tsids": [], "num_buckets": num_buckets, "aggs": {}}
-        tsid_np = tbl.column("tsid").to_numpy()
-        uniq_tsids, gid = np.unique(tsid_np, return_inverse=True)
-        ts_np = tbl.column("timestamp").to_numpy() - int(time_range.start)
-        val_np = tbl.column("value").to_numpy()
-        cap = 1 << max(7, (n - 1).bit_length())
-        pad = lambda a, d: np.pad(a.astype(d), (0, cap - n))
-        aggs = time_bucket_aggregate(
-            pad(ts_np, np.int32), pad(gid, np.int32), pad(val_np, np.float32),
-            n, bucket_ms, num_groups=len(uniq_tsids), num_buckets=num_buckets)
-        return {"tsids": [int(t) for t in uniq_tsids],
+        spec = AggregateSpec(group_col="tsid", ts_col="timestamp",
+                             value_col="value",
+                             range_start=int(time_range.start),
+                             bucket_ms=bucket_ms, num_buckets=num_buckets)
+        group_values, aggs = await self.tables["data"].scan_aggregate(
+            ScanRequest(range=time_range, predicate=pred), spec)
+        return {"tsids": [int(t) for t in group_values],
                 "num_buckets": num_buckets,
-                "aggs": {k: np.asarray(v) for k, v in aggs.items()}}
+                "aggs": aggs if len(group_values) else {}}
 
     async def label_values(self, metric: str, tag_key: str,
                            time_range: TimeRange) -> list[str]:

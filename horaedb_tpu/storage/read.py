@@ -25,6 +25,7 @@ tests, the analogue of the reference's DisplayableExecutionPlan test
 from __future__ import annotations
 
 import asyncio
+import functools
 import time
 from dataclasses import dataclass
 from typing import AsyncIterator, Optional
@@ -33,6 +34,7 @@ import numpy as np
 import pyarrow as pa
 
 import jax
+import jax.numpy as jnp
 
 from horaedb_tpu.common.error import ensure
 from horaedb_tpu.objstore import ObjectStore
@@ -63,6 +65,20 @@ class ScanRequest:
     predicate: Optional[filter_ops.Predicate] = None
     # indexes into the FULL storage schema (user columns + builtins)
     projections: Optional[list[int]] = None
+
+
+@dataclass
+class AggregateSpec:
+    """Downsample pushdown: GROUP BY group_col, time(bucket) computed on
+    device straight from the merge output — no Arrow materialization and
+    no host re-encode on the north-star query path."""
+
+    group_col: str
+    ts_col: str
+    value_col: str
+    range_start: int  # host-time of bucket 0
+    bucket_ms: int
+    num_buckets: int
 
 
 @dataclass
@@ -161,14 +177,16 @@ class ParquetReader:
         present = set(columns)
         return [n for n in self.schema.primary_key_names if n in present]
 
-    def _merge_on_device(self, batch: pa.RecordBatch, seg: SegmentPlan,
-                         plan: ScanPlan) -> Optional[pa.RecordBatch]:
+    def _merged_windows(self, batch: pa.RecordBatch, plan: ScanPlan):
         """Device merge with bounded HBM: segments above
         scan.max_window_rows are split into PK-code-range windows, each a
-        complete set of PK groups, merged independently and concatenated
-        in order (windows are PK-ascending, so global order is preserved).
-        The streaming analogue of the reference's pull-based MergeStream
-        (SURVEY.md hard part #5)."""
+        complete set of PK groups, merged independently in key order
+        (windows are PK-ascending, so global order is preserved).  The
+        streaming analogue of the reference's pull-based MergeStream
+        (SURVEY.md hard part #5).  Yields post-dedup DeviceBatches —
+        consumers decode to Arrow (row scan) or aggregate in place
+        (pushdown path) without leaving the device.
+        """
         dev = encode.encode_batch(batch)  # host-resident numpy columns
         pk_names = self._pk_names_in(batch.schema.names)
         ensure(len(pk_names) == self.schema.num_primary_keys,
@@ -184,8 +202,6 @@ class ParquetReader:
         else:
             selections = _plan_pk_windows(host_cols[pk_names[0]], window)
 
-        out_names = list(batch.schema.names)  # preserve projection order
-        parts: list[pa.RecordBatch] = []
         for sel in selections:
             if sel is None:
                 # single-window fast path: encode_batch already padded
@@ -196,9 +212,26 @@ class ParquetReader:
                 cap = encode.pad_capacity(n_win)
                 padded = {k: np.pad(v, (0, cap - n_win))
                           for k, v in sub.items()}
-            part = self._merge_window(padded, n_win, cap, pk_names,
-                                      value_names, dev.encodings, out_names,
-                                      plan)
+            if n_win == 0:
+                continue
+            dev_cols = {name: jax.device_put(c) for name, c in padded.items()}
+            pks = tuple(dev_cols[name] for name in pk_names)
+            seq = dev_cols[SEQ_COLUMN_NAME]
+            values = tuple(dev_cols[name] for name in value_names)
+            out_pks, out_seq, out_values, _out_valid, num_runs = \
+                merge_ops.merge_dedup_last(pks, seq, values, n_win)
+            yield encode.DeviceBatch(
+                columns={**{name: a for name, a in zip(pk_names, out_pks)},
+                         SEQ_COLUMN_NAME: out_seq,
+                         **{name: a for name, a in zip(value_names, out_values)}},
+                encodings=dev.encodings, n_valid=int(num_runs), capacity=cap)
+
+    def _merge_on_device(self, batch: pa.RecordBatch, seg: SegmentPlan,
+                         plan: ScanPlan) -> Optional[pa.RecordBatch]:
+        out_names = list(batch.schema.names)  # preserve projection order
+        parts: list[pa.RecordBatch] = []
+        for out_batch in self._merged_windows(batch, plan):
+            part = self._window_to_arrow(out_batch, out_names, plan)
             if part is not None and part.num_rows:
                 parts.append(part)
         if not parts:
@@ -207,35 +240,88 @@ class ParquetReader:
             return parts[0]
         return pa.Table.from_batches(parts).combine_chunks().to_batches()[0]
 
-    def _merge_window(self, padded_cols: dict, n: int, cap: int,
-                      pk_names: list[str], value_names: list[str],
-                      encodings: dict, out_names: list[str],
-                      plan: ScanPlan) -> Optional[pa.RecordBatch]:
-        if n == 0:
-            return None
-        dev_cols = {name: jax.device_put(c) for name, c in padded_cols.items()}
-        pks = tuple(dev_cols[name] for name in pk_names)
-        seq = dev_cols[SEQ_COLUMN_NAME]
-        values = tuple(dev_cols[name] for name in value_names)
-        out_pks, out_seq, out_values, out_valid, num_runs = \
-            merge_ops.merge_dedup_last(pks, seq, values, n)
-
-        k = int(num_runs)
-        out_batch = encode.DeviceBatch(
-            columns={**{name: a for name, a in zip(pk_names, out_pks)},
-                     SEQ_COLUMN_NAME: out_seq,
-                     **{name: a for name, a in zip(value_names, out_values)}},
-            encodings=encodings, n_valid=k, capacity=cap)
-
+    def _window_to_arrow(self, out_batch: encode.DeviceBatch,
+                         out_names: list[str],
+                         plan: ScanPlan) -> Optional[pa.RecordBatch]:
         # Predicates apply AFTER dedup: filtering before would break
         # last-value semantics when the predicate touches value columns
         # (a filtered-out newer row must still shadow an older row).
+        k = out_batch.n_valid
         if plan.predicate is not None:
             mask = filter_ops.eval_predicate(plan.predicate, out_batch)
             sel = np.flatnonzero(np.asarray(mask)[:k])
             arrow = encode.decode_to_arrow(out_batch, names=out_names)
             return arrow.take(pa.array(sel))
         return encode.decode_to_arrow(out_batch, names=out_names)
+
+    # ---- aggregate pushdown ------------------------------------------------
+
+    async def execute_aggregate(self, plan: ScanPlan, spec: AggregateSpec
+                                ) -> tuple[np.ndarray, dict]:
+        """Run the merge + downsample entirely on device, returning
+        (group_values, finalized grids) combined across all segments and
+        windows.  group_values are decoded host values (e.g. tsids) in
+        sorted order; each grid is (len(group_values), num_buckets)."""
+        ensure(plan.mode is UpdateMode.OVERWRITE,
+               "aggregate pushdown requires Overwrite mode")
+        # overlap object-store I/O across segments; aggregation itself
+        # proceeds in segment order so `last` tie-breaks stay deterministic
+        tables = await asyncio.gather(
+            *(self._read_segment_table(seg) for seg in plan.segments))
+        parts: list[tuple[np.ndarray, dict]] = []
+        for table in tables:
+            if table.num_rows == 0:
+                continue
+            t0 = time.perf_counter()
+            batch = table.combine_chunks().to_batches()[0]
+            for out_batch in self._merged_windows(batch, plan):
+                part = self._aggregate_window(out_batch, spec, plan)
+                if part is not None:
+                    parts.append(part)
+            _SCAN_LATENCY.observe(time.perf_counter() - t0)
+            _ROWS_SCANNED.inc(table.num_rows)
+        return combine_aggregate_parts(parts, spec.num_buckets)
+
+    def _aggregate_window(self, out_batch: encode.DeviceBatch,
+                          spec: AggregateSpec,
+                          plan: ScanPlan) -> Optional[tuple[np.ndarray, dict]]:
+        k = out_batch.n_valid
+        cap = out_batch.capacity
+        if k == 0:
+            return None
+        keep = np.arange(cap) < k
+        if plan.predicate is not None:
+            mask = filter_ops.eval_predicate(plan.predicate, out_batch)
+            keep &= np.asarray(mask)
+
+        # dense group ids: one int32 column roundtrips to host (cheap),
+        # values/timestamps stay on device
+        codes = np.asarray(out_batch.columns[spec.group_col])
+        sel_codes = codes[keep]
+        if len(sel_codes) == 0:
+            return None
+        uniq, dense = np.unique(sel_codes, return_inverse=True)
+        gid_full = np.full(cap, -1, dtype=np.int32)
+        gid_full[keep] = dense.astype(np.int32)
+
+        ts_enc = out_batch.encodings[spec.ts_col]
+        ensure(ts_enc.kind in ("offset", "numeric"),
+               f"aggregate needs arithmetic timestamps, got "
+               f"{ts_enc.kind!r} encoding for {spec.ts_col!r}")
+        shift = ts_enc.epoch - spec.range_start  # host_ts = dev_ts + epoch
+        ensure(abs(shift) < 2**31, "query range too far from segment epoch")
+
+        g_pad = max(8, 1 << (len(uniq) - 1).bit_length())
+        partial = _partial_aggregate_jit(
+            out_batch.columns[spec.ts_col], jnp.asarray(gid_full),
+            out_batch.columns[spec.value_col],
+            jnp.int32(cap), jnp.int32(shift), jnp.int32(spec.bucket_ms),
+            num_groups=g_pad, num_buckets=spec.num_buckets)
+        host_partial = {name: np.asarray(a)[: len(uniq)]
+                        for name, a in partial.items()}
+        group_values = _decode_group_values(
+            uniq, out_batch.encodings[spec.group_col])
+        return group_values, host_partial
 
     def _merge_on_host(self, batch: pa.RecordBatch,
                        plan: ScanPlan) -> pa.RecordBatch:
@@ -254,6 +340,77 @@ class ParquetReader:
             mask = _eval_predicate_host(plan.predicate, merged)
             merged = merged.filter(pa.array(mask))
         return merged
+
+
+@functools.partial(jax.jit, static_argnames=("num_groups", "num_buckets"))
+def _partial_aggregate_jit(ts, gid, vals, n_valid, shift, bucket_ms,
+                           num_groups: int, num_buckets: int):
+    from horaedb_tpu.ops import downsample
+
+    return downsample.partial_aggregate(
+        ts + shift, gid, vals, n_valid, bucket_ms,
+        num_groups=num_groups, num_buckets=num_buckets)
+
+
+def _decode_group_values(codes: np.ndarray, enc) -> np.ndarray:
+    """Device group codes -> host values (dictionary entries / epoch
+    shift), in the same (sorted) order as the codes."""
+    if enc.kind == "dict":
+        return enc.dictionary[codes]
+    if enc.kind == "offset":
+        return codes.astype(np.int64) + enc.epoch
+    return codes
+
+
+def combine_aggregate_parts(parts: list[tuple[np.ndarray, dict]],
+                            num_buckets: int) -> tuple[np.ndarray, dict]:
+    """Combine per-window partial grids (from disjoint-or-overlapping
+    group sets) into one finalized grid, keyed by the union of group
+    values.  Grids are small (groups x buckets), so this is cheap host
+    numpy.  `last` combines by latest timestamp, later part winning ties
+    (parts arrive in segment/window order)."""
+    if not parts:
+        empty = np.zeros((0, num_buckets), dtype=np.float32)
+        return np.asarray([]), {k: empty.copy() for k in
+                                ("count", "sum", "min", "max", "avg", "last")}
+    all_values = np.unique(np.concatenate([v for v, _ in parts]))
+    g = len(all_values)
+    acc = {
+        "count": np.zeros((g, num_buckets), dtype=np.float64),
+        "sum": np.zeros((g, num_buckets), dtype=np.float64),
+        "min": np.full((g, num_buckets), np.inf, dtype=np.float64),
+        "max": np.full((g, num_buckets), -np.inf, dtype=np.float64),
+        "last": np.zeros((g, num_buckets), dtype=np.float64),
+        "last_ts": np.full((g, num_buckets), np.iinfo(np.int64).min,
+                           dtype=np.int64),
+    }
+    for values, p in parts:
+        rows = np.searchsorted(all_values, values)
+        acc["count"][rows] += p["count"]
+        acc["sum"][rows] += p["sum"]
+        acc["min"][rows] = np.minimum(acc["min"][rows], p["min"])
+        acc["max"][rows] = np.maximum(acc["max"][rows], p["max"])
+        newer = p["last_ts"].astype(np.int64) >= acc["last_ts"][rows]
+        has_data = p["count"] > 0
+        take = newer & has_data
+        last_rows = acc["last"][rows]
+        last_rows[take] = p["last"][take]
+        acc["last"][rows] = last_rows
+        lt_rows = acc["last_ts"][rows]
+        lt_rows[take] = p["last_ts"].astype(np.int64)[take]
+        acc["last_ts"][rows] = lt_rows
+    empty = acc["count"] == 0
+    with np.errstate(invalid="ignore", divide="ignore"):
+        avg = np.where(empty, np.nan, acc["sum"] / np.maximum(acc["count"], 1))
+    out = {
+        "count": acc["count"],
+        "sum": acc["sum"],
+        "min": acc["min"],
+        "max": acc["max"],
+        "avg": avg,
+        "last": np.where(empty, np.nan, acc["last"]),
+    }
+    return all_values, out
 
 
 def _plan_pk_windows(pk1_codes: np.ndarray, window: int) -> list[np.ndarray]:

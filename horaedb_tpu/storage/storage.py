@@ -162,6 +162,12 @@ class CloudObjectStorage(TimeMergeStorage):
         async for batch in self.reader.execute(plan):
             yield batch
 
+    async def scan_aggregate(self, req: ScanRequest, spec):
+        """Downsample pushdown: merge + GROUP BY group_col, time(bucket)
+        on device; returns (group_values, grids).  See read.AggregateSpec."""
+        plan = await self.build_scan_plan(req)
+        return await self.reader.execute_aggregate(plan, spec)
+
     async def build_scan_plan(self, req: ScanRequest,
                               keep_builtin: bool = False) -> ScanPlan:
         ensure(self.manifest is not None, "storage not opened")

@@ -329,3 +329,64 @@ class TestReviewRegressions:
                 await e.close()
 
         asyncio.run(go())
+
+
+class TestAggregatePushdown:
+    def test_multi_segment_downsample_combines(self):
+        """Series spanning segments: per-segment partial grids must
+        combine into one correct result (incl. last across segments)."""
+
+        async def go():
+            e = await open_engine()
+            try:
+                samples = []
+                # segment 1: ts in [T0, ...); segment 2: +2h
+                for seg_base, off in [(T0, 0.0), (T0 + 2 * HOUR, 100.0)]:
+                    for host in ["a", "b"]:
+                        for i in range(6):
+                            samples.append(sample(
+                                "cpu", [("host", host)],
+                                seg_base + i * 60_000,
+                                off + (10.0 if host == "a" else 50.0) + i))
+                await e.write(samples)
+                rng = TimeRange.new(T0, T0 + 2 * HOUR + 600_000)
+                out = await e.query_downsample("cpu", [], rng,
+                                               bucket_ms=HOUR)
+                assert len(out["tsids"]) == 2
+                aggs = out["aggs"]
+                assert out["num_buckets"] == 3
+                # bucket 0 holds segment-1 points, bucket 2 segment-2 points
+                np.testing.assert_array_equal(aggs["count"][:, 0], [6, 6])
+                np.testing.assert_array_equal(aggs["count"][:, 1], [0, 0])
+                np.testing.assert_array_equal(aggs["count"][:, 2], [6, 6])
+                by = dict(zip(out["tsids"], range(2)))
+                a_row = by[tsid_of("cpu", [Label("host", "a")])]
+                # segment 1 values: 10..15 -> sum 75; segment 2: 110..115
+                assert aggs["sum"][a_row, 0] == 75.0
+                assert aggs["sum"][a_row, 2] == 675.0
+                # last of the whole range comes from segment 2's final point
+                assert aggs["last"][a_row, 2] == 115.0
+                assert np.isnan(aggs["avg"][a_row, 1])
+                assert aggs["min"][a_row, 0] == 10.0
+                assert aggs["max"][a_row, 2] == 115.0
+            finally:
+                await e.close()
+
+        asyncio.run(go())
+
+    def test_pushdown_respects_label_filter(self):
+        async def go():
+            e = await open_engine()
+            try:
+                for host, v in [("a", 1.0), ("b", 2.0)]:
+                    await e.write([sample("cpu", [("host", host)],
+                                          T0 + 1000, v)])
+                out = await e.query_downsample(
+                    "cpu", [("host", "b")], TimeRange.new(T0, T0 + HOUR),
+                    bucket_ms=HOUR)
+                assert out["tsids"] == [tsid_of("cpu", [Label("host", "b")])]
+                assert out["aggs"]["sum"][0, 0] == 2.0
+            finally:
+                await e.close()
+
+        asyncio.run(go())
