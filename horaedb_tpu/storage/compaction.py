@@ -42,7 +42,7 @@ from horaedb_tpu.storage.types import (
 if TYPE_CHECKING:
     from horaedb_tpu.storage.storage import CloudObjectStorage
 
-from horaedb_tpu.utils import registry
+from horaedb_tpu.utils import registry, span
 
 logger = logging.getLogger(__name__)
 
@@ -185,6 +185,11 @@ class Executor:
                 self._unmark(task)
 
     async def _do_compaction(self, task: Task) -> None:
+        with span("compaction.execute", inputs=len(task.inputs),
+                  expireds=len(task.expireds), bytes=task.input_size):
+            await self._do_compaction_traced(task)
+
+    async def _do_compaction_traced(self, task: Task) -> None:
         self._trigger_more()
         storage = self.storage
         time_range = task.inputs[0].meta.time_range

@@ -30,6 +30,7 @@ from horaedb_tpu.storage.manifest.encoding import (
 )
 from horaedb_tpu.storage.sst import FileId, FileMeta, SstFile
 from horaedb_tpu.storage.types import TimeRange
+from horaedb_tpu.utils import span
 
 logger = logging.getLogger(__name__)
 
@@ -116,6 +117,10 @@ class _Merger:
             await self._do_merge_locked(first_run)
 
     async def _do_merge_locked(self, first_run: bool) -> None:
+        with span("manifest.merge", first_run=first_run):
+            await self._do_merge_inner(first_run)
+
+    async def _do_merge_inner(self, first_run: bool) -> None:
         metas = await self.store.list(self.delta_dir + "/")
         paths = [m.path for m in metas]
         if not paths:

@@ -55,6 +55,8 @@ _SCAN_LATENCY = registry.histogram(
     "storage_scan_seconds", "merge-scan latency per segment")
 _ROWS_SCANNED = registry.counter(
     "storage_rows_scanned_total", "rows produced by merge-scan")
+# segment tables held in memory at once on the aggregate pushdown path
+_PREFETCH_SEGMENTS = 4
 
 
 @dataclass
@@ -177,7 +179,7 @@ class ParquetReader:
         present = set(columns)
         return [n for n in self.schema.primary_key_names if n in present]
 
-    def _merged_windows(self, batch: pa.RecordBatch, plan: ScanPlan):
+    def _merged_windows(self, batch: pa.RecordBatch):
         """Device merge with bounded HBM: segments above
         scan.max_window_rows are split into PK-code-range windows, each a
         complete set of PK groups, merged independently in key order
@@ -230,7 +232,7 @@ class ParquetReader:
                          plan: ScanPlan) -> Optional[pa.RecordBatch]:
         out_names = list(batch.schema.names)  # preserve projection order
         parts: list[pa.RecordBatch] = []
-        for out_batch in self._merged_windows(batch, plan):
+        for out_batch in self._merged_windows(batch):
             part = self._window_to_arrow(out_batch, out_names, plan)
             if part is not None and part.num_rows:
                 parts.append(part)
@@ -264,22 +266,39 @@ class ParquetReader:
         sorted order; each grid is (len(group_values), num_buckets)."""
         ensure(plan.mode is UpdateMode.OVERWRITE,
                "aggregate pushdown requires Overwrite mode")
-        # overlap object-store I/O across segments; aggregation itself
-        # proceeds in segment order so `last` tie-breaks stay deterministic
-        tables = await asyncio.gather(
-            *(self._read_segment_table(seg) for seg in plan.segments))
+        # bounded prefetch: overlap object-store I/O across segments while
+        # holding at most _PREFETCH_SEGMENTS tables in memory (released
+        # only after consumption); aggregation proceeds in segment order
+        # so `last` tie-breaks stay deterministic
+        sem = asyncio.Semaphore(_PREFETCH_SEGMENTS)
+
+        async def read(seg: SegmentPlan) -> pa.Table:
+            await sem.acquire()
+            return await self._read_segment_table(seg)
+
+        tasks = [asyncio.create_task(read(seg)) for seg in plan.segments]
         parts: list[tuple[np.ndarray, dict]] = []
-        for table in tables:
-            if table.num_rows == 0:
-                continue
-            t0 = time.perf_counter()
-            batch = table.combine_chunks().to_batches()[0]
-            for out_batch in self._merged_windows(batch, plan):
-                part = self._aggregate_window(out_batch, spec, plan)
-                if part is not None:
-                    parts.append(part)
-            _SCAN_LATENCY.observe(time.perf_counter() - t0)
-            _ROWS_SCANNED.inc(table.num_rows)
+        try:
+            for task in tasks:
+                t0 = time.perf_counter()
+                table = await task
+                try:
+                    if table.num_rows == 0:
+                        continue
+                    batch = table.combine_chunks().to_batches()[0]
+                    for out_batch in self._merged_windows(batch):
+                        part = self._aggregate_window(out_batch, spec, plan)
+                        if part is not None:
+                            parts.append(part)
+                        # same semantics as the row path: post-dedup rows
+                        _ROWS_SCANNED.inc(out_batch.n_valid)
+                finally:
+                    sem.release()
+                    # I/O-inclusive per-segment latency, like execute()
+                    _SCAN_LATENCY.observe(time.perf_counter() - t0)
+        finally:
+            for task in tasks:
+                task.cancel()
         return combine_aggregate_parts(parts, spec.num_buckets)
 
     def _aggregate_window(self, out_batch: encode.DeviceBatch,

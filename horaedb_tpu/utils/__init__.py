@@ -1,5 +1,7 @@
-"""Cross-cutting utilities: observability registry."""
+"""Cross-cutting utilities: observability registry + tracing spans."""
 
 from horaedb_tpu.utils.metrics import Counter, Histogram, MetricsRegistry, registry
+from horaedb_tpu.utils.tracing import current_span, span
 
-__all__ = ["Counter", "Histogram", "MetricsRegistry", "registry"]
+__all__ = ["Counter", "Histogram", "MetricsRegistry", "current_span",
+           "registry", "span"]

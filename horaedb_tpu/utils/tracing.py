@@ -1,0 +1,63 @@
+"""Structured tracing spans.
+
+The reference uses field-style tracing events (tracing + EnvFilter,
+SURVEY.md section 5) without spans; here spans are first-class: a
+context manager that logs enter/exit with duration and fields, nests via
+a contextvar, and feeds the metrics registry so every traced operation
+gets a latency histogram for free.
+
+    with span("compaction.execute", inputs=len(task.inputs)):
+        ...
+
+Env: HORAEDB_TRACE=1 promotes span logs from DEBUG to INFO.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import logging
+import os
+import time
+from typing import Iterator
+
+from horaedb_tpu.utils.metrics import registry
+
+logger = logging.getLogger("horaedb_tpu.trace")
+
+_current_span: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "horaedb_span", default="")
+
+_LEVEL = logging.INFO if os.environ.get("HORAEDB_TRACE") == "1" else logging.DEBUG
+
+
+def current_span() -> str:
+    """Dotted path of the active span ("" outside any span)."""
+    return _current_span.get()
+
+
+@contextlib.contextmanager
+def span(name: str, **fields) -> Iterator[None]:
+    parent = _current_span.get()
+    full = f"{parent}/{name}" if parent else name
+    token = _current_span.set(full)
+    t0 = time.perf_counter()
+    if logger.isEnabledFor(_LEVEL):
+        logger.log(_LEVEL, "-> %s %s", full,
+                   " ".join(f"{k}={v}" for k, v in fields.items()))
+    ok = False
+    try:
+        yield
+        ok = True
+    finally:
+        _current_span.reset(token)
+        elapsed = time.perf_counter() - t0
+        if logger.isEnabledFor(_LEVEL):
+            if ok:
+                logger.log(_LEVEL, "<- %s %.1fms", full, elapsed * 1e3)
+            else:
+                logger.log(_LEVEL, "<- %s FAILED after %.1fms", full,
+                           elapsed * 1e3)
+        # failures are observed too — failure-path tail latency matters
+        registry.histogram(f"span_{name.replace('.', '_')}_seconds",
+                           f"span {name} duration").observe(elapsed)

@@ -1,0 +1,225 @@
+"""Fault-injection tests for crash-consistency invariants.
+
+The reference has NO fault injection (SURVEY.md section 5); its safety
+story is order-of-operations discipline. These tests inject object-store
+failures at every discipline point and assert the invariants hold:
+
+  - an acknowledged write is durable and queryable after recovery
+  - a failed write leaves no manifest entry (no ghost files)
+  - a failed compaction unmarks inputs and loses nothing
+  - a crash between snapshot put and delta GC replays idempotently
+"""
+
+import asyncio
+
+import pyarrow as pa
+import pytest
+
+from horaedb_tpu.common import Error, ReadableDuration
+from horaedb_tpu.objstore import MemoryObjectStore
+from horaedb_tpu.storage.config import StorageConfig, from_dict
+from horaedb_tpu.storage.read import ScanRequest
+from horaedb_tpu.storage.storage import CloudObjectStorage, WriteRequest
+from horaedb_tpu.storage.types import TimeRange
+
+SEGMENT_MS = 3_600_000
+
+
+class FlakyStore(MemoryObjectStore):
+    """Injects one-shot failures keyed by (op, path-substring)."""
+
+    def __init__(self):
+        super().__init__()
+        self.failures: list[tuple[str, str]] = []
+
+    def fail_next(self, op: str, path_part: str) -> None:
+        self.failures.append((op, path_part))
+
+    def _maybe_fail(self, op: str, path: str) -> None:
+        for i, (fop, part) in enumerate(self.failures):
+            if fop == op and part in path:
+                del self.failures[i]
+                raise OSError(f"injected {op} failure for {path}")
+
+    async def put(self, path, data):
+        self._maybe_fail("put", path)
+        return await super().put(path, data)
+
+    async def get(self, path):
+        self._maybe_fail("get", path)
+        return await super().get(path)
+
+    async def delete(self, path):
+        self._maybe_fail("delete", path)
+        return await super().delete(path)
+
+
+def schema():
+    return pa.schema([("k", pa.string()), ("ts", pa.int64()),
+                      ("v", pa.float64())])
+
+
+def batch(rows):
+    k, t, v = zip(*rows)
+    return pa.record_batch([pa.array(list(k)), pa.array(list(t), type=pa.int64()),
+                            pa.array(list(v), type=pa.float64())],
+                           schema=schema())
+
+
+async def open_storage(store, **cfg_over):
+    cfg = from_dict(StorageConfig, {"scheduler": {"schedule_interval": "1h",
+                                                  **cfg_over}})
+    cfg.manifest.merge_interval = ReadableDuration.parse("1h")
+    return await CloudObjectStorage.open("db", SEGMENT_MS, store, schema(), 2,
+                                         cfg)
+
+
+async def scan_rows(s):
+    out = []
+    async for b in s.scan(ScanRequest(range=TimeRange.new(0, 10**10))):
+        out.extend(zip(b.column(0).to_pylist(), b.column(1).to_pylist(),
+                       b.column(2).to_pylist()))
+    return out
+
+
+class TestWriteFaults:
+    def test_failed_sst_put_leaves_no_ghost(self):
+        async def go():
+            store = FlakyStore()
+            s = await open_storage(store)
+            try:
+                await s.write(WriteRequest(batch([("a", 1, 1.0)]),
+                                           TimeRange.new(1, 2)))
+                store.fail_next("put", "/data/")
+                with pytest.raises(OSError):
+                    await s.write(WriteRequest(batch([("b", 2, 2.0)]),
+                                               TimeRange.new(2, 3)))
+                # the failed write is invisible; the earlier one survives
+                assert await scan_rows(s) == [("a", 1, 1.0)]
+                assert len(await s.manifest.all_ssts()) == 1
+                # and the engine still accepts new writes
+                await s.write(WriteRequest(batch([("c", 3, 3.0)]),
+                                           TimeRange.new(3, 4)))
+                assert len(await scan_rows(s)) == 2
+            finally:
+                await s.close()
+
+        asyncio.run(go())
+
+    def test_failed_delta_put_rolls_back_ack(self):
+        async def go():
+            store = FlakyStore()
+            s = await open_storage(store)
+            try:
+                store.fail_next("put", "/manifest/delta/")
+                with pytest.raises(OSError):
+                    await s.write(WriteRequest(batch([("a", 1, 1.0)]),
+                                               TimeRange.new(1, 2)))
+                # unacknowledged -> not visible (orphan SST object is
+                # acceptable garbage, never data)
+                assert await scan_rows(s) == []
+                assert s.manifest.deltas_num == 0  # counter rolled back
+            finally:
+                await s.close()
+
+        asyncio.run(go())
+
+    def test_acknowledged_writes_survive_recovery(self):
+        async def go():
+            store = FlakyStore()
+            s = await open_storage(store)
+            await s.write(WriteRequest(batch([("a", 1, 1.0)]),
+                                       TimeRange.new(1, 2)))
+            await s.write(WriteRequest(batch([("b", 2, 2.0)]),
+                                       TimeRange.new(2, 3)))
+            await s.close()  # crash with unmerged deltas
+
+            s2 = await open_storage(store)
+            try:
+                assert await scan_rows(s2) == [("a", 1, 1.0), ("b", 2, 2.0)]
+            finally:
+                await s2.close()
+
+        asyncio.run(go())
+
+
+class TestCompactionFaults:
+    async def _setup(self, store):
+        s = await open_storage(store, input_sst_min_num=2)
+        for i in range(3):
+            await s.write(WriteRequest(batch([("k", 1, float(i))]),
+                                       TimeRange.new(1, 2)))
+        return s
+
+    def test_failed_output_put_unmarks_and_recovers(self):
+        async def go():
+            store = FlakyStore()
+            s = await self._setup(store)
+            try:
+                task = await s.compact_scheduler.picker.pick_candidate()
+                assert task is not None
+                store.fail_next("put", "/data/")
+                with pytest.raises(OSError):
+                    await s.compact_scheduler.executor.execute(task)
+                # inputs unmarked -> re-pickable; memory accounting intact
+                assert all(not f.in_compaction for f in task.inputs)
+                assert s.compact_scheduler.executor.inused_memory == 0
+                assert await scan_rows(s) == [("k", 1, 2.0)]
+                # retry succeeds
+                task2 = await s.compact_scheduler.picker.pick_candidate()
+                await s.compact_scheduler.executor.execute(task2)
+                assert len(await s.manifest.all_ssts()) == 1
+                assert await scan_rows(s) == [("k", 1, 2.0)]
+            finally:
+                await s.close()
+
+        asyncio.run(go())
+
+    def test_failed_input_delete_is_tolerated(self):
+        """Old objects may leak; data must not duplicate or vanish."""
+
+        async def go():
+            store = FlakyStore()
+            s = await self._setup(store)
+            try:
+                task = await s.compact_scheduler.picker.pick_candidate()
+                store.fail_next("delete", "/data/")
+                await s.compact_scheduler.executor.execute(task)  # no raise
+                assert len(await s.manifest.all_ssts()) == 1
+                assert await scan_rows(s) == [("k", 1, 2.0)]
+                # the leaked object exists but is not referenced
+                objs = await store.list("db/data/")
+                assert len(objs) == 2  # 1 live + 1 leaked
+            finally:
+                await s.close()
+
+        asyncio.run(go())
+
+
+class TestManifestMergeFaults:
+    def test_crash_between_snapshot_put_and_delta_gc(self):
+        async def go():
+            store = FlakyStore()
+            s = await open_storage(store)
+            await s.write(WriteRequest(batch([("a", 1, 1.0)]),
+                                       TimeRange.new(1, 2)))
+            await s.write(WriteRequest(batch([("a", 1, 2.0)]),
+                                       TimeRange.new(1, 2)))
+            # merge succeeds in writing the snapshot but delta deletes fail
+            store.fail_next("delete", "/manifest/delta/")
+            store.fail_next("delete", "/manifest/delta/")
+            await s.manifest.trigger_merge()
+            leftover = await store.list("db/manifest/delta/")
+            assert leftover  # deltas survived the "crash"
+            await s.close()
+
+            # recovery replays the deltas onto the already-folded snapshot
+            s2 = await open_storage(store)
+            try:
+                assert await scan_rows(s2) == [("a", 1, 2.0)]
+                assert len(await s2.manifest.all_ssts()) == 2
+                assert await store.list("db/manifest/delta/") == []
+            finally:
+                await s2.close()
+
+        asyncio.run(go())
