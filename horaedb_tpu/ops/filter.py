@@ -131,6 +131,103 @@ def _const_code_upper(enc: ColumnEncoding, value: Any):
     raise Error(f"unknown encoding kind: {enc.kind}")
 
 
+def predicate_columns(pred: Predicate) -> set[str]:
+    """All column names a predicate references."""
+    if isinstance(pred, (And, Or)):
+        out: set[str] = set()
+        for c in pred.children:
+            out |= predicate_columns(c)
+        return out
+    if isinstance(pred, Not):
+        return predicate_columns(pred.child)
+    return {pred.column}
+
+
+def to_arrow_expression(pred: Predicate, allowed: set[str]):
+    """Translate the safely-pushable part of a predicate tree into a
+    pyarrow compute expression for Parquet row-group pruning + pre-merge
+    row filtering (the analogue of the reference's ParquetExec pruning
+    predicate, read.rs:442-465).
+
+    Only predicates whose columns are ALL in `allowed` (the primary keys)
+    may be pushed: dropping rows by PK removes whole groups, which is
+    merge-safe; dropping by value columns would un-shadow older rows.
+
+    The translation computes a sound UPPER BOUND of the predicate: in
+    positive polarity an unpushable subterm relaxes to TRUE (so And drops
+    it, and an Or containing one becomes unpushable), while under Not the
+    child must translate exactly (widening under negation would wrongly
+    narrow).  Returns None when the bound degenerates to TRUE.
+    """
+    import pyarrow.compute as pc
+
+    TRUE = object()  # sentinel: "no constraint" in positive polarity
+
+    def leaf(p: Predicate):
+        if predicate_columns(p) - allowed:
+            return None
+        f = pc.field(p.column)
+        if isinstance(p, Eq):
+            return f == p.value
+        if isinstance(p, Ne):
+            return f != p.value
+        if isinstance(p, Lt):
+            return f < p.value
+        if isinstance(p, Le):
+            return f <= p.value
+        if isinstance(p, Gt):
+            return f > p.value
+        if isinstance(p, Ge):
+            return f >= p.value
+        if isinstance(p, In):
+            return f.isin(list(p.values))
+        if isinstance(p, TimeRangePred):
+            return (f >= p.start) & (f < p.end)
+        return None
+
+    def strict(p: Predicate):
+        """Exact translation; None if any part is not pushable."""
+        if isinstance(p, (And, Or)):
+            parts = [strict(c) for c in p.children]
+            if any(x is None for x in parts):
+                return None
+            out = parts[0]
+            for x in parts[1:]:
+                out = (out & x) if isinstance(p, And) else (out | x)
+            return out
+        if isinstance(p, Not):
+            inner = strict(p.child)
+            return None if inner is None else ~inner
+        return leaf(p)
+
+    def upper(p: Predicate):
+        """Upper bound; TRUE when nothing constrains."""
+        if isinstance(p, And):
+            parts = [x for x in (upper(c) for c in p.children) if x is not TRUE]
+            if not parts:
+                return TRUE
+            out = parts[0]
+            for x in parts[1:]:
+                out = out & x
+            return out
+        if isinstance(p, Or):
+            parts = [upper(c) for c in p.children]
+            if any(x is TRUE for x in parts):
+                return TRUE  # one unconstrained branch unbounds the union
+            out = parts[0]
+            for x in parts[1:]:
+                out = out | x
+            return out
+        if isinstance(p, Not):
+            inner = strict(p.child)  # exact required under negation
+            return TRUE if inner is None else ~inner
+        expr = leaf(p)
+        return TRUE if expr is None else expr
+
+    expr = upper(pred)
+    return None if expr is TRUE else expr
+
+
 def eval_predicate(pred: Predicate, batch: DeviceBatch) -> jnp.ndarray:
     """Evaluate to a (capacity,) bool mask (padding rows unconstrained —
     callers AND this with the batch validity mask)."""

@@ -97,8 +97,11 @@ async def write_sst(store: ObjectStore, path: str,
 
 
 async def read_sst(store: ObjectStore, path: str,
-                   columns: Optional[list[str]] = None) -> pa.Table:
-    """Read an SST, optionally a column subset.
+                   columns: Optional[list[str]] = None,
+                   filters=None) -> pa.Table:
+    """Read an SST, optionally a column subset and a pyarrow filter
+    expression (row-group pruning via parquet statistics + row filtering
+    — the reference's ParquetExec pruning predicate, read.rs:442-465).
 
     Local stores expose a filesystem path for mmap'd reads; other stores
     go through a bytes buffer.
@@ -108,6 +111,8 @@ async def read_sst(store: ObjectStore, path: str,
         import asyncio
 
         return await asyncio.to_thread(
-            pq.read_table, local_path(path), columns=columns, memory_map=True)
+            pq.read_table, local_path(path), columns=columns,
+            memory_map=True, filters=filters)
     data = await store.get(path)
-    return pq.read_table(pa.BufferReader(data), columns=columns)
+    return pq.read_table(pa.BufferReader(data), columns=columns,
+                         filters=filters)

@@ -96,6 +96,9 @@ class ScanPlan:
     mode: UpdateMode
     predicate: Optional[filter_ops.Predicate]
     keep_builtin: bool
+    # pyarrow expression pushed into the Parquet reads (PK-only subtree
+    # of `predicate`); the full predicate still applies post-merge
+    pushdown: object = None
 
 
 class ParquetReader:
@@ -135,8 +138,13 @@ class ParquetReader:
                         columns=columns)
             for seg, files in sorted(by_segment.items())
         ]
+        pushdown = None
+        if request.predicate is not None:
+            pushdown = filter_ops.to_arrow_expression(
+                request.predicate, set(self.schema.primary_key_names))
         return ScanPlan(segments=segments, mode=self.schema.update_mode,
-                        predicate=request.predicate, keep_builtin=keep_builtin)
+                        predicate=request.predicate, keep_builtin=keep_builtin,
+                        pushdown=pushdown)
 
     # ---- execution ---------------------------------------------------------
 
@@ -149,17 +157,18 @@ class ParquetReader:
                 _ROWS_SCANNED.inc(batch.num_rows)
                 yield batch
 
-    async def _read_segment_table(self, seg: SegmentPlan) -> pa.Table:
+    async def _read_segment_table(self, seg: SegmentPlan,
+                                  pushdown=None) -> pa.Table:
         tables = await asyncio.gather(*(
             parquet_io.read_sst(self.store, sst_path(self.root_path, f.id),
-                                columns=seg.columns)
+                                columns=seg.columns, filters=pushdown)
             for f in seg.ssts
         ))
         return pa.concat_tables(tables)
 
     async def _execute_segment(self, seg: SegmentPlan,
                                plan: ScanPlan) -> Optional[pa.RecordBatch]:
-        table = await self._read_segment_table(seg)
+        table = await self._read_segment_table(seg, plan.pushdown)
         if table.num_rows == 0:
             return None
         batch = table.combine_chunks().to_batches()[0]
@@ -274,7 +283,7 @@ class ParquetReader:
 
         async def read(seg: SegmentPlan) -> pa.Table:
             await sem.acquire()
-            return await self._read_segment_table(seg)
+            return await self._read_segment_table(seg, plan.pushdown)
 
         tasks = [asyncio.create_task(read(seg)) for seg in plan.segments]
         parts: list[tuple[np.ndarray, dict]] = []
@@ -509,5 +518,7 @@ def describe_plan(plan: ScanPlan) -> str:
         if plan.predicate is not None:
             lines.append(f"    Filter: {plan.predicate!r}")
         files = ", ".join(f"{f.id}.sst" for f in seg.ssts)
-        lines.append(f"    ParquetScan: files=[{files}], columns={seg.columns}")
+        pushed = ", pushdown=yes" if plan.pushdown is not None else ""
+        lines.append(f"    ParquetScan: files=[{files}], "
+                     f"columns={seg.columns}{pushed}")
     return "\n".join(lines)

@@ -321,3 +321,59 @@ class TestEncodeNulls:
                     pa.array([1, None], type=pa.int64())):
             with _pytest.raises(Error, match="null"):
                 encode_batch(pa.record_batch({"c": arr}))
+
+
+class TestArrowPushdown:
+    def test_pk_only_predicates_push(self):
+        from horaedb_tpu.ops.filter import to_arrow_expression
+        pks = {"host", "ts"}
+        assert to_arrow_expression(Eq("host", "a"), pks) is not None
+        assert to_arrow_expression(TimeRangePred("ts", 1, 5), pks) is not None
+        assert to_arrow_expression(In("host", ["a", "b"]), pks) is not None
+        # value-column predicates must NOT push (would break last-value)
+        assert to_arrow_expression(Gt("cpu", 0.5), pks) is None
+        # partial AND pushes only the PK part
+        expr = to_arrow_expression(
+            And([Eq("host", "a"), Gt("cpu", 0.5)]), pks)
+        assert expr is not None and "cpu" not in str(expr)
+        # OR with a value column cannot push at all
+        assert to_arrow_expression(
+            Or([Eq("host", "a"), Gt("cpu", 0.5)]), pks) is None
+        # pure-PK OR and NOT push
+        assert to_arrow_expression(
+            Or([Eq("host", "a"), Eq("host", "b")]), pks) is not None
+        assert to_arrow_expression(Not(Eq("host", "a")), pks) is not None
+
+    def test_pushed_filter_matches_post_merge_filter(self):
+        """Row filtering by a PK predicate pre-merge must give the same
+        result as filtering post-merge."""
+        import pyarrow.parquet as pq, io
+        import pyarrow as pa
+        from horaedb_tpu.ops.filter import to_arrow_expression
+        tbl = pa.table({"host": ["a", "b", "a", "c"],
+                        "ts": [1, 2, 3, 4],
+                        "cpu": [0.1, 0.2, 0.3, 0.4]})
+        sink = io.BytesIO()
+        pq.write_table(tbl, sink)
+        expr = to_arrow_expression(Eq("host", "a"), {"host", "ts"})
+        got = pq.read_table(pa.BufferReader(sink.getvalue()), filters=expr)
+        assert got.column("ts").to_pylist() == [1, 3]
+
+    def test_nested_relaxation(self):
+        from horaedb_tpu.ops.filter import to_arrow_expression
+        pks = {"host", "ts"}
+        # nested And under Or: unpushable conjunct relaxes, Or still pushes
+        expr = to_arrow_expression(
+            Or([And([Eq("host", "a"), Gt("cpu", 0.5)]), Eq("host", "b")]), pks)
+        assert expr is not None and "cpu" not in str(expr)
+        # nested And under top-level And relaxes too
+        expr = to_arrow_expression(
+            And([TimeRangePred("ts", 1, 5),
+                 And([Eq("host", "a"), Gt("cpu", 0.5)])]), pks)
+        assert expr is not None and "host" in str(expr) and "cpu" not in str(expr)
+        # but relaxation NEVER happens under Not (would narrow, unsound)
+        assert to_arrow_expression(
+            Not(And([Eq("host", "a"), Gt("cpu", 0.5)])), pks) is None
+        # Or with a fully-unpushable branch stays unpushable
+        assert to_arrow_expression(
+            Or([Eq("host", "a"), Gt("cpu", 0.5)]), pks) is None

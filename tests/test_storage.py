@@ -245,7 +245,7 @@ class TestPlanShape:
                     "  Segment[start=0]: DeviceMergeDedup",
                     "    Filter: Eq(column='host', value='a')",
                     f"    ParquetScan: files=[{ids[0]}.sst, {ids[1]}.sst], "
-                    "columns=['host', 'ts', 'cpu', '__seq__']",
+                    "columns=['host', 'ts', 'cpu', '__seq__'], pushdown=yes",
                 ])
                 assert text == expected
             finally:
