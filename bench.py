@@ -18,6 +18,7 @@ BENCH_CONFIG (default 1; 2-5 delegate to horaedb_tpu.bench.suite).
 
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -26,6 +27,28 @@ import numpy as np
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
+
+
+def ensure_responsive_backend(timeout_s: int = 180) -> None:
+    """Probe jax.devices() in a SUBPROCESS first: the axon TPU tunnel is
+    single-client and can wedge (a dial then blocks forever, which would
+    hang the whole bench).  If the probe can't come up in time, re-exec
+    on the CPU backend so the driver always gets a result line."""
+    if os.environ.get("_HORAEDB_BENCH_REEXEC") == "1":
+        return
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s, capture_output=True)
+        if probe.returncode == 0:
+            return
+        log(f"device probe failed: {probe.stderr[-300:]!r}")
+    except subprocess.TimeoutExpired:
+        log(f"device probe hung >{timeout_s}s (wedged TPU tunnel?)")
+    log("falling back to the CPU backend for this bench run")
+    env = dict(os.environ, _HORAEDB_BENCH_REEXEC="1",
+               PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
+    os.execve(sys.executable, [sys.executable] + sys.argv, env)
 
 
 def cpu_baseline(ts_off, gid, vals, bucket_ms, num_groups, num_buckets, iters):
@@ -52,6 +75,8 @@ def main() -> None:
     except ValueError:
         sys.exit(f"BENCH_CONFIG must be 1-5, got "
                  f"{os.environ.get('BENCH_CONFIG')!r}")
+
+    ensure_responsive_backend()
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     if config != 1:

@@ -17,5 +17,7 @@ from horaedb_tpu.cluster.router import (
     routing_key,
 )
 from horaedb_tpu.cluster.cluster import Cluster
+from horaedb_tpu.cluster.remote import RemoteRegion
 
-__all__ = ["Cluster", "MAX_TTL", "PartitionRule", "RoutingTable", "routing_key"]
+__all__ = ["Cluster", "MAX_TTL", "PartitionRule", "RemoteRegion",
+           "RoutingTable", "routing_key"]

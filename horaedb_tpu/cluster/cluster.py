@@ -65,6 +65,12 @@ class Cluster:
             f"{self._root_path}/region_{region_id}", self._store,
             segment_ms=self._segment_ms, config=self._config)
 
+    def add_remote_region(self, region_id: int, backend) -> None:
+        """Attach a region served by another process (e.g. a RemoteRegion
+        speaking the server's HTTP API over DCN)."""
+        ensure(region_id not in self.regions, f"region {region_id} exists")
+        self.regions[region_id] = backend
+
     # ---- write ------------------------------------------------------------
 
     async def write(self, samples: list[Sample]) -> None:
@@ -104,6 +110,63 @@ class Cluster:
         # all regions share one result schema, so concat handles the
         # empty case too — no refetch needed
         return pa.concat_tables(tables)
+
+    async def query_downsample(self, metric: str,
+                               filters: list[tuple[str, str]],
+                               time_range: TimeRange, bucket_ms: int,
+                               field: str = "value") -> dict:
+        """Scatter-gather downsample: per-region grids merged by tsid.
+        Regions are series-disjoint in steady state; during a split's TTL
+        window an overlapping tsid combines additively (sum/count/min/
+        max; avg recomputed; `last` takes the later region's value)."""
+        rids = self._query_regions(metric, filters, time_range)
+        results = await asyncio.gather(*(
+            self.regions[rid].query_downsample(metric, filters, time_range,
+                                               bucket_ms, field=field)
+            for rid in rids if rid in self.regions))
+        results = [r for r in results if r["tsids"]]
+        num_buckets = -(-(int(time_range.end) - int(time_range.start))
+                        // bucket_ms)
+        if not results:
+            return {"tsids": [], "num_buckets": num_buckets, "aggs": {}}
+
+        import numpy as np
+
+        all_tsids = sorted({t for r in results for t in r["tsids"]})
+        idx = {t: i for i, t in enumerate(all_tsids)}
+        g = len(all_tsids)
+        agg = {"count": np.zeros((g, num_buckets)),
+               "sum": np.zeros((g, num_buckets)),
+               "min": np.full((g, num_buckets), np.inf),
+               "max": np.full((g, num_buckets), -np.inf),
+               "last": np.full((g, num_buckets), np.nan),
+               "last_ts": np.full((g, num_buckets), -np.inf)}
+        for r in results:
+            rows = np.asarray([idx[t] for t in r["tsids"]])
+            a = r["aggs"]
+            agg["count"][rows] += np.nan_to_num(np.asarray(a["count"]))
+            agg["sum"][rows] += np.nan_to_num(np.asarray(a["sum"]))
+            agg["min"][rows] = np.fmin(agg["min"][rows], np.asarray(a["min"]))
+            agg["max"][rows] = np.fmax(agg["max"][rows], np.asarray(a["max"]))
+            has = np.asarray(a["count"]) > 0
+            # winner by actual sample time (regions expose last_ts);
+            # ties break toward the later region in route order
+            cand_ts = np.nan_to_num(
+                np.asarray(a["last_ts"], dtype=np.float64), nan=-np.inf)
+            take = has & (cand_ts >= agg["last_ts"][rows])
+            last_rows = agg["last"][rows]
+            last_rows[take] = np.asarray(a["last"])[take]
+            agg["last"][rows] = last_rows
+            lt_rows = agg["last_ts"][rows]
+            lt_rows[take] = cand_ts[take]
+            agg["last_ts"][rows] = lt_rows
+        empty = agg["count"] == 0
+        with np.errstate(invalid="ignore"):
+            agg["avg"] = np.where(empty, np.nan,
+                                  agg["sum"] / np.maximum(agg["count"], 1))
+        agg["min"] = np.where(empty, np.inf, agg["min"])
+        agg["max"] = np.where(empty, -np.inf, agg["max"])
+        return {"tsids": all_tsids, "num_buckets": num_buckets, "aggs": agg}
 
     async def label_values(self, metric: str, tag_key: str,
                            time_range: TimeRange) -> list[str]:

@@ -1,0 +1,103 @@
+"""Remote region backend: the MetricEngine API over the server's HTTP
+endpoints — the cluster's DCN plane (SURVEY.md P6: the legacy reference
+forwards via HoraeMeta + gRPC; our control/data plane is the aiohttp
+server, so a region can live in any process that runs one).
+
+RemoteRegion duck-types the MetricEngine surface the Cluster facade uses
+(write / query / query_downsample / label_values / close), so a Cluster
+can mix in-process and remote regions freely.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import pyarrow as pa
+
+import aiohttp
+
+from horaedb_tpu.common.error import Error
+from horaedb_tpu.metric_engine.types import Sample
+from horaedb_tpu.storage.types import TimeRange
+
+
+class RemoteRegion:
+    def __init__(self, base_url: str,
+                 session: Optional[aiohttp.ClientSession] = None):
+        self.base_url = base_url.rstrip("/")
+        self._session = session
+        self._own_session = session is None
+
+    async def _ensure_session(self) -> aiohttp.ClientSession:
+        if self._session is None:
+            self._session = aiohttp.ClientSession()
+        return self._session
+
+    async def close(self) -> None:
+        if self._own_session and self._session is not None:
+            await self._session.close()
+            self._session = None
+
+    async def _post(self, path: str, body: dict) -> dict:
+        session = await self._ensure_session()
+        async with session.post(self.base_url + path, json=body) as resp:
+            if resp.status != 200:
+                # body may be a non-JSON error page (404 text, 500 html)
+                text = await resp.text()
+                raise Error(f"remote region {self.base_url}{path} "
+                            f"returned {resp.status}: {text[:200]}")
+            return await resp.json(content_type=None)
+
+    # ---- MetricEngine surface ---------------------------------------------
+
+    async def write(self, samples: list[Sample]) -> None:
+        body = {"samples": [
+            {"name": s.name,
+             "labels": {l.name: l.value for l in s.labels},
+             "timestamp": s.timestamp, "value": s.value,
+             "field": s.field_name}
+            for s in samples
+        ]}
+        await self._post("/write", body)
+
+    async def query(self, metric: str, filters: list[tuple[str, str]],
+                    time_range: TimeRange, field: str = "value") -> pa.Table:
+        data = await self._post("/query", {
+            "metric": metric, "filters": [list(f) for f in filters],
+            "start": int(time_range.start), "end": int(time_range.end),
+            "field": field})
+        return pa.table({
+            "tsid": pa.array([int(t) for t in data["tsids"]],
+                             type=pa.uint64()),
+            "timestamp": pa.array(data["timestamps"], type=pa.int64()),
+            "value": pa.array(data["values"], type=pa.float64()),
+        })
+
+    async def query_downsample(self, metric: str,
+                               filters: list[tuple[str, str]],
+                               time_range: TimeRange, bucket_ms: int,
+                               field: str = "value") -> dict:
+        data = await self._post("/query", {
+            "metric": metric, "filters": [list(f) for f in filters],
+            "start": int(time_range.start), "end": int(time_range.end),
+            "bucket_ms": bucket_ms, "field": field})
+        aggs = {
+            k: np.array([[np.nan if x is None else x for x in row]
+                         for row in grid], dtype=np.float64)
+            for k, grid in data["aggs"].items()
+        }
+        return {"tsids": [int(t) for t in data["tsids"]],
+                "num_buckets": data["num_buckets"], "aggs": aggs}
+
+    async def label_values(self, metric: str, tag_key: str,
+                           time_range: TimeRange) -> list[str]:
+        session = await self._ensure_session()
+        async with session.get(self.base_url + "/label_values", params={
+                "metric": metric, "key": tag_key,
+                "start": str(int(time_range.start)),
+                "end": str(int(time_range.end))}) as resp:
+            data = await resp.json()
+            if resp.status != 200:
+                raise Error(f"remote label_values failed: {data}")
+            return data["values"]

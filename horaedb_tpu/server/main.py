@@ -130,7 +130,13 @@ def build_app(state: ServerState) -> web.Application:
         try:
             body = await req.json()
             metric = body["metric"]
-            filters = sorted(body.get("filters", {}).items())
+            raw_filters = body.get("filters", {})
+            # dict form loses duplicate keys; the list-of-pairs form
+            # (RemoteRegion sends it) preserves them
+            if isinstance(raw_filters, dict):
+                filters = sorted(raw_filters.items())
+            else:
+                filters = sorted((str(k), str(v)) for k, v in raw_filters)
             rng = TimeRange.new(int(body["start"]), int(body["end"]))
             bucket_ms = body.get("bucket_ms")
             field = body.get("field", "value")

@@ -55,7 +55,8 @@ _SCAN_LATENCY = registry.histogram(
     "storage_scan_seconds", "merge-scan latency per segment")
 _ROWS_SCANNED = registry.counter(
     "storage_rows_scanned_total", "rows produced by merge-scan")
-# segment tables held in memory at once on the aggregate pushdown path
+# segment tables held in memory at once by _prefetch_tables (bounds BOTH
+# the row-scan and aggregate paths — including compaction's scan)
 _PREFETCH_SEGMENTS = 4
 
 
@@ -149,13 +150,41 @@ class ParquetReader:
     # ---- execution ---------------------------------------------------------
 
     async def execute(self, plan: ScanPlan) -> AsyncIterator[pa.RecordBatch]:
-        for seg in plan.segments:
+        async for seg, table, read_s in self._prefetch_tables(plan):
             t0 = time.perf_counter()
-            batch = await self._execute_segment(seg, plan)
-            _SCAN_LATENCY.observe(time.perf_counter() - t0)
+            batch = self._merge_segment_table(table, seg, plan)
+            # read time (inside the prefetch task) + merge time: the true
+            # per-segment cost even though reads overlap merges
+            _SCAN_LATENCY.observe(read_s + (time.perf_counter() - t0))
             if batch is not None and batch.num_rows:
                 _ROWS_SCANNED.inc(batch.num_rows)
                 yield batch
+
+    async def _prefetch_tables(self, plan: ScanPlan):
+        """Bounded segment prefetch shared by the row and aggregate paths:
+        object-store reads overlap downstream device work while at most
+        _PREFETCH_SEGMENTS tables are in memory (the permit is released
+        only after the consumer finishes with a segment).  Yields
+        (segment, table, read_seconds)."""
+        sem = asyncio.Semaphore(_PREFETCH_SEGMENTS)
+
+        async def read(seg: SegmentPlan):
+            await sem.acquire()
+            t0 = time.perf_counter()
+            table = await self._read_segment_table(seg, plan.pushdown)
+            return table, time.perf_counter() - t0
+
+        tasks = [asyncio.create_task(read(seg)) for seg in plan.segments]
+        try:
+            for seg, task in zip(plan.segments, tasks):
+                table, read_s = await task
+                try:
+                    yield seg, table, read_s
+                finally:
+                    sem.release()
+        finally:
+            for task in tasks:
+                task.cancel()
 
     async def _read_segment_table(self, seg: SegmentPlan,
                                   pushdown=None) -> pa.Table:
@@ -166,9 +195,8 @@ class ParquetReader:
         ))
         return pa.concat_tables(tables)
 
-    async def _execute_segment(self, seg: SegmentPlan,
-                               plan: ScanPlan) -> Optional[pa.RecordBatch]:
-        table = await self._read_segment_table(seg, plan.pushdown)
+    def _merge_segment_table(self, table: pa.Table, seg: SegmentPlan,
+                             plan: ScanPlan) -> Optional[pa.RecordBatch]:
         if table.num_rows == 0:
             return None
         batch = table.combine_chunks().to_batches()[0]
@@ -275,39 +303,20 @@ class ParquetReader:
         sorted order; each grid is (len(group_values), num_buckets)."""
         ensure(plan.mode is UpdateMode.OVERWRITE,
                "aggregate pushdown requires Overwrite mode")
-        # bounded prefetch: overlap object-store I/O across segments while
-        # holding at most _PREFETCH_SEGMENTS tables in memory (released
-        # only after consumption); aggregation proceeds in segment order
+        # aggregation proceeds in segment order (via the shared prefetch)
         # so `last` tie-breaks stay deterministic
-        sem = asyncio.Semaphore(_PREFETCH_SEGMENTS)
-
-        async def read(seg: SegmentPlan) -> pa.Table:
-            await sem.acquire()
-            return await self._read_segment_table(seg, plan.pushdown)
-
-        tasks = [asyncio.create_task(read(seg)) for seg in plan.segments]
         parts: list[tuple[np.ndarray, dict]] = []
-        try:
-            for task in tasks:
-                t0 = time.perf_counter()
-                table = await task
-                try:
-                    if table.num_rows == 0:
-                        continue
-                    batch = table.combine_chunks().to_batches()[0]
-                    for out_batch in self._merged_windows(batch):
-                        part = self._aggregate_window(out_batch, spec, plan)
-                        if part is not None:
-                            parts.append(part)
-                        # same semantics as the row path: post-dedup rows
-                        _ROWS_SCANNED.inc(out_batch.n_valid)
-                finally:
-                    sem.release()
-                    # I/O-inclusive per-segment latency, like execute()
-                    _SCAN_LATENCY.observe(time.perf_counter() - t0)
-        finally:
-            for task in tasks:
-                task.cancel()
+        async for _seg, table, read_s in self._prefetch_tables(plan):
+            t0 = time.perf_counter()
+            if table.num_rows:
+                batch = table.combine_chunks().to_batches()[0]
+                for out_batch in self._merged_windows(batch):
+                    part = self._aggregate_window(out_batch, spec, plan)
+                    if part is not None:
+                        parts.append(part)
+                    # same semantics as the row path: post-dedup rows
+                    _ROWS_SCANNED.inc(out_batch.n_valid)
+            _SCAN_LATENCY.observe(read_s + (time.perf_counter() - t0))
         return combine_aggregate_parts(parts, spec.num_buckets)
 
     def _aggregate_window(self, out_batch: encode.DeviceBatch,
@@ -400,7 +409,8 @@ def combine_aggregate_parts(parts: list[tuple[np.ndarray, dict]],
     if not parts:
         empty = np.zeros((0, num_buckets), dtype=np.float32)
         return np.asarray([]), {k: empty.copy() for k in
-                                ("count", "sum", "min", "max", "avg", "last")}
+                                ("count", "sum", "min", "max", "avg", "last",
+                                 "last_ts")}
     all_values = np.unique(np.concatenate([v for v, _ in parts]))
     g = len(all_values)
     acc = {
@@ -437,6 +447,9 @@ def combine_aggregate_parts(parts: list[tuple[np.ndarray, dict]],
         "max": acc["max"],
         "avg": avg,
         "last": np.where(empty, np.nan, acc["last"]),
+        # exposed (as float, NaN for empty) so cross-region merges can
+        # pick `last` by actual sample time instead of region order
+        "last_ts": np.where(empty, np.nan, acc["last_ts"].astype(np.float64)),
     }
     return all_values, out
 

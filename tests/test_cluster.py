@@ -13,7 +13,7 @@ from horaedb_tpu.cluster import (
     routing_key,
 )
 from horaedb_tpu.common import Error
-from horaedb_tpu.metric_engine import Label, Sample
+from horaedb_tpu.metric_engine import Label, MetricEngine, Sample
 from horaedb_tpu.objstore import MemoryObjectStore
 from horaedb_tpu.storage.types import TimeRange
 
@@ -171,3 +171,67 @@ class TestStrictTimeRouting:
         # default (backfill-safe): historical window still consults the
         # new region, where late-arriving old-timestamp writes now land
         assert set(rt.route_query(pivot + 1, T0, T0 + DAY)) == {1, 4}
+
+
+class TestRemoteRegion:
+    """A cluster mixing an in-process region with a region served by a
+    real HTTP server process (the DCN plane)."""
+
+    def test_mixed_local_and_remote_regions(self):
+        async def go():
+            import aiohttp
+            from aiohttp.test_utils import TestServer
+
+            from horaedb_tpu.cluster import RemoteRegion
+            from horaedb_tpu.server.config import ServerConfig
+            from horaedb_tpu.server.main import ServerState, build_app
+
+            # remote region = full engine behind the HTTP server
+            remote_engine = await MetricEngine.open(
+                "remote_db", MemoryObjectStore(), segment_ms=2 * HOUR)
+            server = TestServer(build_app(
+                ServerState(remote_engine, ServerConfig())))
+            await server.start_server()
+            session = aiohttp.ClientSession()
+            remote = RemoteRegion(str(server.make_url("/")), session)
+
+            c = await Cluster.open("cluster", MemoryObjectStore(),
+                                   num_regions=1, segment_ms=2 * HOUR)
+            try:
+                # move half the key space to the remote region
+                from horaedb_tpu.common.time_ext import now_ms
+                c.routing.split(0, 1 << 62, 7, now_ms(), 30 * 24 * HOUR)
+                c.add_remote_region(7, remote)
+
+                samples = [sample("cpu", [("host", f"h{i:02d}")],
+                                  T0 + 60_000 * (i % 5), float(i))
+                           for i in range(40)]
+                await c.write(samples)
+                rng = TimeRange.new(T0, T0 + HOUR)
+
+                # the remote engine really took traffic over HTTP
+                remote_rows = (await remote_engine.query("cpu", [], rng)).num_rows
+                assert remote_rows > 0
+
+                t = await c.query("cpu", [], rng)
+                assert t.num_rows == 40
+                assert sorted(t.column("value").to_pylist()) == \
+                    [float(i) for i in range(40)]
+
+                vals = await c.label_values("cpu", "host", rng)
+                assert len(vals) == 40
+
+                ds = await c.query_downsample("cpu", [], rng,
+                                              bucket_ms=5 * 60_000)
+                assert len(ds["tsids"]) == 40
+                assert float(ds["aggs"]["count"].sum()) == 40.0
+                # values survive the JSON hop exactly
+                assert float(ds["aggs"]["sum"].sum()) == sum(range(40))
+            finally:
+                await c.close()
+                await remote.close()
+                await session.close()
+                await server.close()
+                await remote_engine.close()
+
+        asyncio.run(go())
