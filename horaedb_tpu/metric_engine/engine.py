@@ -323,6 +323,100 @@ class MetricEngine:
         await self.index_manager.populate_series_ids(samples)
         await self.sample_manager.persist(samples)
 
+    async def write_arrow(self, metric: str, tag_columns: list[str],
+                          batch: pa.RecordBatch,
+                          field: str = "value") -> None:
+        """Vectorized bulk ingest: an Arrow batch with columns
+        [*tag_columns, 'timestamp' int64, 'value' float64] for one metric.
+
+        The scalar write() path builds a Python Sample per point; this
+        path touches Python only once per UNIQUE series (for SeaHash id
+        derivation and index registration) and moves the per-row work —
+        series-code assignment, segment splitting, column assembly — into
+        Arrow/numpy.  This is the ingest path benchmarks and remote-write
+        bulk endpoints should use.
+        """
+        import numpy as np
+        import pyarrow.compute as pc
+
+        from horaedb_tpu.metric_engine.types import Label
+
+        n = batch.num_rows
+        if n == 0:
+            return
+        ensure("timestamp" in batch.schema.names
+               and "value" in batch.schema.names,
+               "write_arrow needs 'timestamp' and 'value' columns")
+        for c in tag_columns:
+            ensure(c in batch.schema.names,
+                   f"write_arrow tag column {c!r} missing from batch")
+
+        # unique series via per-tag dictionary codes combined into one
+        # composite code (Arrow C++ encodes; numpy combines)
+        tag_arrays = [batch.column(batch.schema.names.index(c))
+                      for c in tag_columns]
+        per_tag_codes = []
+        code_space = 1
+        for arr in tag_arrays:
+            d = pc.dictionary_encode(arr)
+            d = d.combine_chunks() if isinstance(d, pa.ChunkedArray) else d
+            per_tag_codes.append(np.asarray(d.indices).astype(np.int64))
+            code_space *= max(1, len(d.dictionary))
+        ensure(code_space < 2**62,
+               "tag cardinality product overflows the composite series "
+               "code; split the batch or reduce tag columns")
+        composite = np.zeros(n, dtype=np.int64)
+        for c in per_tag_codes:
+            card = int(c.max()) + 1 if len(c) else 1
+            composite = composite * card + c
+        uniq_codes, codes = np.unique(composite, return_inverse=True)
+
+        ts_np = batch.column(batch.schema.names.index("timestamp")).to_numpy()
+        # segment assignment must match Timestamp.truncate_by (truncation
+        # toward zero, not numpy floor) so pre-epoch rows land where their
+        # registration does
+        seg = self.segment_ms
+        q = np.where(ts_np >= 0, ts_np // seg, -((-ts_np) // seg))
+        seg_ids = q * seg
+
+        # registration must happen per (segment, series) — the index is
+        # Date-scoped (RFC:104), so a series spanning segments registers
+        # in each one.  One Python trip per unique pair.
+        pair = np.stack([seg_ids, composite], axis=1)
+        _, pair_rows = np.unique(pair, axis=0, return_index=True)
+        reg_samples = []
+        tsid_of_code = np.full(len(uniq_codes), 0, dtype=np.uint64)
+        mid = metric_id_of(metric)
+        for row in pair_rows:
+            row = int(row)
+            labels = [Label(c, str(tag_arrays[j][row].as_py()))
+                      for j, c in enumerate(tag_columns)]
+            code_idx = int(codes[row])
+            tsid_of_code[code_idx] = tsid_of(metric, labels)
+            reg_samples.append(Sample(metric, labels, int(ts_np[row]), 0.0,
+                                      field_name=field))
+        # registration rides the scalar pipeline (per-segment dedup caches
+        # make it cheap); data rows go straight to the data table
+        await self.metric_manager.populate_metric_ids(reg_samples)
+        await self.index_manager.populate_series_ids(reg_samples)
+
+        val_np = batch.column(batch.schema.names.index("value")).to_numpy()
+        tsids = tsid_of_code[codes]
+        data = self.tables["data"]
+        fid = field_id_of(field)
+        for seg in np.unique(seg_ids):
+            m = seg_ids == seg
+            seg_ts = ts_np[m]
+            out = pa.record_batch(
+                [pa.array(np.full(int(m.sum()), mid, dtype=np.uint64)),
+                 pa.array(tsids[m]),
+                 pa.array(np.full(int(m.sum()), fid, dtype=np.uint64)),
+                 pa.array(seg_ts, type=pa.int64()),
+                 pa.array(val_np[m], type=pa.float64())],
+                schema=data.schema().user_schema)
+            await data.write(WriteRequest(
+                out, TimeRange.new(int(seg_ts.min()), int(seg_ts.max()) + 1)))
+
     # ---- read -------------------------------------------------------------
 
     async def _resolve_data_predicate(self, metric: str,

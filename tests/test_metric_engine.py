@@ -390,3 +390,116 @@ class TestAggregatePushdown:
                 await e.close()
 
         asyncio.run(go())
+
+
+from horaedb_tpu.common import Error
+
+
+class TestBulkArrowIngest:
+    def test_write_arrow_equals_scalar_write(self):
+        async def go():
+            import pyarrow as pa
+            rng = np.random.default_rng(0)
+            n, hosts = 2000, 20
+            hs = [f"h{int(i):02d}" for i in rng.integers(0, hosts, n)]
+            regions = ["east" if h < "h10" else "west" for h in hs]
+            ts = (T0 + rng.integers(0, 3 * HOUR, n)).tolist()
+            vals = rng.random(n).round(4).tolist()
+            batch = pa.record_batch({
+                "host": pa.array(hs), "region": pa.array(regions),
+                "timestamp": pa.array(ts, type=pa.int64()),
+                "value": pa.array(vals, type=pa.float64()),
+            })
+
+            e_bulk = await open_engine()
+            e_ref = await open_engine()
+            try:
+                await e_bulk.write_arrow("cpu", ["host", "region"], batch)
+                await e_ref.write([
+                    sample("cpu", [("host", h), ("region", r)], t, v)
+                    for h, r, t, v in zip(hs, regions, ts, vals)
+                ])
+                rng_q = TimeRange.new(T0, T0 + 4 * HOUR)
+                for filters in ([], [("host", "h03")],
+                                [("region", "east")],
+                                [("host", "h15"), ("region", "west")]):
+                    a = await e_bulk.query("cpu", filters, rng_q)
+                    b = await e_ref.query("cpu", filters, rng_q)
+                    ka = sorted(zip(a.column("tsid").to_pylist(),
+                                    a.column("timestamp").to_pylist(),
+                                    a.column("value").to_pylist()))
+                    kb = sorted(zip(b.column("tsid").to_pylist(),
+                                    b.column("timestamp").to_pylist(),
+                                    b.column("value").to_pylist()))
+                    assert ka == kb, filters
+                assert await e_bulk.label_values("cpu", "region", rng_q) == \
+                    await e_ref.label_values("cpu", "region", rng_q)
+            finally:
+                await e_bulk.close()
+                await e_ref.close()
+
+        asyncio.run(go())
+
+    def test_write_arrow_multi_segment(self):
+        async def go():
+            import pyarrow as pa
+            e = await open_engine()
+            try:
+                ts = [T0 + 1000, T0 + 2 * HOUR + 1000, T0 + 4 * HOUR + 1000]
+                batch = pa.record_batch({
+                    "host": pa.array(["a", "a", "a"]),
+                    "timestamp": pa.array(ts, type=pa.int64()),
+                    "value": pa.array([1.0, 2.0, 3.0], type=pa.float64()),
+                })
+                await e.write_arrow("cpu", ["host"], batch)
+                t = await e.query("cpu", [("host", "a")],
+                                  TimeRange.new(T0, T0 + 6 * HOUR))
+                assert sorted(t.column("value").to_pylist()) == [1.0, 2.0, 3.0]
+            finally:
+                await e.close()
+
+        asyncio.run(go())
+
+    def test_write_arrow_later_segment_queryable(self):
+        """Regression: a series' data in a later segment must be indexed
+        there too — a query window that misses the first segment still
+        finds it (the review's reproduced bug)."""
+
+        async def go():
+            import pyarrow as pa
+            e = await open_engine()
+            try:
+                batch = pa.record_batch({
+                    "host": pa.array(["a", "a"]),
+                    "timestamp": pa.array([T0 + 1000, T0 + 4 * HOUR + 1000],
+                                          type=pa.int64()),
+                    "value": pa.array([1.0, 2.0], type=pa.float64()),
+                })
+                await e.write_arrow("cpu", ["host"], batch)
+                later = TimeRange.new(T0 + 4 * HOUR, T0 + 6 * HOUR)
+                t = await e.query("cpu", [("host", "a")], later)
+                assert t.column("value").to_pylist() == [2.0]
+                t = await e.query("cpu", [], later)
+                assert t.column("value").to_pylist() == [2.0]
+                assert await e.label_values("cpu", "host", later) == ["a"]
+            finally:
+                await e.close()
+
+        asyncio.run(go())
+
+    def test_write_arrow_missing_tag_column(self):
+        async def go():
+            import pyarrow as pa
+            e = await open_engine()
+            try:
+                batch = pa.record_batch({
+                    "host": pa.array(["a"]),
+                    "timestamp": pa.array([T0], type=pa.int64()),
+                    "value": pa.array([1.0], type=pa.float64()),
+                })
+                with pytest.raises(Error, match="hsot"):
+                    await e.write_arrow("cpu", ["hsot"], batch)
+            finally:
+                await e.close()
+
+        asyncio.run(go())
