@@ -61,6 +61,30 @@ class RemoteRegion:
         ]}
         await self._post("/write", body)
 
+    async def write_arrow(self, metric: str, tag_columns: list[str],
+                          batch: pa.RecordBatch,
+                          field: str = "value") -> None:
+        """Bulk ingest over the Arrow-IPC data plane."""
+        import io
+
+        import pyarrow.ipc
+
+        sink = io.BytesIO()
+        with pyarrow.ipc.new_stream(sink, batch.schema) as writer:
+            writer.write_batch(batch)
+        session = await self._ensure_session()
+        async with session.post(
+                self.base_url + "/write_arrow",
+                params={"metric": metric, "tags": ",".join(tag_columns),
+                        "field": field},
+                data=sink.getvalue(),
+                headers={"Content-Type":
+                         "application/vnd.apache.arrow.stream"}) as resp:
+            if resp.status != 200:
+                text = await resp.text()
+                raise Error(f"remote write_arrow returned {resp.status}: "
+                            f"{text[:200]}")
+
     async def query(self, metric: str, filters: list[tuple[str, str]],
                     time_range: TimeRange, field: str = "value") -> pa.Table:
         data = await self._post("/query", {

@@ -350,6 +350,21 @@ class MetricEngine:
         for c in tag_columns:
             ensure(c in batch.schema.names,
                    f"write_arrow tag column {c!r} missing from batch")
+            ensure(batch.column(batch.schema.names.index(c)).null_count == 0,
+                   f"write_arrow tag column {c!r} contains nulls")
+        # normalize idiomatic Arrow types up front (timestamp('ms') etc.)
+        # so type mismatches fail here as Error, not deep in numpy
+        try:
+            ts_col = batch.column(
+                batch.schema.names.index("timestamp")).cast(pa.int64())
+            val_col = batch.column(
+                batch.schema.names.index("value")).cast(pa.float64())
+        except pa.ArrowInvalid as e:
+            raise Error.context(
+                "write_arrow timestamp/value columns must cast to "
+                "int64/float64", e)
+        ensure(ts_col.null_count == 0 and val_col.null_count == 0,
+               "write_arrow timestamp/value columns contain nulls")
 
         # unique series via per-tag dictionary codes combined into one
         # composite code (Arrow C++ encodes; numpy combines)
@@ -371,7 +386,7 @@ class MetricEngine:
             composite = composite * card + c
         uniq_codes, codes = np.unique(composite, return_inverse=True)
 
-        ts_np = batch.column(batch.schema.names.index("timestamp")).to_numpy()
+        ts_np = ts_col.to_numpy()
         # segment assignment must match Timestamp.truncate_by (truncation
         # toward zero, not numpy floor) so pre-epoch rows land where their
         # registration does
@@ -400,7 +415,7 @@ class MetricEngine:
         await self.metric_manager.populate_metric_ids(reg_samples)
         await self.index_manager.populate_series_ids(reg_samples)
 
-        val_np = batch.column(batch.schema.names.index("value")).to_numpy()
+        val_np = val_col.to_numpy()
         tsids = tsid_of_code[codes]
         data = self.tables["data"]
         fid = field_id_of(field)

@@ -125,6 +125,38 @@ def build_app(state: ServerState) -> web.Application:
             return web.json_response({"error": str(e)}, status=400)
         return web.json_response({"written": len(samples)})
 
+    @routes.post("/write_arrow")
+    async def write_arrow(req: web.Request) -> web.Response:
+        """Bulk columnar ingest: the body is an Arrow IPC stream (one or
+        more record batches with [tags..., timestamp, value] columns);
+        metric and tag columns come from query params.  This is the
+        Arrow-IPC data plane — no per-row JSON, C++ decode straight into
+        the vectorized ingest path."""
+        import pyarrow.ipc
+
+        metric = req.query.get("metric")
+        if not metric:
+            return web.json_response({"error": "metric param required"},
+                                     status=400)
+        tags = [t for t in req.query.get("tags", "").split(",") if t]
+        field = req.query.get("field", "value")
+        body = await req.read()
+        try:
+            reader = pyarrow.ipc.open_stream(body)
+            table = reader.read_all()
+        except Exception as e:  # arrow raises several types here
+            return web.json_response({"error": f"bad arrow stream: {e}"},
+                                     status=400)
+        written = 0
+        try:
+            for batch in table.combine_chunks().to_batches():
+                await state.engine.write_arrow(metric, tags, batch,
+                                               field=field)
+                written += batch.num_rows
+        except Error as e:
+            return web.json_response({"error": str(e)}, status=400)
+        return web.json_response({"written": written})
+
     @routes.post("/query")
     async def query(req: web.Request) -> web.Response:
         try:
@@ -169,7 +201,9 @@ def build_app(state: ServerState) -> web.Application:
         vals = await state.engine.label_values(metric, key, rng)
         return web.json_response({"values": vals})
 
-    app = web.Application()
+    # sized for the Arrow-IPC bulk data plane (default 1 MiB would 413
+    # any real ingest batch)
+    app = web.Application(client_max_size=256 * 1024 * 1024)
     app.add_routes(routes)
     return app
 

@@ -503,3 +503,39 @@ class TestBulkArrowIngest:
                 await e.close()
 
         asyncio.run(go())
+
+    def test_write_arrow_type_normalization_and_nulls(self):
+        async def go():
+            import pyarrow as pa
+            e = await open_engine()
+            try:
+                # idiomatic Arrow timestamp type casts cleanly
+                batch = pa.record_batch({
+                    "host": pa.array(["a"]),
+                    "timestamp": pa.array([T0], type=pa.timestamp("ms")),
+                    "value": pa.array([1], type=pa.int32()),
+                })
+                await e.write_arrow("cpu", ["host"], batch)
+                t = await e.query("cpu", [("host", "a")],
+                                  TimeRange.new(T0, T0 + HOUR))
+                assert t.column("value").to_pylist() == [1.0]
+                # null tags rejected with the framework Error
+                bad = pa.record_batch({
+                    "host": pa.array(["a", None]),
+                    "timestamp": pa.array([T0, T0], type=pa.int64()),
+                    "value": pa.array([1.0, 2.0], type=pa.float64()),
+                })
+                with pytest.raises(Error, match="nulls"):
+                    await e.write_arrow("cpu", ["host"], bad)
+                # non-castable timestamp rejected
+                bad2 = pa.record_batch({
+                    "host": pa.array(["a"]),
+                    "timestamp": pa.array(["yesterday"]),
+                    "value": pa.array([1.0], type=pa.float64()),
+                })
+                with pytest.raises(Error, match="cast"):
+                    await e.write_arrow("cpu", ["host"], bad2)
+            finally:
+                await e.close()
+
+        asyncio.run(go())

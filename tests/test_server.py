@@ -153,3 +153,88 @@ class TestConfigValidation:
         p.write_text("[test]\nenable_write = 'false'\n")
         with pytest.raises(Error, match="boolean"):
             load_config(str(p))
+
+
+class TestArrowIpcIngest:
+    def test_write_arrow_endpoint_roundtrip(self):
+        async def go():
+            import io
+
+            import pyarrow as pa
+            import pyarrow.ipc
+
+            client, _state, engine = await make_client()
+            try:
+                batch = pa.record_batch({
+                    "host": pa.array(["a", "b", "a"]),
+                    "timestamp": pa.array([T0, T0 + 1000, T0 + 2000],
+                                          type=pa.int64()),
+                    "value": pa.array([1.0, 2.0, 3.0], type=pa.float64()),
+                })
+                sink = io.BytesIO()
+                with pyarrow.ipc.new_stream(sink, batch.schema) as w:
+                    w.write_batch(batch)
+                r = await client.post(
+                    "/write_arrow?metric=cpu&tags=host",
+                    data=sink.getvalue())
+                assert r.status == 200 and (await r.json())["written"] == 3
+                r = await client.post("/query", json={
+                    "metric": "cpu", "filters": {"host": "a"},
+                    "start": T0, "end": T0 + HOUR})
+                assert (await r.json())["values"] == [1.0, 3.0]
+            finally:
+                await client.close()
+                await engine.close()
+
+        run(go())
+
+    def test_write_arrow_bad_body(self):
+        async def go():
+            client, _state, engine = await make_client()
+            try:
+                r = await client.post("/write_arrow?metric=cpu&tags=host",
+                                      data=b"not arrow")
+                assert r.status == 400
+                r = await client.post("/write_arrow", data=b"")
+                assert r.status == 400  # missing metric
+            finally:
+                await client.close()
+                await engine.close()
+
+        run(go())
+
+    def test_remote_region_write_arrow(self):
+        async def go():
+            import aiohttp
+            from aiohttp.test_utils import TestServer
+
+            import pyarrow as pa
+
+            from horaedb_tpu.cluster import RemoteRegion
+            from horaedb_tpu.storage.types import TimeRange
+
+            engine = await MetricEngine.open("m2", MemoryObjectStore(),
+                                             segment_ms=2 * HOUR)
+            server = TestServer(build_app(ServerState(engine, ServerConfig())))
+            await server.start_server()
+            session = aiohttp.ClientSession()
+            remote = RemoteRegion(str(server.make_url("/")), session)
+            try:
+                batch = pa.record_batch({
+                    "host": pa.array(["x"] * 5),
+                    "timestamp": pa.array([T0 + i * 1000 for i in range(5)],
+                                          type=pa.int64()),
+                    "value": pa.array([float(i) for i in range(5)],
+                                      type=pa.float64()),
+                })
+                await remote.write_arrow("cpu", ["host"], batch)
+                t = await remote.query("cpu", [("host", "x")],
+                                       TimeRange.new(T0, T0 + HOUR))
+                assert t.num_rows == 5
+            finally:
+                await remote.close()
+                await session.close()
+                await server.close()
+                await engine.close()
+
+        run(go())
