@@ -9,5 +9,7 @@ behavior here is built from the RFC)."""
 
 from horaedb_tpu.metric_engine.types import Label, Sample, metric_id_of, series_key_of, tsid_of
 from horaedb_tpu.metric_engine.engine import MetricEngine
+from horaedb_tpu.metric_engine.functions import delta, increase, rate
 
-__all__ = ["Label", "MetricEngine", "Sample", "metric_id_of", "series_key_of", "tsid_of"]
+__all__ = ["Label", "MetricEngine", "Sample", "delta", "increase",
+           "metric_id_of", "rate", "series_key_of", "tsid_of"]

@@ -1,0 +1,54 @@
+"""Range-vector functions over downsample grids (rate / increase / delta).
+
+The reference's legacy architecture pushes sum/rate down from a
+Prometheus Query Frontend (RFC 20220702, SURVEY.md section 5); here the
+counterpart operates on the (series, bucket) grids that
+query_downsample / the cluster scatter-gather return.  Pure numpy: the
+grids are tiny compared to the scanned data, so this is frontend work,
+not device work.
+
+Counter semantics follow Prometheus: `increase` sums positive deltas
+(counter resets — a drop in value — contribute the post-reset value),
+`rate` is increase per second, `delta` is the raw last-first difference
+for gauges.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _per_bucket_last(aggs: dict) -> np.ndarray:
+    return np.asarray(aggs["last"], dtype=np.float64)
+
+
+def delta(aggs: dict, bucket_ms: int) -> np.ndarray:
+    """Gauge delta per bucket: last(bucket) - last(previous bucket).
+    First bucket and buckets following an empty bucket are NaN."""
+    last = _per_bucket_last(aggs)
+    out = np.full_like(last, np.nan)
+    out[:, 1:] = last[:, 1:] - last[:, :-1]
+    return out
+
+
+def increase(aggs: dict, bucket_ms: int) -> np.ndarray:
+    """Counter increase per bucket, reset-aware.
+
+    Uses last-per-bucket samples: increase = last - prev_last, except on
+    a counter reset (value dropped), where the post-reset value itself is
+    the increase since the reset.  NaN where either side is empty."""
+    last = _per_bucket_last(aggs)
+    out = np.full_like(last, np.nan)
+    prev = last[:, :-1]
+    cur = last[:, 1:]
+    raw = cur - prev
+    # either side empty -> undefined (NaN), matching Prometheus's
+    # two-sample requirement; only a genuine drop counts as a reset
+    out[:, 1:] = np.where(np.isnan(prev) | np.isnan(cur), np.nan,
+                          np.where(raw >= 0, raw, cur))
+    return out
+
+
+def rate(aggs: dict, bucket_ms: int) -> np.ndarray:
+    """Counter rate per second per bucket (increase / bucket seconds)."""
+    return increase(aggs, bucket_ms) / (bucket_ms / 1000.0)

@@ -172,6 +172,7 @@ def build_app(state: ServerState) -> web.Application:
             rng = TimeRange.new(int(body["start"]), int(body["end"]))
             bucket_ms = body.get("bucket_ms")
             field = body.get("field", "value")
+            fn = body.get("fn")
         except (KeyError, TypeError, ValueError) as e:
             return web.json_response({"error": f"bad request: {e}"}, status=400)
         try:
@@ -179,6 +180,17 @@ def build_app(state: ServerState) -> web.Application:
                 out = await state.engine.query_downsample(
                     metric, filters, rng, int(bucket_ms), field=field)
                 aggs = {k: _grid_json(v) for k, v in out["aggs"].items()}
+                if fn is not None:
+                    from horaedb_tpu.metric_engine import functions
+
+                    impl = getattr(functions, fn, None)
+                    if impl is None or fn.startswith("_"):
+                        return web.json_response(
+                            {"error": f"unknown fn {fn!r}; supported: "
+                                      "rate, increase, delta"}, status=400)
+                    if out["tsids"]:
+                        aggs[fn] = _grid_json(impl(out["aggs"],
+                                                   int(bucket_ms)))
                 return web.json_response({
                     "tsids": [str(t) for t in out["tsids"]],
                     "num_buckets": out["num_buckets"], "aggs": aggs})

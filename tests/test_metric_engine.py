@@ -539,3 +539,32 @@ class TestBulkArrowIngest:
                 await e.close()
 
         asyncio.run(go())
+
+
+class TestRangeFunctions:
+    def grids(self, last_rows):
+        last = np.array(last_rows, dtype=np.float64)
+        return {"last": last, "count": np.where(np.isnan(last), 0, 1)}
+
+    def test_delta(self):
+        from horaedb_tpu.metric_engine import delta
+        out = delta(self.grids([[1.0, 4.0, 2.0]]), 60_000)
+        assert np.isnan(out[0, 0])
+        assert out[0, 1:].tolist() == [3.0, -2.0]
+
+    def test_increase_with_reset(self):
+        from horaedb_tpu.metric_engine import increase
+        # counter: 10 -> 25 -> reset to 5 -> 12
+        out = increase(self.grids([[10.0, 25.0, 5.0, 12.0]]), 60_000)
+        assert np.isnan(out[0, 0])
+        assert out[0, 1:].tolist() == [15.0, 5.0, 7.0]
+
+    def test_rate(self):
+        from horaedb_tpu.metric_engine import rate
+        out = rate(self.grids([[0.0, 120.0]]), 60_000)
+        assert out[0, 1] == 2.0  # 120 over 60s
+
+    def test_nan_propagates_through_empty_buckets(self):
+        from horaedb_tpu.metric_engine import increase
+        out = increase(self.grids([[1.0, np.nan, 5.0]]), 60_000)
+        assert np.isnan(out[0, 1]) and np.isnan(out[0, 2])

@@ -238,3 +238,29 @@ class TestArrowIpcIngest:
                 await engine.close()
 
         run(go())
+
+
+class TestRangeFunctionEndpoint:
+    def test_rate_over_http(self):
+        async def go():
+            client, _state, engine = await make_client()
+            try:
+                samples = [{"name": "reqs", "labels": {"h": "a"},
+                            "timestamp": T0 + i * 60_000,
+                            "value": float(i * 60)} for i in range(4)]
+                await client.post("/write", json={"samples": samples})
+                r = await client.post("/query", json={
+                    "metric": "reqs", "filters": {}, "start": T0,
+                    "end": T0 + 240_000, "bucket_ms": 60_000, "fn": "rate"})
+                body = await r.json()
+                assert r.status == 200
+                assert body["aggs"]["rate"][0][1:] == [1.0, 1.0, 1.0]
+                r = await client.post("/query", json={
+                    "metric": "reqs", "filters": {}, "start": T0,
+                    "end": T0 + 240_000, "bucket_ms": 60_000, "fn": "evil"})
+                assert r.status == 400
+            finally:
+                await client.close()
+                await engine.close()
+
+        run(go())
