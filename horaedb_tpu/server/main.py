@@ -183,11 +183,16 @@ def build_app(state: ServerState) -> web.Application:
                 if fn is not None:
                     from horaedb_tpu.metric_engine import functions
 
-                    impl = getattr(functions, fn, None)
-                    if impl is None or fn.startswith("_"):
+                    # explicit whitelist: getattr dispatch would accept
+                    # module attributes (fn="np") and 500 on call
+                    supported = {"rate": functions.rate,
+                                 "increase": functions.increase,
+                                 "delta": functions.delta}
+                    impl = supported.get(fn) if isinstance(fn, str) else None
+                    if impl is None:
                         return web.json_response(
                             {"error": f"unknown fn {fn!r}; supported: "
-                                      "rate, increase, delta"}, status=400)
+                                      f"{sorted(supported)}"}, status=400)
                     if out["tsids"]:
                         aggs[fn] = _grid_json(impl(out["aggs"],
                                                    int(bucket_ms)))

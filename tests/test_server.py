@@ -264,3 +264,18 @@ class TestRangeFunctionEndpoint:
                 await engine.close()
 
         run(go())
+
+    def test_fn_whitelist(self):
+        async def go():
+            client, _state, engine = await make_client()
+            try:
+                for bad in ("np", "annotations", 5, "_per_bucket_last"):
+                    r = await client.post("/query", json={
+                        "metric": "x", "filters": {}, "start": T0,
+                        "end": T0 + 60_000, "bucket_ms": 60_000, "fn": bad})
+                    assert r.status == 400, bad
+            finally:
+                await client.close()
+                await engine.close()
+
+        run(go())
