@@ -1,0 +1,84 @@
+"""Opaque chunk codec for the RFC's batched data design.
+
+RFC 20240827 (data design): "Timestamp and Value are encoded by the
+upper layer itself; data is batched — e.g. 30 minutes compressed into
+one row", with the engine's Append/BytesMerge path concatenating chunk
+payloads for the same primary key across files.
+
+Codec (numpy-vectorized, little-endian):
+
+    chunk := magic u8 | count u32 | ts_base i64 | ts_delta i32[count]
+             | values f64[count]
+
+Deltas are relative to ts_base (chunk windows are minutes to hours, so
+int32 always fits); parquet's Snappy over the binary column compresses
+the delta'd timestamps well.  A BytesMerge'd payload is a SEQUENCE of
+chunks — decode_chunks walks them and concatenates.
+
+Duplicate policy: chunks arrive in sequence order (BytesMerge
+concatenates in (pk, __seq__) order), so for equal timestamps the LAST
+occurrence wins — the RFC's dedup-by-seq rule applied at decode time.
+"""
+
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+from horaedb_tpu.common.error import Error, ensure
+
+_MAGIC = 0xC7
+_HEADER = struct.Struct("<BIq")  # magic u8 | count u32 | ts_base i64
+
+
+def encode_chunk(ts: np.ndarray, values: np.ndarray) -> bytes:
+    """Encode one chunk; ts int64 ms (any order, will be sorted),
+    values float64 aligned with ts."""
+    ensure(len(ts) == len(values), "ts/values length mismatch")
+    ensure(len(ts) > 0, "empty chunk")
+    order = np.argsort(ts, kind="stable")
+    ts = np.asarray(ts, dtype=np.int64)[order]
+    values = np.asarray(values, dtype=np.float64)[order]
+    base = int(ts[0])
+    deltas = ts - base
+    ensure(int(deltas.max()) < 2**31, "chunk time span exceeds int32 deltas")
+    return (_HEADER.pack(_MAGIC, len(ts), base)
+            + deltas.astype(np.int32).tobytes()
+            + values.tobytes())
+
+
+def decode_chunks(payload: bytes) -> tuple[np.ndarray, np.ndarray]:
+    """Decode a (possibly concatenated) chunk payload into
+    (ts int64, values float64), sorted by ts with last-wins dedup."""
+    if not payload:
+        return (np.empty(0, dtype=np.int64), np.empty(0, dtype=np.float64))
+    all_ts: list[np.ndarray] = []
+    all_vals: list[np.ndarray] = []
+    off = 0
+    n = len(payload)
+    while off < n:
+        if off + _HEADER.size > n:
+            raise Error("truncated chunk header")
+        magic, count, base = _HEADER.unpack_from(payload, off)
+        if magic != _MAGIC:
+            raise Error(f"bad chunk magic 0x{magic:02x} at offset {off}")
+        off += _HEADER.size
+        need = count * (4 + 8)
+        if off + need > n:
+            raise Error("truncated chunk body")
+        deltas = np.frombuffer(payload, dtype="<i4", count=count, offset=off)
+        off += count * 4
+        vals = np.frombuffer(payload, dtype="<f8", count=count, offset=off)
+        off += count * 8
+        all_ts.append(base + deltas.astype(np.int64))
+        all_vals.append(vals)
+    ts = np.concatenate(all_ts)
+    vals = np.concatenate(all_vals)
+    # stable sort + keep the LAST occurrence per timestamp (seq order)
+    order = np.argsort(ts, kind="stable")
+    ts = ts[order]
+    vals = vals[order]
+    keep = np.ones(len(ts), dtype=bool)
+    keep[:-1] = ts[:-1] != ts[1:]
+    return ts[keep], vals[keep]

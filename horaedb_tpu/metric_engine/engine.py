@@ -71,6 +71,15 @@ _TABLE_SCHEMAS = {
     ]), 4),
 }
 
+# chunked data table (RFC:218-231): (ts, value) pairs batch-encoded into
+# opaque payloads, one row per (series, field, chunk window); Append mode
+# so the BytesMerge path concatenates same-key payloads across files
+_CHUNKED_DATA_SCHEMA = (pa.schema([
+    ("metric_id", pa.uint64()), ("tsid", pa.uint64()),
+    ("field_id", pa.uint64()), ("chunk_ts", pa.int64()),
+    ("payload", pa.binary()),
+]), 4)
+
 FIELD_TYPE_FLOAT = 0
 # keep per-(segment) registration dedup state for this many newest segments;
 # older entries can never be useful again and would grow without bound
@@ -266,6 +275,48 @@ class SampleManager:
         self.table = table
         self.segment_ms = segment_ms
 
+    async def persist_chunked(self, samples: list[Sample],
+                              chunk_window_ms: int) -> None:
+        """Opaque-chunk layout: one row per (series, field, chunk window)
+        holding the encoded (ts, value) payload (RFC:218-231)."""
+        import numpy as np
+
+        from horaedb_tpu.metric_engine import chunks
+
+        groups: dict[tuple, list[Sample]] = {}
+        for s in samples:
+            ensure(s.series_id is not None, "populate_series_ids must run first")
+            # trunc-toward-zero breaks the window-containment invariant
+            # for pre-epoch times; chunked mode rejects them explicitly
+            ensure(s.timestamp >= 0,
+                   "chunked data mode requires non-negative timestamps")
+            chunk_ts = int(Timestamp(s.timestamp).truncate_by(chunk_window_ms))
+            groups.setdefault(
+                (s.name_id, s.series_id, field_id_of(s.field_name), chunk_ts),
+                []).append(s)
+
+        by_seg: dict[int, list[tuple]] = {}
+        for key, grp in groups.items():
+            seg = int(Timestamp(key[3]).truncate_by(self.segment_ms))
+            payload = chunks.encode_chunk(
+                np.asarray([s.timestamp for s in grp], dtype=np.int64),
+                np.asarray([s.value for s in grp], dtype=np.float64))
+            by_seg.setdefault(seg, []).append((*key, payload))
+        for seg, rows in sorted(by_seg.items()):
+            # the file covers its chunk WINDOWS in full, so any query range
+            # overlapping a window finds the file
+            lo = min(r[3] for r in rows)
+            hi = max(r[3] for r in rows) + chunk_window_ms
+            batch = pa.record_batch(
+                [pa.array([r[0] for r in rows], type=pa.uint64()),
+                 pa.array([r[1] for r in rows], type=pa.uint64()),
+                 pa.array([r[2] for r in rows], type=pa.uint64()),
+                 pa.array([r[3] for r in rows], type=pa.int64()),
+                 pa.array([r[4] for r in rows], type=pa.binary())],
+                schema=self.table.schema().user_schema)
+            await self.table.write(WriteRequest(
+                batch, TimeRange.new(lo, hi)))
+
     async def persist(self, samples: list[Sample]) -> None:
         by_seg: dict[int, list[Sample]] = {}
         for s in samples:
@@ -288,11 +339,21 @@ class SampleManager:
 
 
 class MetricEngine:
-    """The user-facing metric API over five storage instances."""
+    """The user-facing metric API over five storage instances.
 
-    def __init__(self, tables: dict[str, CloudObjectStorage], segment_ms: int):
+    chunked_data=True switches the data table to the RFC's opaque-chunk
+    layout: (ts, value) pairs batch-encoded per (series, field, chunk
+    window) with Append/BytesMerge semantics (RFC:218-231).  Better
+    compression and tiny row counts; queries decode chunks on host, so
+    the aggregate pushdown applies only to the row layout."""
+
+    def __init__(self, tables: dict[str, CloudObjectStorage], segment_ms: int,
+                 chunked_data: bool = False,
+                 chunk_window_ms: int = 30 * 60 * 1000):
         self.tables = tables
         self.segment_ms = segment_ms
+        self.chunked_data = chunked_data
+        self.chunk_window_ms = chunk_window_ms
         self.metric_manager = MetricManager(tables["metrics"], segment_ms)
         self.index_manager = IndexManager(tables["series"], tables["tags"],
                                           tables["index"], segment_ms)
@@ -301,13 +362,30 @@ class MetricEngine:
     @classmethod
     async def open(cls, root_path: str, store: ObjectStore,
                    segment_ms: int = 2 * 3600 * 1000,
-                   config: Optional[StorageConfig] = None) -> "MetricEngine":
+                   config: Optional[StorageConfig] = None,
+                   chunked_data: bool = False,
+                   chunk_window_ms: int = 30 * 60 * 1000) -> "MetricEngine":
+        import dataclasses
+
+        if chunked_data:
+            ensure(chunk_window_ms <= segment_ms
+                   and segment_ms % chunk_window_ms == 0,
+                   "chunk window must evenly divide the segment duration")
         tables = {}
-        for name, (schema, num_pks) in _TABLE_SCHEMAS.items():
+        schemas = dict(_TABLE_SCHEMAS)
+        if chunked_data:
+            schemas["data"] = _CHUNKED_DATA_SCHEMA
+        for name, (schema, num_pks) in schemas.items():
+            cfg = config or StorageConfig()
+            if chunked_data and name == "data":
+                from horaedb_tpu.storage.config import UpdateMode
+
+                cfg = dataclasses.replace(cfg, update_mode=UpdateMode.APPEND)
             tables[name] = await CloudObjectStorage.open(
                 f"{root_path}/{name}", segment_ms, store, schema, num_pks,
-                config or StorageConfig())
-        return cls(tables, segment_ms)
+                cfg)
+        return cls(tables, segment_ms, chunked_data=chunked_data,
+                   chunk_window_ms=chunk_window_ms)
 
     async def close(self) -> None:
         for t in self.tables.values():
@@ -321,7 +399,11 @@ class MetricEngine:
             return
         await self.metric_manager.populate_metric_ids(samples)
         await self.index_manager.populate_series_ids(samples)
-        await self.sample_manager.persist(samples)
+        if self.chunked_data:
+            await self.sample_manager.persist_chunked(samples,
+                                                      self.chunk_window_ms)
+        else:
+            await self.sample_manager.persist(samples)
 
     async def write_arrow(self, metric: str, tag_columns: list[str],
                           batch: pa.RecordBatch,
@@ -419,6 +501,10 @@ class MetricEngine:
         tsids = tsid_of_code[codes]
         data = self.tables["data"]
         fid = field_id_of(field)
+        if self.chunked_data:
+            await self._write_arrow_chunked(mid, fid, codes, tsid_of_code,
+                                            ts_np, val_np)
+            return
         for seg in np.unique(seg_ids):
             m = seg_ids == seg
             seg_ts = ts_np[m]
@@ -431,6 +517,45 @@ class MetricEngine:
                 schema=data.schema().user_schema)
             await data.write(WriteRequest(
                 out, TimeRange.new(int(seg_ts.min()), int(seg_ts.max()) + 1)))
+
+    async def _write_arrow_chunked(self, mid, fid, codes, tsid_of_code,
+                                   ts_np, val_np) -> None:
+        """Bulk path for the chunked layout: group rows by (series, chunk
+        window) in numpy, encode one payload per group."""
+        import numpy as np
+
+        from horaedb_tpu.metric_engine import chunks
+
+        ensure(int(ts_np.min()) >= 0,
+               "chunked data mode requires non-negative timestamps")
+        window = self.chunk_window_ms
+        chunk_ts = (ts_np // window) * window
+        pair = np.stack([codes.astype(np.int64), chunk_ts], axis=1)
+        uniq_pairs, inv = np.unique(pair, axis=0, return_inverse=True)
+        order = np.argsort(inv, kind="stable")
+        boundaries = np.concatenate(
+            [[0], np.cumsum(np.bincount(inv, minlength=len(uniq_pairs)))])
+
+        by_seg: dict[int, list[tuple]] = {}
+        for g in range(len(uniq_pairs)):
+            rows = order[boundaries[g]:boundaries[g + 1]]
+            code_idx, c_ts = int(uniq_pairs[g, 0]), int(uniq_pairs[g, 1])
+            payload = chunks.encode_chunk(ts_np[rows], val_np[rows])
+            seg = int(Timestamp(c_ts).truncate_by(self.segment_ms))
+            by_seg.setdefault(seg, []).append(
+                (int(tsid_of_code[code_idx]), c_ts, payload))
+        data = self.tables["data"]
+        for seg, rows in sorted(by_seg.items()):
+            lo = min(r[1] for r in rows)
+            hi = max(r[1] for r in rows) + window
+            batch = pa.record_batch(
+                [pa.array(np.full(len(rows), mid, dtype=np.uint64)),
+                 pa.array([r[0] for r in rows], type=pa.uint64()),
+                 pa.array(np.full(len(rows), fid, dtype=np.uint64)),
+                 pa.array([r[1] for r in rows], type=pa.int64()),
+                 pa.array([r[2] for r in rows], type=pa.binary())],
+                schema=data.schema().user_schema)
+            await data.write(WriteRequest(batch, TimeRange.new(lo, hi)))
 
     # ---- read -------------------------------------------------------------
 
@@ -446,9 +571,18 @@ class MetricEngine:
         if tsids is not None and not tsids:
             return None
         preds = [Eq("metric_id", mid),
-                 Eq("field_id", field_id_of(field)),
-                 TimeRangePred("timestamp", int(time_range.start),
-                               int(time_range.end))]
+                 Eq("field_id", field_id_of(field))]
+        if self.chunked_data:
+            # a chunk's row key is its window start; a window overlapping
+            # the query starts at or after truncate(start, window)
+            # (chunked mode stores only non-negative timestamps, so the
+            # truncation is a true floor)
+            lo = int(Timestamp(max(0, int(time_range.start))).truncate_by(
+                self.chunk_window_ms))
+            preds.append(TimeRangePred("chunk_ts", lo, int(time_range.end)))
+        else:
+            preds.append(TimeRangePred("timestamp", int(time_range.start),
+                                       int(time_range.end)))
         if tsids is not None:
             preds.append(In("tsid", sorted(tsids)))
         return And(preds)
@@ -465,8 +599,39 @@ class MetricEngine:
             range=time_range, predicate=pred)))
         if not batches:
             return _empty_result()
+        if self.chunked_data:
+            return self._decode_chunk_batches(batches, time_range)
         tbl = pa.Table.from_batches(batches)
         return tbl.select(["tsid", "timestamp", "value"])
+
+    def _decode_chunk_batches(self, batches: list[pa.RecordBatch],
+                              time_range: TimeRange) -> pa.Table:
+        import numpy as np
+
+        from horaedb_tpu.metric_engine import chunks
+
+        out_tsid: list[np.ndarray] = []
+        out_ts: list[np.ndarray] = []
+        out_val: list[np.ndarray] = []
+        lo, hi = int(time_range.start), int(time_range.end)
+        for b in batches:
+            tsid_col = b.column(b.schema.names.index("tsid")).to_pylist()
+            payloads = b.column(b.schema.names.index("payload")).to_pylist()
+            for tsid, payload in zip(tsid_col, payloads):
+                ts, vals = chunks.decode_chunks(payload)
+                m = (ts >= lo) & (ts < hi)
+                if m.any():
+                    out_ts.append(ts[m])
+                    out_val.append(vals[m])
+                    out_tsid.append(np.full(int(m.sum()), tsid,
+                                            dtype=np.uint64))
+        if not out_ts:
+            return _empty_result()
+        return pa.table({
+            "tsid": pa.array(np.concatenate(out_tsid), type=pa.uint64()),
+            "timestamp": pa.array(np.concatenate(out_ts), type=pa.int64()),
+            "value": pa.array(np.concatenate(out_val), type=pa.float64()),
+        })
 
     async def resolve_series(self, metric: str, tsids: list[int],
                              time_range: TimeRange) -> dict[int, bytes]:
@@ -491,6 +656,12 @@ class MetricEngine:
                f"query window of {span}ms exceeds the int32 offset range "
                "(~24.8 days); split the query into smaller windows")
         num_buckets = -(-span // bucket_ms)
+        if self.chunked_data:
+            # chunk payloads are opaque to the scan, so decode rows first
+            # and aggregate the decoded columns on device
+            tbl = await self.query(metric, filters, time_range, field=field)
+            return self._downsample_rows(tbl, time_range, bucket_ms,
+                                         num_buckets)
         pred = await self._resolve_data_predicate(metric, filters,
                                                   time_range, field)
         if pred is None:
@@ -504,6 +675,37 @@ class MetricEngine:
         return {"tsids": [int(t) for t in group_values],
                 "num_buckets": num_buckets,
                 "aggs": aggs if len(group_values) else {}}
+
+    def _downsample_rows(self, tbl: pa.Table, time_range: TimeRange,
+                         bucket_ms: int, num_buckets: int) -> dict:
+        import numpy as np
+
+        from horaedb_tpu.ops.downsample import time_bucket_aggregate
+        from horaedb_tpu.ops.encode import pad_capacity
+
+        n = tbl.num_rows
+        if n == 0:
+            return {"tsids": [], "num_buckets": num_buckets, "aggs": {}}
+        tsid_np = tbl.column("tsid").to_numpy()
+        uniq, gid = np.unique(tsid_np, return_inverse=True)
+        ts_np = tbl.column("timestamp").to_numpy() - int(time_range.start)
+        val_np = tbl.column("value").to_numpy()
+        cap = pad_capacity(n)
+        pad = lambda a, d: np.pad(a.astype(d), (0, cap - n))
+        aggs = time_bucket_aggregate(
+            pad(ts_np, np.int32), pad(gid, np.int32), pad(val_np, np.float32),
+            n, bucket_ms, num_groups=len(uniq), num_buckets=num_buckets)
+        host = {k: np.asarray(v) for k, v in aggs.items()}
+        # match the pushdown path's grid keys: per-cell max sample time
+        # (absolute ms as float, NaN for empty cells)
+        cell = gid.astype(np.int64) * num_buckets + ts_np // bucket_ms
+        last_ts = np.full(len(uniq) * num_buckets, -np.inf)
+        np.maximum.at(last_ts, cell, ts_np.astype(np.float64))
+        last_ts = last_ts.reshape(len(uniq), num_buckets)
+        host["last_ts"] = np.where(np.isinf(last_ts), np.nan,
+                                   last_ts + int(time_range.start))
+        return {"tsids": [int(t) for t in uniq],
+                "num_buckets": num_buckets, "aggs": host}
 
     async def label_values(self, metric: str, tag_key: str,
                            time_range: TimeRange) -> list[str]:

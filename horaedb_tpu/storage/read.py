@@ -317,7 +317,12 @@ class ParquetReader:
                     # same semantics as the row path: post-dedup rows
                     _ROWS_SCANNED.inc(out_batch.n_valid)
             _SCAN_LATENCY.observe(read_s + (time.perf_counter() - t0))
-        return combine_aggregate_parts(parts, spec.num_buckets)
+        group_values, grids = combine_aggregate_parts(parts, spec.num_buckets)
+        # last_ts is computed relative to range_start on device; expose it
+        # as ABSOLUTE time so all downsample paths share one unit
+        if len(group_values):
+            grids["last_ts"] = grids["last_ts"] + spec.range_start
+        return group_values, grids
 
     def _aggregate_window(self, out_batch: encode.DeviceBatch,
                           spec: AggregateSpec,
