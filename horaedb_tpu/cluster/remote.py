@@ -39,15 +39,22 @@ class RemoteRegion:
             await self._session.close()
             self._session = None
 
-    async def _post(self, path: str, body: dict) -> dict:
+    async def _post_raw(self, path: str, **kwargs) -> bytes:
+        """POST with the shared status-first error contract; returns the
+        raw response body."""
         session = await self._ensure_session()
-        async with session.post(self.base_url + path, json=body) as resp:
+        async with session.post(self.base_url + path, **kwargs) as resp:
             if resp.status != 200:
                 # body may be a non-JSON error page (404 text, 500 html)
                 text = await resp.text()
                 raise Error(f"remote region {self.base_url}{path} "
                             f"returned {resp.status}: {text[:200]}")
-            return await resp.json(content_type=None)
+            return await resp.read()
+
+    async def _post(self, path: str, body: dict) -> dict:
+        import json
+
+        return json.loads(await self._post_raw(path, json=body))
 
     # ---- MetricEngine surface ---------------------------------------------
 
@@ -72,31 +79,23 @@ class RemoteRegion:
         sink = io.BytesIO()
         with pyarrow.ipc.new_stream(sink, batch.schema) as writer:
             writer.write_batch(batch)
-        session = await self._ensure_session()
-        async with session.post(
-                self.base_url + "/write_arrow",
-                params={"metric": metric, "tags": ",".join(tag_columns),
-                        "field": field},
-                data=sink.getvalue(),
-                headers={"Content-Type":
-                         "application/vnd.apache.arrow.stream"}) as resp:
-            if resp.status != 200:
-                text = await resp.text()
-                raise Error(f"remote write_arrow returned {resp.status}: "
-                            f"{text[:200]}")
+        await self._post_raw(
+            "/write_arrow",
+            params={"metric": metric, "tags": ",".join(tag_columns),
+                    "field": field},
+            data=sink.getvalue(),
+            headers={"Content-Type": "application/vnd.apache.arrow.stream"})
 
     async def query(self, metric: str, filters: list[tuple[str, str]],
                     time_range: TimeRange, field: str = "value") -> pa.Table:
-        data = await self._post("/query", {
+        """Row queries ride the Arrow-IPC plane (no per-row JSON)."""
+        import pyarrow.ipc
+
+        body = await self._post_raw("/query_arrow", json={
             "metric": metric, "filters": [list(f) for f in filters],
             "start": int(time_range.start), "end": int(time_range.end),
             "field": field})
-        return pa.table({
-            "tsid": pa.array([int(t) for t in data["tsids"]],
-                             type=pa.uint64()),
-            "timestamp": pa.array(data["timestamps"], type=pa.int64()),
-            "value": pa.array(data["values"], type=pa.float64()),
-        })
+        return pyarrow.ipc.open_stream(body).read_all()
 
     async def query_downsample(self, metric: str,
                                filters: list[tuple[str, str]],

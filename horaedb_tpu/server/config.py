@@ -10,6 +10,8 @@ selecting S3 is rejected at startup.
 from __future__ import annotations
 
 import dataclasses
+import functools
+import typing
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
@@ -51,6 +53,10 @@ class ObjectStoreConfig:
 class MetricEngineConfig:
     segment_duration: ReadableDuration = field(
         default_factory=lambda: ReadableDuration.parse("2h"))
+    # RFC opaque-chunk data layout (Append/BytesMerge path)
+    chunked_data: bool = False
+    chunk_window: ReadableDuration = field(
+        default_factory=lambda: ReadableDuration.parse("30m"))
     object_store: ObjectStoreConfig = field(default_factory=ObjectStoreConfig)
     time_merge_storage: StorageConfig = field(default_factory=StorageConfig)
 
@@ -62,6 +68,11 @@ class ServerConfig:
     metric_engine: MetricEngineConfig = field(default_factory=MetricEngineConfig)
 
 
+@functools.lru_cache(maxsize=None)
+def _hints(cls: type) -> dict[str, Any]:
+    return typing.get_type_hints(cls)
+
+
 def _dc_from_dict(cls: type, data: dict[str, Any]) -> Any:
     names = {f.name: f for f in dataclasses.fields(cls)}
     unknown = set(data) - set(names)
@@ -70,7 +81,9 @@ def _dc_from_dict(cls: type, data: dict[str, Any]) -> Any:
     kwargs: dict[str, Any] = {}
     for key, value in data.items():
         where = f"{cls.__name__}.{key}"
-        if key in ("write_interval", "segment_duration"):
+        # dispatch durations by DECLARED type, not a name whitelist —
+        # new ReadableDuration fields need no registration here
+        if _hints(cls).get(key) is ReadableDuration:
             if not isinstance(value, ReadableDuration):
                 ensure(isinstance(value, str),
                        f'{where} expects a duration string like "2h"')

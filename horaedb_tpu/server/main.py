@@ -157,21 +157,26 @@ def build_app(state: ServerState) -> web.Application:
             return web.json_response({"error": str(e)}, status=400)
         return web.json_response({"written": written})
 
+    def _parse_query_body(body: dict):
+        """Shared /query + /query_arrow request parsing.  The dict filter
+        form loses duplicate keys; the list-of-pairs form (RemoteRegion
+        sends it) preserves them."""
+        metric = body["metric"]
+        raw_filters = body.get("filters", {})
+        if isinstance(raw_filters, dict):
+            filters = sorted(raw_filters.items())
+        else:
+            filters = sorted((str(k), str(v)) for k, v in raw_filters)
+        rng = TimeRange.new(int(body["start"]), int(body["end"]))
+        field = body.get("field", "value")
+        return metric, filters, rng, field
+
     @routes.post("/query")
     async def query(req: web.Request) -> web.Response:
         try:
             body = await req.json()
-            metric = body["metric"]
-            raw_filters = body.get("filters", {})
-            # dict form loses duplicate keys; the list-of-pairs form
-            # (RemoteRegion sends it) preserves them
-            if isinstance(raw_filters, dict):
-                filters = sorted(raw_filters.items())
-            else:
-                filters = sorted((str(k), str(v)) for k, v in raw_filters)
-            rng = TimeRange.new(int(body["start"]), int(body["end"]))
+            metric, filters, rng, field = _parse_query_body(body)
             bucket_ms = body.get("bucket_ms")
-            field = body.get("field", "value")
             fn = body.get("fn")
         except (KeyError, TypeError, ValueError) as e:
             return web.json_response({"error": f"bad request: {e}"}, status=400)
@@ -207,6 +212,29 @@ def build_app(state: ServerState) -> web.Application:
         except Error as e:
             return web.json_response({"error": str(e)}, status=400)
 
+    @routes.post("/query_arrow")
+    async def query_arrow(req: web.Request) -> web.Response:
+        """Like POST /query (raw rows) but the response body is an Arrow
+        IPC stream — the symmetric read side of the Arrow data plane."""
+        import io
+
+        import pyarrow.ipc
+
+        try:
+            body = await req.json()
+            metric, filters, rng, field = _parse_query_body(body)
+        except (KeyError, TypeError, ValueError) as e:
+            return web.json_response({"error": f"bad request: {e}"}, status=400)
+        try:
+            tbl = await state.engine.query(metric, filters, rng, field=field)
+        except Error as e:
+            return web.json_response({"error": str(e)}, status=400)
+        sink = io.BytesIO()
+        with pyarrow.ipc.new_stream(sink, tbl.schema) as writer:
+            writer.write_table(tbl)
+        return web.Response(body=sink.getvalue(),
+                            content_type="application/vnd.apache.arrow.stream")
+
     @routes.get("/label_values")
     async def label_values(req: web.Request) -> web.Response:
         try:
@@ -239,7 +267,9 @@ async def run_server(config: ServerConfig,
     engine = await MetricEngine.open(
         "metrics", store,
         segment_ms=config.metric_engine.segment_duration.millis,
-        config=config.metric_engine.time_merge_storage)
+        config=config.metric_engine.time_merge_storage,
+        chunked_data=config.metric_engine.chunked_data,
+        chunk_window_ms=config.metric_engine.chunk_window.millis)
     state = ServerState(engine, config)
     if config.test.enable_write:
         state.start_generators()

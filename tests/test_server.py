@@ -279,3 +279,40 @@ class TestRangeFunctionEndpoint:
                 await engine.close()
 
         run(go())
+
+
+class TestArrowQueryEndpoint:
+    def test_query_arrow_roundtrip(self):
+        async def go():
+            import pyarrow.ipc
+
+            client, _state, engine = await make_client()
+            try:
+                samples = [{"name": "cpu", "labels": {"h": "a"},
+                            "timestamp": T0 + i * 1000, "value": float(i)}
+                           for i in range(10)]
+                await client.post("/write", json={"samples": samples})
+                r = await client.post("/query_arrow", json={
+                    "metric": "cpu", "filters": {"h": "a"},
+                    "start": T0, "end": T0 + HOUR})
+                assert r.status == 200
+                tbl = pyarrow.ipc.open_stream(await r.read()).read_all()
+                assert tbl.column("value").to_pylist() == \
+                    [float(i) for i in range(10)]
+                r = await client.post("/query_arrow", json={"metric": "x"})
+                assert r.status == 400
+            finally:
+                await client.close()
+                await engine.close()
+
+        run(go())
+
+
+class TestChunkedServerConfig:
+    def test_chunked_toml(self, tmp_path):
+        p = tmp_path / "c.toml"
+        p.write_text('[metric_engine]\nchunked_data = true\n'
+                     'chunk_window = "15m"\n')
+        cfg = load_config(str(p))
+        assert cfg.metric_engine.chunked_data is True
+        assert cfg.metric_engine.chunk_window.millis == 15 * 60 * 1000
