@@ -161,6 +161,15 @@ class MetricManager:
                 return b.column(b.schema.names.index("metric_id"))[0].as_py()
         return None
 
+    async def list_metrics(self, time_range: TimeRange) -> list[str]:
+        """Distinct metric names active in the window."""
+        names: set[str] = set()
+        for b in await _collect(self.table.scan(ScanRequest(
+                range=time_range))):
+            col = b.column(b.schema.names.index("metric_name"))
+            names.update(col.to_pylist())
+        return sorted(names)
+
 
 class IndexManager:
     """TSID resolution + series/tags/index registration per segment
@@ -254,6 +263,16 @@ class IndexManager:
             col = b.column(b.schema.names.index("tag_value"))
             vals.update(col.to_pylist())
         return sorted(vals)
+
+    async def label_names(self, metric_id: int,
+                          time_range: TimeRange) -> list[str]:
+        """Distinct tag keys of a metric in the window."""
+        keys: set[str] = set()
+        for b in await _collect(self.tags.scan(ScanRequest(
+                range=time_range, predicate=Eq("metric_id", metric_id)))):
+            col = b.column(b.schema.names.index("tag_key"))
+            keys.update(col.to_pylist())
+        return sorted(keys)
 
     async def resolve_series_keys(self, metric_id: int, tsids: list[int],
                                   time_range: TimeRange) -> dict[int, bytes]:
@@ -713,3 +732,17 @@ class MetricEngine:
         if mid is None:
             return []
         return await self.index_manager.label_values(mid, tag_key, time_range)
+
+    async def label_names(self, metric: str,
+                          time_range: TimeRange) -> list[str]:
+        """Distinct tag keys of a metric in the window (Prometheus
+        /api/v1/labels analogue)."""
+        mid = await self.metric_manager.resolve(metric, time_range)
+        if mid is None:
+            return []
+        return await self.index_manager.label_names(mid, time_range)
+
+    async def list_metrics(self, time_range: TimeRange) -> list[str]:
+        """Distinct metric names active in the window (Prometheus
+        /api/v1/label/__name__/values analogue)."""
+        return await self.metric_manager.list_metrics(time_range)

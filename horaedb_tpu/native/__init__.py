@@ -124,13 +124,19 @@ def snapshot_decode(buf: bytes) -> np.ndarray:
                                 out.ctypes.data_as(ctypes.c_void_p), n_max)
         if n == -2:
             raise Error("invalid bytes to convert to header")
+        if n == -5:
+            raise Error(f"snapshot version is newer than supported "
+                        f"{SNAPSHOT_VERSION}")
         ensure(n >= 0, f"snapshot decode failed (code {n}): length mismatch")
         return out[:n]
     import struct
 
     ensure(len(buf) >= _HEADER_LEN, "snapshot header truncated")
-    magic, _ver, _flag, length = struct.unpack_from("<IBBQ", buf)
+    magic, ver, _flag, length = struct.unpack_from("<IBBQ", buf)
     ensure(magic == SNAPSHOT_MAGIC, "invalid bytes to convert to header")
+    ensure(ver <= SNAPSHOT_VERSION,
+           f"snapshot version {ver} is newer than supported "
+           f"{SNAPSHOT_VERSION}")
     body = buf[_HEADER_LEN:]
     ensure(length == len(body) and length % _RECORD_LEN == 0,
            f"snapshot length mismatch: header={length}, body={len(body)}")

@@ -12,6 +12,10 @@ main.rs:59-80 notes "No query/read endpoint exists yet"):
   POST /query    JSON: {"metric", "filters": {k:v}, "start", "end",
                  optional "bucket_ms" -> downsample grid}
   GET  /label_values?metric=...&key=...&start=...&end=...
+  GET  /label_names?metric=...&start=...&end=...
+  GET  /metrics_list?start=...&end=...
+  POST /query_arrow   like /query (raw rows) but responds Arrow IPC
+  POST /write_arrow?metric=..&tags=a,b  body = Arrow IPC stream
 
 Run: python -m horaedb_tpu.server --config docs/example.toml
 """
@@ -234,6 +238,25 @@ def build_app(state: ServerState) -> web.Application:
             writer.write_table(tbl)
         return web.Response(body=sink.getvalue(),
                             content_type="application/vnd.apache.arrow.stream")
+
+    @routes.get("/label_names")
+    async def label_names(req: web.Request) -> web.Response:
+        try:
+            metric = req.query["metric"]
+            rng = TimeRange.new(int(req.query["start"]), int(req.query["end"]))
+        except (KeyError, ValueError) as e:
+            return web.json_response({"error": f"bad request: {e}"}, status=400)
+        return web.json_response(
+            {"names": await state.engine.label_names(metric, rng)})
+
+    @routes.get("/metrics_list")
+    async def metrics_list(req: web.Request) -> web.Response:
+        try:
+            rng = TimeRange.new(int(req.query["start"]), int(req.query["end"]))
+        except (KeyError, ValueError) as e:
+            return web.json_response({"error": f"bad request: {e}"}, status=400)
+        return web.json_response(
+            {"metrics": await state.engine.list_metrics(rng)})
 
     @routes.get("/label_values")
     async def label_values(req: web.Request) -> web.Response:

@@ -769,3 +769,22 @@ class TestChunkedDataMode:
                 await e.close()
 
         asyncio.run(go())
+
+
+class TestDiscoveryApis:
+    def test_label_names_and_list_metrics(self):
+        async def go():
+            e = await open_engine()
+            try:
+                await e.write(http_samples())
+                rng = TimeRange.new(T0, T0 + HOUR)
+                assert await e.label_names("http_requests", rng) == \
+                    ["code", "job", "url"]
+                assert await e.label_names("grpc_requests", rng) == ["job"]
+                assert await e.label_names("nope", rng) == []
+                assert await e.list_metrics(rng) == \
+                    ["grpc_requests", "http_requests"]
+            finally:
+                await e.close()
+
+        asyncio.run(go())

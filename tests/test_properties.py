@@ -1,0 +1,127 @@
+"""Property-based tests (hypothesis) for the codecs and the merge op —
+randomized invariants beyond the example-based suites."""
+
+import numpy as np
+import pyarrow as pa
+from hypothesis import given, settings, strategies as st
+
+from horaedb_tpu.metric_engine import chunks
+from horaedb_tpu.ops import encode_batch, decode_to_arrow, merge_dedup_last, pad_capacity
+from horaedb_tpu.storage.manifest.encoding import (
+    ManifestUpdate,
+    Snapshot,
+    decode_manifest_update,
+    encode_manifest_update,
+)
+from horaedb_tpu.storage.sst import FileMeta, SstFile
+from horaedb_tpu.storage.types import TimeRange
+
+_SETTINGS = settings(max_examples=40, deadline=None)
+
+file_metas = st.builds(
+    lambda fid, start, span, rows, size: SstFile(
+        fid, FileMeta(max_sequence=fid, num_rows=rows, size=size,
+                      time_range=TimeRange.new(start, start + span))),
+    fid=st.integers(0, 2**63 - 1),
+    start=st.integers(-(2**40), 2**40),
+    span=st.integers(1, 2**30),
+    rows=st.integers(0, 2**32 - 1),
+    size=st.integers(0, 2**32 - 1),
+)
+
+
+class TestManifestCodecs:
+    @_SETTINGS
+    @given(st.lists(file_metas, max_size=20, unique_by=lambda f: f.id),
+           st.lists(st.integers(0, 2**63 - 1), max_size=10))
+    def test_delta_roundtrip(self, adds, deletes):
+        upd = ManifestUpdate(to_adds=adds, to_deletes=deletes)
+        back = decode_manifest_update(encode_manifest_update(upd))
+        assert [f.id for f in back.to_adds] == [f.id for f in adds]
+        assert [f.meta for f in back.to_adds] == [f.meta for f in adds]
+        assert back.to_deletes == deletes
+
+    @_SETTINGS
+    @given(st.lists(file_metas, max_size=30, unique_by=lambda f: f.id))
+    def test_snapshot_roundtrip(self, files):
+        snap = Snapshot()
+        snap.add_records(files)
+        back = Snapshot.from_bytes(snap.into_bytes())
+        assert sorted(back.ids) == sorted(f.id for f in files)
+        for f, s in zip(sorted(files, key=lambda x: x.id),
+                        sorted(back.into_ssts(), key=lambda x: x.id)):
+            assert s.meta.num_rows == f.meta.num_rows
+            assert s.meta.time_range == f.meta.time_range
+
+
+class TestChunkCodec:
+    @_SETTINGS
+    @given(st.lists(
+        st.tuples(st.integers(0, 2**40), st.floats(allow_nan=False,
+                                                   allow_infinity=False,
+                                                   width=64)),
+        min_size=1, max_size=200))
+    def test_roundtrip_sorted_last_wins(self, points):
+        ts = np.asarray([p[0] for p in points], dtype=np.int64)
+        # keep spans encodable
+        ts = ts % (2**30)
+        vals = np.asarray([p[1] for p in points], dtype=np.float64)
+        buf = chunks.encode_chunk(ts, vals)
+        got_ts, got_vals = chunks.decode_chunks(buf)
+        # sorted, unique timestamps
+        assert (np.diff(got_ts) > 0).all()
+        # last occurrence per ts wins (stable sort ordering)
+        expected = {}
+        for t, v in zip(ts.tolist(), vals.tolist()):
+            expected[t] = v
+        assert got_ts.tolist() == sorted(expected)
+        assert got_vals.tolist() == [expected[t] for t in sorted(expected)]
+
+
+class TestMergeProperties:
+    @_SETTINGS
+    @given(st.data())
+    def test_dedup_invariants(self, data):
+        import jax.numpy as jnp
+
+        n = data.draw(st.integers(1, 300))
+        key_space = data.draw(st.integers(1, 20))
+        cap = pad_capacity(n)
+        rng = np.random.default_rng(data.draw(st.integers(0, 2**31)))
+        pk = np.pad(rng.integers(0, key_space, n).astype(np.int32),
+                    (0, cap - n))
+        seq = np.pad(rng.permutation(n).astype(np.int32), (0, cap - n))
+        val = np.pad(rng.random(n).astype(np.float32), (0, cap - n))
+        out_pks, out_seq, out_vals, out_valid, num_runs = merge_dedup_last(
+            (jnp.asarray(pk),), jnp.asarray(seq), (jnp.asarray(val),), n)
+        k = int(num_runs)
+        got_pk = np.asarray(out_pks[0])[:k]
+        # output is sorted, unique, and exactly the distinct input keys
+        assert (np.diff(got_pk) > 0).all()
+        assert set(got_pk.tolist()) == set(pk[:n].tolist())
+        # each surviving row carries the max seq of its key
+        got_seq = np.asarray(out_seq)[:k]
+        for key in np.unique(pk[:n]):
+            expect = seq[:n][pk[:n] == key].max()
+            assert got_seq[got_pk == key][0] == expect
+
+
+class TestEncodeProperties:
+    @_SETTINGS
+    @given(st.lists(st.text(min_size=0, max_size=8), min_size=1, max_size=100),
+           st.lists(st.integers(-(2**62), 2**62), min_size=1, max_size=100))
+    def test_arrow_roundtrip(self, strings, ints):
+        n = min(len(strings), len(ints))
+        batch = pa.record_batch({
+            "s": pa.array(strings[:n]),
+            "i": pa.array(ints[:n], type=pa.int64()),
+        })
+        dev = encode_batch(batch)
+        back = decode_to_arrow(dev)
+        assert back.column(0).to_pylist() == strings[:n]
+        assert back.column(1).to_pylist() == ints[:n]
+        # dict codes are order-preserving: sorting rows by code sorts
+        # them by string value
+        codes = np.asarray(dev.columns["s"][:n])
+        order_by_code = np.argsort(codes, kind="stable")
+        assert [strings[:n][i] for i in order_by_code] == sorted(strings[:n])
