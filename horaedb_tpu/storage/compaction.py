@@ -198,9 +198,11 @@ class Executor:
 
         # The same merge pipeline as scan, keeping builtin columns so
         # surviving rows retain their original sequences.
+        # use_cache=False: the inputs are deleted right after, so caching
+        # their merge would only evict hot query entries
         plan = storage.reader.build_plan(
             task.inputs, ScanRequest(range=TimeRange.new(-(2**63), 2**63 - 1)),
-            keep_builtin=True)
+            keep_builtin=True, use_cache=False)
 
         file_id = SstFile.allocate_id()
         path = sst_path(storage.root_path, file_id)

@@ -99,6 +99,10 @@ class ScanConfig:
     # max rows per compiled device window; segments larger than this are
     # processed as PK-range-partitioned windows
     max_window_rows: int = 1 << 20
+    # HBM-resident post-merge cache budget in rows (0 disables); keyed by
+    # (segment, SST set, columns) so writes/compaction invalidate
+    # structurally
+    cache_max_rows: int = 4 << 20
 
 
 @dataclass

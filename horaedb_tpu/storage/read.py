@@ -100,6 +100,9 @@ class ScanPlan:
     # pyarrow expression pushed into the Parquet reads (PK-only subtree
     # of `predicate`); the full predicate still applies post-merge
     pushdown: object = None
+    # compaction scans set this False: their input SST sets are deleted
+    # right after, so caching them only evicts hot query entries
+    use_cache: bool = True
 
 
 class ParquetReader:
@@ -114,11 +117,15 @@ class ParquetReader:
         self.schema = schema
         self.config = config
         self.segment_duration_ms = segment_duration_ms
+        from horaedb_tpu.storage.scan_cache import ScanCache
+
+        self.scan_cache = ScanCache(config.scan.cache_max_rows)
 
     # ---- plan construction -------------------------------------------------
 
     def build_plan(self, ssts: list[SstFile], request: ScanRequest,
-                   keep_builtin: bool = False) -> ScanPlan:
+                   keep_builtin: bool = False,
+                   use_cache: bool = True) -> ScanPlan:
         projections = self.schema.fill_required_projections(request.projections)
         if projections is None:
             columns = list(self.schema.arrow_schema.names)
@@ -145,22 +152,81 @@ class ParquetReader:
                 request.predicate, set(self.schema.primary_key_names))
         return ScanPlan(segments=segments, mode=self.schema.update_mode,
                         predicate=request.predicate, keep_builtin=keep_builtin,
-                        pushdown=pushdown)
+                        pushdown=pushdown, use_cache=use_cache)
 
     # ---- execution ---------------------------------------------------------
 
     async def execute(self, plan: ScanPlan) -> AsyncIterator[pa.RecordBatch]:
-        async for seg, table, read_s in self._prefetch_tables(plan):
+        if plan.mode is not UpdateMode.OVERWRITE:
+            # host (Append) path: uncached streaming merge
+            async for seg, table, read_s in self._prefetch_tables(
+                    plan.segments, plan):
+                t0 = time.perf_counter()
+                batch = self._merge_segment_table(table, seg, plan)
+                _SCAN_LATENCY.observe(read_s + (time.perf_counter() - t0))
+                if batch is not None and batch.num_rows:
+                    _ROWS_SCANNED.inc(batch.num_rows)
+                    yield batch
+            return
+        async for seg, windows, read_s in self._cached_windows(plan):
             t0 = time.perf_counter()
-            batch = self._merge_segment_table(table, seg, plan)
-            # read time (inside the prefetch task) + merge time: the true
-            # per-segment cost even though reads overlap merges
+            parts = []
+            for w in windows:
+                part = self._window_to_arrow(w, list(seg.columns), plan)
+                if part is not None and part.num_rows:
+                    parts.append(part)
+            batch = self._combine_and_strip(parts, plan)
             _SCAN_LATENCY.observe(read_s + (time.perf_counter() - t0))
             if batch is not None and batch.num_rows:
                 _ROWS_SCANNED.inc(batch.num_rows)
                 yield batch
 
-    async def _prefetch_tables(self, plan: ScanPlan):
+    def _cache_key(self, seg: SegmentPlan, plan: ScanPlan):
+        from horaedb_tpu.storage.scan_cache import segment_cache_key
+
+        # A pushdown changes WHICH rows were read pre-merge, so it is part
+        # of the cached merge output's identity.  Key on OUR predicate
+        # tree's repr (complete and deterministic) — str() of a pyarrow
+        # expression ELIDES long isin lists, so distinct predicates could
+        # collide on it.  With no pushdown the read is full, and one
+        # entry serves every predicate shape.
+        pred_key = repr(plan.predicate) if plan.pushdown is not None else ""
+        return segment_cache_key(
+            seg.segment_start, (f.id for f in seg.ssts),
+            tuple(seg.columns) + (pred_key,))
+
+    async def _cached_windows(self, plan: ScanPlan):
+        """Per segment, yield (seg, post-merge DeviceBatch windows,
+        read_seconds) — from the HBM-resident cache when the segment's
+        (SST set, columns, pushdown) is unchanged, else by reading +
+        merging (and populating the cache unless the plan opted out)."""
+        cached: dict[int, list] = {}
+        to_read: list[SegmentPlan] = []
+        for seg in plan.segments:
+            windows = (self.scan_cache.get(self._cache_key(seg, plan))
+                       if plan.use_cache else None)
+            if windows is None:
+                to_read.append(seg)
+            else:
+                cached[id(seg)] = windows
+        read_iter = self._prefetch_tables(to_read, plan).__aiter__()
+        for seg in plan.segments:
+            if id(seg) in cached:
+                yield seg, cached[id(seg)], 0.0
+                continue
+            read_seg, table, read_s = await read_iter.__anext__()
+            assert read_seg is seg
+            windows = []
+            if table.num_rows:
+                batch = table.combine_chunks().to_batches()[0]
+                windows = list(self._merged_windows(batch))
+            if plan.use_cache:
+                self.scan_cache.put(self._cache_key(seg, plan), windows,
+                                    sum(w.capacity for w in windows))
+            yield seg, windows, read_s
+
+    async def _prefetch_tables(self, segments: list[SegmentPlan],
+                               plan: ScanPlan):
         """Bounded segment prefetch shared by the row and aggregate paths:
         object-store reads overlap downstream device work while at most
         _PREFETCH_SEGMENTS tables are in memory (the permit is released
@@ -174,9 +240,9 @@ class ParquetReader:
             table = await self._read_segment_table(seg, plan.pushdown)
             return table, time.perf_counter() - t0
 
-        tasks = [asyncio.create_task(read(seg)) for seg in plan.segments]
+        tasks = [asyncio.create_task(read(seg)) for seg in segments]
         try:
-            for seg, task in zip(plan.segments, tasks):
+            for seg, task in zip(segments, tasks):
                 table, read_s = await task
                 try:
                     yield seg, table, read_s
@@ -195,15 +261,27 @@ class ParquetReader:
         ))
         return pa.concat_tables(tables)
 
+    def _combine_and_strip(self, parts: list[pa.RecordBatch],
+                           plan: ScanPlan) -> Optional[pa.RecordBatch]:
+        """Concatenate per-window outputs and drop builtin columns unless
+        the plan keeps them."""
+        if not parts:
+            return None
+        batch = (parts[0] if len(parts) == 1 else
+                 pa.Table.from_batches(parts).combine_chunks().to_batches()[0])
+        if not plan.keep_builtin:
+            keep = [c for c in batch.schema.names
+                    if not self.schema.is_builtin_name(c)]
+            batch = batch.select(keep)
+        return batch
+
     def _merge_segment_table(self, table: pa.Table, seg: SegmentPlan,
                              plan: ScanPlan) -> Optional[pa.RecordBatch]:
+        """Host (Append/BytesMerge) merge of one segment's table."""
         if table.num_rows == 0:
             return None
         batch = table.combine_chunks().to_batches()[0]
-        if plan.mode is UpdateMode.OVERWRITE:
-            merged = self._merge_on_device(batch, seg, plan)
-        else:
-            merged = self._merge_on_host(batch, plan)
+        merged = self._merge_on_host(batch, plan)
         if not plan.keep_builtin and merged is not None:
             keep = [c for c in merged.schema.names
                     if not self.schema.is_builtin_name(c)]
@@ -265,20 +343,6 @@ class ParquetReader:
                          **{name: a for name, a in zip(value_names, out_values)}},
                 encodings=dev.encodings, n_valid=int(num_runs), capacity=cap)
 
-    def _merge_on_device(self, batch: pa.RecordBatch, seg: SegmentPlan,
-                         plan: ScanPlan) -> Optional[pa.RecordBatch]:
-        out_names = list(batch.schema.names)  # preserve projection order
-        parts: list[pa.RecordBatch] = []
-        for out_batch in self._merged_windows(batch):
-            part = self._window_to_arrow(out_batch, out_names, plan)
-            if part is not None and part.num_rows:
-                parts.append(part)
-        if not parts:
-            return None
-        if len(parts) == 1:
-            return parts[0]
-        return pa.Table.from_batches(parts).combine_chunks().to_batches()[0]
-
     def _window_to_arrow(self, out_batch: encode.DeviceBatch,
                          out_names: list[str],
                          plan: ScanPlan) -> Optional[pa.RecordBatch]:
@@ -303,19 +367,17 @@ class ParquetReader:
         sorted order; each grid is (len(group_values), num_buckets)."""
         ensure(plan.mode is UpdateMode.OVERWRITE,
                "aggregate pushdown requires Overwrite mode")
-        # aggregation proceeds in segment order (via the shared prefetch)
-        # so `last` tie-breaks stay deterministic
+        # aggregation proceeds in segment order (via the shared cache/
+        # prefetch iterator) so `last` tie-breaks stay deterministic
         parts: list[tuple[np.ndarray, dict]] = []
-        async for _seg, table, read_s in self._prefetch_tables(plan):
+        async for _seg, windows, read_s in self._cached_windows(plan):
             t0 = time.perf_counter()
-            if table.num_rows:
-                batch = table.combine_chunks().to_batches()[0]
-                for out_batch in self._merged_windows(batch):
-                    part = self._aggregate_window(out_batch, spec, plan)
-                    if part is not None:
-                        parts.append(part)
-                    # same semantics as the row path: post-dedup rows
-                    _ROWS_SCANNED.inc(out_batch.n_valid)
+            for out_batch in windows:
+                part = self._aggregate_window(out_batch, spec, plan)
+                if part is not None:
+                    parts.append(part)
+                # same semantics as the row path: post-dedup rows
+                _ROWS_SCANNED.inc(out_batch.n_valid)
             _SCAN_LATENCY.observe(read_s + (time.perf_counter() - t0))
         group_values, grids = combine_aggregate_parts(parts, spec.num_buckets)
         # last_ts is computed relative to range_start on device; expose it

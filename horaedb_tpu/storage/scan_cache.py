@@ -1,0 +1,71 @@
+"""HBM-resident scan cache.
+
+The north star keeps the scan path operating "over HBM-resident
+RecordBatches" — steady-state queries should not re-decode Parquet,
+re-encode columns, or re-run the merge sort.  This cache stores each
+segment's POST-MERGE device windows keyed by
+
+    (segment_start, frozenset of SST ids, column tuple)
+
+so correctness falls out structurally: any write or compaction changes
+the segment's SST set and therefore misses the cache (no explicit
+invalidation hooks, no staleness).  Predicates and aggregation run AFTER
+the merge, so one cached entry serves every query shape over the same
+data.
+
+Eviction is LRU by total cached rows (a proxy for HBM bytes); dropping
+an entry releases its device buffers through JAX's reference counting.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Optional
+
+from horaedb_tpu.utils import registry
+
+_HITS = registry.counter("scan_cache_hits_total", "scan cache hits")
+_MISSES = registry.counter("scan_cache_misses_total", "scan cache misses")
+_EVICTIONS = registry.counter("scan_cache_evictions_total",
+                              "scan cache evictions")
+
+CacheKey = tuple
+
+
+def segment_cache_key(segment_start: int, sst_ids, columns) -> CacheKey:
+    return (segment_start, frozenset(sst_ids), tuple(columns))
+
+
+class ScanCache:
+    def __init__(self, max_rows: int):
+        self.max_rows = max_rows
+        self._entries: "OrderedDict[CacheKey, tuple[list, int]]" = OrderedDict()
+        self._total_rows = 0
+
+    def get(self, key: CacheKey) -> Optional[list]:
+        entry = self._entries.get(key)
+        if entry is None:
+            _MISSES.inc()
+            return None
+        self._entries.move_to_end(key)
+        _HITS.inc()
+        return entry[0]
+
+    def put(self, key: CacheKey, windows: list, rows: int) -> None:
+        if self.max_rows <= 0 or rows > self.max_rows:
+            return
+        if key in self._entries:
+            self._total_rows -= self._entries.pop(key)[1]
+        self._entries[key] = (windows, rows)
+        self._total_rows += rows
+        while self._total_rows > self.max_rows and self._entries:
+            _, (_, evicted_rows) = self._entries.popitem(last=False)
+            self._total_rows -= evicted_rows
+            _EVICTIONS.inc()
+
+    @property
+    def total_rows(self) -> int:
+        return self._total_rows
+
+    def __len__(self) -> int:
+        return len(self._entries)

@@ -557,3 +557,107 @@ class TestWindowedScan:
                 await s.close()
 
         asyncio.run(go())
+
+
+class TestScanCache:
+    def _cfg(self, cache_rows=1 << 20):
+        cfg = StorageConfig()
+        cfg.scheduler.schedule_interval = ReadableDuration.parse("1h")
+        cfg.scan.cache_max_rows = cache_rows
+        return cfg
+
+    def test_repeat_scan_hits_cache(self):
+        async def go():
+            s = await open_storage(config=self._cfg())
+            try:
+                await s.write(WriteRequest(
+                    make_batch([("a", 1000, 1.0), ("b", 2000, 2.0)]),
+                    TimeRange.new(1000, 2001)))
+                cache = s.reader.scan_cache
+                r1 = rows_of(await collect(s.scan(
+                    ScanRequest(range=TimeRange.new(0, 10_000)))))
+                assert len(cache) == 1
+                r2 = rows_of(await collect(s.scan(
+                    ScanRequest(range=TimeRange.new(0, 10_000)))))
+                assert r1 == r2
+                # a different predicate still reuses the cached merge
+                # (no pushdown parts changed -> same key) when the
+                # predicate is value-only
+                r3 = rows_of(await collect(s.scan(ScanRequest(
+                    range=TimeRange.new(0, 10_000),
+                    predicate=Gt("cpu", 1.5)))))
+                assert r3 == [("b", 2000, 2.0)]
+            finally:
+                await s.close()
+
+        asyncio.run(go())
+
+    def test_write_invalidates_structurally(self):
+        async def go():
+            s = await open_storage(config=self._cfg())
+            try:
+                await s.write(WriteRequest(
+                    make_batch([("a", 1000, 1.0)]), TimeRange.new(1000, 1001)))
+                r1 = rows_of(await collect(s.scan(
+                    ScanRequest(range=TimeRange.new(0, 10_000)))))
+                # new write changes the SST set -> new key -> fresh merge
+                await s.write(WriteRequest(
+                    make_batch([("a", 1000, 9.0)]), TimeRange.new(1000, 1001)))
+                r2 = rows_of(await collect(s.scan(
+                    ScanRequest(range=TimeRange.new(0, 10_000)))))
+                assert r1 == [("a", 1000, 1.0)]
+                assert r2 == [("a", 1000, 9.0)]
+            finally:
+                await s.close()
+
+        asyncio.run(go())
+
+    def test_compaction_invalidates_structurally(self):
+        async def go():
+            cfg = self._cfg()
+            cfg.scheduler.input_sst_min_num = 2
+            s = await open_storage(config=cfg)
+            try:
+                for v in (1.0, 2.0):
+                    await s.write(WriteRequest(
+                        make_batch([("a", 1000, v)]),
+                        TimeRange.new(1000, 1001)))
+                assert rows_of(await collect(s.scan(
+                    ScanRequest(range=TimeRange.new(0, 10_000))))) == \
+                    [("a", 1000, 2.0)]
+                task = await s.compact_scheduler.picker.pick_candidate()
+                await s.compact_scheduler.executor.execute(task)
+                assert rows_of(await collect(s.scan(
+                    ScanRequest(range=TimeRange.new(0, 10_000))))) == \
+                    [("a", 1000, 2.0)]
+            finally:
+                await s.close()
+
+        asyncio.run(go())
+
+    def test_eviction_bound(self):
+        from horaedb_tpu.storage.scan_cache import ScanCache
+        c = ScanCache(max_rows=300)
+        c.put(("k1",), ["w"], 128)
+        c.put(("k2",), ["w"], 128)
+        assert c.total_rows == 256 and len(c) == 2
+        c.put(("k3",), ["w"], 128)  # evicts k1 (LRU)
+        assert c.total_rows == 256
+        assert c.get(("k1",)) is None
+        assert c.get(("k2",)) is not None
+        # oversized entries are not cached
+        c.put(("big",), ["w"], 10_000)
+        assert c.get(("big",)) is None
+
+    def test_disabled_cache(self):
+        async def go():
+            s = await open_storage(config=self._cfg(cache_rows=0))
+            try:
+                await s.write(WriteRequest(
+                    make_batch([("a", 1000, 1.0)]), TimeRange.new(1000, 1001)))
+                await collect(s.scan(ScanRequest(range=TimeRange.new(0, 10_000))))
+                assert len(s.reader.scan_cache) == 0
+            finally:
+                await s.close()
+
+        asyncio.run(go())
