@@ -144,6 +144,11 @@ def predicate_columns(pred: Predicate) -> set[str]:
 
 
 def to_arrow_expression(pred: Predicate, allowed: set[str]):
+    expr, _key = to_arrow_expression_with_key(pred, allowed)
+    return expr
+
+
+def to_arrow_expression_with_key(pred: Predicate, allowed: set[str]):
     """Translate the safely-pushable part of a predicate tree into a
     pyarrow compute expression for Parquet row-group pruning + pre-merge
     row filtering (the analogue of the reference's ParquetExec pruning
@@ -157,7 +162,11 @@ def to_arrow_expression(pred: Predicate, allowed: set[str]):
     positive polarity an unpushable subterm relaxes to TRUE (so And drops
     it, and an Or containing one becomes unpushable), while under Not the
     child must translate exactly (widening under negation would wrongly
-    narrow).  Returns None when the bound degenerates to TRUE.
+    narrow).  Returns (expr, key): expr is None when the bound
+    degenerates to TRUE; key is a complete canonical string of the PUSHED
+    subtree (scan-cache identity — pyarrow's own str() elides long isin
+    lists, and keying the full predicate would duplicate cache entries
+    for predicates sharing one pushed subtree).
     """
     import pyarrow.compute as pc
 
@@ -186,46 +195,56 @@ def to_arrow_expression(pred: Predicate, allowed: set[str]):
         return None
 
     def strict(p: Predicate):
-        """Exact translation; None if any part is not pushable."""
+        """Exact translation as (expr, key); None if not fully pushable."""
         if isinstance(p, (And, Or)):
             parts = [strict(c) for c in p.children]
             if any(x is None for x in parts):
                 return None
-            out = parts[0]
-            for x in parts[1:]:
+            out, key = parts[0]
+            for x, k in parts[1:]:
                 out = (out & x) if isinstance(p, And) else (out | x)
-            return out
+                key = f"({'and' if isinstance(p, And) else 'or'} {key} {k})"
+            return out, key
         if isinstance(p, Not):
             inner = strict(p.child)
-            return None if inner is None else ~inner
-        return leaf(p)
+            if inner is None:
+                return None
+            return ~inner[0], f"(not {inner[1]})"
+        expr = leaf(p)
+        return None if expr is None else (expr, repr(p))
 
     def upper(p: Predicate):
-        """Upper bound; TRUE when nothing constrains."""
+        """Upper bound as (expr, key); TRUE when nothing constrains."""
         if isinstance(p, And):
             parts = [x for x in (upper(c) for c in p.children) if x is not TRUE]
             if not parts:
                 return TRUE
-            out = parts[0]
-            for x in parts[1:]:
+            out, key = parts[0]
+            for x, k in parts[1:]:
                 out = out & x
-            return out
+                key = f"(and {key} {k})"
+            return out, key
         if isinstance(p, Or):
             parts = [upper(c) for c in p.children]
             if any(x is TRUE for x in parts):
                 return TRUE  # one unconstrained branch unbounds the union
-            out = parts[0]
-            for x in parts[1:]:
+            out, key = parts[0]
+            for x, k in parts[1:]:
                 out = out | x
-            return out
+                key = f"(or {key} {k})"
+            return out, key
         if isinstance(p, Not):
             inner = strict(p.child)  # exact required under negation
-            return TRUE if inner is None else ~inner
+            if inner is None:
+                return TRUE
+            return ~inner[0], f"(not {inner[1]})"
         expr = leaf(p)
-        return TRUE if expr is None else expr
+        return TRUE if expr is None else (expr, repr(p))
 
-    expr = upper(pred)
-    return None if expr is TRUE else expr
+    result = upper(pred)
+    if result is TRUE:
+        return None, ""
+    return result
 
 
 def eval_predicate(pred: Predicate, batch: DeviceBatch) -> jnp.ndarray:

@@ -100,6 +100,8 @@ class ScanPlan:
     # pyarrow expression pushed into the Parquet reads (PK-only subtree
     # of `predicate`); the full predicate still applies post-merge
     pushdown: object = None
+    # canonical string of the pushed subtree (scan-cache identity)
+    pushdown_key: str = ""
     # compaction scans set this False: their input SST sets are deleted
     # right after, so caching them only evicts hot query entries
     use_cache: bool = True
@@ -147,16 +149,27 @@ class ParquetReader:
             for seg, files in sorted(by_segment.items())
         ]
         pushdown = None
+        pushdown_key = ""
         if request.predicate is not None:
-            pushdown = filter_ops.to_arrow_expression(
+            pushdown, pushdown_key = filter_ops.to_arrow_expression_with_key(
                 request.predicate, set(self.schema.primary_key_names))
         return ScanPlan(segments=segments, mode=self.schema.update_mode,
                         predicate=request.predicate, keep_builtin=keep_builtin,
-                        pushdown=pushdown, use_cache=use_cache)
+                        pushdown=pushdown, pushdown_key=pushdown_key,
+                        use_cache=use_cache)
 
     # ---- execution ---------------------------------------------------------
 
     async def execute(self, plan: ScanPlan) -> AsyncIterator[pa.RecordBatch]:
+        async for _seg_start, batch in self.execute_segments(plan):
+            if batch is not None:
+                yield batch
+
+    async def execute_segments(self, plan: ScanPlan):
+        """Like execute(), but yields (segment_start, batch_or_None) for
+        EVERY segment — callers that must retry after a concurrent
+        compaction (see CloudObjectStorage.scan) track completed segments
+        by start time."""
         if plan.mode is not UpdateMode.OVERWRITE:
             # host (Append) path: uncached streaming merge
             async for seg, table, read_s in self._prefetch_tables(
@@ -166,7 +179,9 @@ class ParquetReader:
                 _SCAN_LATENCY.observe(read_s + (time.perf_counter() - t0))
                 if batch is not None and batch.num_rows:
                     _ROWS_SCANNED.inc(batch.num_rows)
-                    yield batch
+                    yield seg.segment_start, batch
+                else:
+                    yield seg.segment_start, None
             return
         async for seg, windows, read_s in self._cached_windows(plan):
             t0 = time.perf_counter()
@@ -179,21 +194,22 @@ class ParquetReader:
             _SCAN_LATENCY.observe(read_s + (time.perf_counter() - t0))
             if batch is not None and batch.num_rows:
                 _ROWS_SCANNED.inc(batch.num_rows)
-                yield batch
+                yield seg.segment_start, batch
+            else:
+                yield seg.segment_start, None
 
     def _cache_key(self, seg: SegmentPlan, plan: ScanPlan):
         from horaedb_tpu.storage.scan_cache import segment_cache_key
 
-        # A pushdown changes WHICH rows were read pre-merge, so it is part
-        # of the cached merge output's identity.  Key on OUR predicate
-        # tree's repr (complete and deterministic) — str() of a pyarrow
-        # expression ELIDES long isin lists, so distinct predicates could
-        # collide on it.  With no pushdown the read is full, and one
-        # entry serves every predicate shape.
-        pred_key = repr(plan.predicate) if plan.pushdown is not None else ""
+        # A pushdown changes WHICH rows were read pre-merge, so the
+        # canonical key of the PUSHED subtree (complete, unlike pyarrow
+        # expression str() which elides long isin lists) is part of the
+        # cached merge output's identity.  Predicates differing only in
+        # their value-column parts share one entry; with no pushdown the
+        # read is full and one entry serves every predicate shape.
         return segment_cache_key(
             seg.segment_start, (f.id for f in seg.ssts),
-            tuple(seg.columns) + (pred_key,))
+            tuple(seg.columns) + (plan.pushdown_key,))
 
     async def _cached_windows(self, plan: ScanPlan):
         """Per segment, yield (seg, post-merge DeviceBatch windows,
@@ -365,20 +381,32 @@ class ParquetReader:
         (group_values, finalized grids) combined across all segments and
         windows.  group_values are decoded host values (e.g. tsids) in
         sorted order; each grid is (len(group_values), num_buckets)."""
+        parts: list[tuple[np.ndarray, dict]] = []
+        async for _seg_start, seg_parts in self.aggregate_segments(plan, spec):
+            parts.extend(seg_parts)
+        return self.finalize_aggregate(parts, spec)
+
+    async def aggregate_segments(self, plan: ScanPlan, spec: AggregateSpec):
+        """Per segment, yield (segment_start, partial parts) — the
+        retryable unit for scan_aggregate (segments already yielded are
+        skipped on a replan).  Aggregation proceeds in segment order so
+        `last` tie-breaks stay deterministic."""
         ensure(plan.mode is UpdateMode.OVERWRITE,
                "aggregate pushdown requires Overwrite mode")
-        # aggregation proceeds in segment order (via the shared cache/
-        # prefetch iterator) so `last` tie-breaks stay deterministic
-        parts: list[tuple[np.ndarray, dict]] = []
-        async for _seg, windows, read_s in self._cached_windows(plan):
+        async for seg, windows, read_s in self._cached_windows(plan):
             t0 = time.perf_counter()
+            seg_parts = []
             for out_batch in windows:
                 part = self._aggregate_window(out_batch, spec, plan)
                 if part is not None:
-                    parts.append(part)
+                    seg_parts.append(part)
                 # same semantics as the row path: post-dedup rows
                 _ROWS_SCANNED.inc(out_batch.n_valid)
             _SCAN_LATENCY.observe(read_s + (time.perf_counter() - t0))
+            yield seg.segment_start, seg_parts
+
+    @staticmethod
+    def finalize_aggregate(parts: list, spec: AggregateSpec):
         group_values, grids = combine_aggregate_parts(parts, spec.num_buckets)
         # last_ts is computed relative to range_start on device; expose it
         # as ABSOLUTE time so all downsample paths share one unit

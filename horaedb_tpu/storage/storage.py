@@ -23,8 +23,10 @@ from typing import AsyncIterator, Optional
 import pyarrow as pa
 import pyarrow.compute as pc
 
+import logging
+
 from horaedb_tpu.common.error import ensure
-from horaedb_tpu.objstore import ObjectStore
+from horaedb_tpu.objstore import NotFoundError, ObjectStore
 from horaedb_tpu.storage import parquet_io
 from horaedb_tpu.storage.config import StorageConfig
 from horaedb_tpu.storage.manifest import Manifest
@@ -36,6 +38,8 @@ from horaedb_tpu.storage.types import (
     Timestamp,
 )
 from horaedb_tpu.utils import registry
+
+logger = logging.getLogger(__name__)
 
 _WRITE_LATENCY = registry.histogram(
     "storage_write_seconds", "write path latency")
@@ -157,16 +161,51 @@ class CloudObjectStorage(TimeMergeStorage):
         _ROWS_WRITTEN.inc(req.batch.num_rows)
         return WriteResult(id=file_id, seq=file_id, size=size)
 
+    # Scans race with compaction: the manifest can reference an SST that
+    # compaction deletes before the scan's parquet read runs.  The data
+    # lives on in the compacted output, so the remedy is a fresh plan for
+    # the not-yet-yielded segments (bounded retries).
+    _SCAN_RETRIES = 3
+
     async def scan(self, req: ScanRequest) -> AsyncIterator[pa.RecordBatch]:
-        plan = await self.build_scan_plan(req)
-        async for batch in self.reader.execute(plan):
-            yield batch
+        done: set[int] = set()
+        for attempt in range(self._SCAN_RETRIES + 1):
+            plan = await self.build_scan_plan(req)
+            plan.segments = [s for s in plan.segments
+                             if s.segment_start not in done]
+            try:
+                async for seg_start, batch in self.reader.execute_segments(plan):
+                    done.add(seg_start)
+                    if batch is not None:
+                        yield batch
+                return
+            except NotFoundError:
+                if attempt == self._SCAN_RETRIES:
+                    raise
+                logger.info("scan raced a compaction (sst vanished); "
+                            "replanning remaining segments")
 
     async def scan_aggregate(self, req: ScanRequest, spec):
         """Downsample pushdown: merge + GROUP BY group_col, time(bucket)
-        on device; returns (group_values, grids).  See read.AggregateSpec."""
-        plan = await self.build_scan_plan(req)
-        return await self.reader.execute_aggregate(plan, spec)
+        on device; returns (group_values, grids).  See read.AggregateSpec.
+        Segments completed before a compaction race are not re-aggregated
+        (or re-counted in metrics) on the replan."""
+        done: dict[int, list] = {}
+        for attempt in range(self._SCAN_RETRIES + 1):
+            plan = await self.build_scan_plan(req)
+            plan.segments = [s for s in plan.segments
+                             if s.segment_start not in done]
+            try:
+                async for seg_start, parts in self.reader.aggregate_segments(
+                        plan, spec):
+                    done[seg_start] = parts
+                break
+            except NotFoundError:
+                if attempt == self._SCAN_RETRIES:
+                    raise
+                logger.info("aggregate scan raced a compaction; replanning")
+        all_parts = [p for seg in sorted(done) for p in done[seg]]
+        return self.reader.finalize_aggregate(all_parts, spec)
 
     async def build_scan_plan(self, req: ScanRequest,
                               keep_builtin: bool = False) -> ScanPlan:
