@@ -13,6 +13,8 @@ from horaedb_tpu.parallel.mesh import segment_mesh
 from horaedb_tpu.parallel.scan import (
     sharded_downsample_query,
     sharded_merge_dedup,
+    sharded_window_partials,
 )
 
-__all__ = ["segment_mesh", "sharded_downsample_query", "sharded_merge_dedup"]
+__all__ = ["segment_mesh", "sharded_downsample_query",
+           "sharded_merge_dedup", "sharded_window_partials"]

@@ -76,11 +76,8 @@ def sharded_downsample_query(mesh, *, num_groups: int, num_buckets: int,
     """
 
     def shard_fn(ts, gid, vals, n_valid, bucket_ms):
-        _check_block_is_one(ts)
-        # leading axis is the shard axis: each shard sees (1, capacity)
-        p = downsample.partial_aggregate(
-            ts[0], gid[0], vals[0], n_valid[0], bucket_ms[0],
-            num_groups=num_groups, num_buckets=num_buckets)
+        p = _shard_partial(ts, gid, vals, n_valid, bucket_ms,
+                           num_groups=num_groups, num_buckets=num_buckets)
         combined = _combine_partials(p)
         final = downsample.finalize_aggregate(combined)
         scores = jnp.max(jnp.where(final["count"] > 0, final["max"],
@@ -90,9 +87,48 @@ def sharded_downsample_query(mesh, *, num_groups: int, num_buckets: int,
 
     mapped = shard_map(
         shard_fn, mesh=mesh,
-        in_specs=(P(SEGMENT_AXIS, None), P(SEGMENT_AXIS, None),
-                  P(SEGMENT_AXIS, None), P(SEGMENT_AXIS), P()),
+        in_specs=_ROW_SPECS,
         out_specs=(P(), P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(mapped)
+
+
+def _shard_partial(ts, gid, vals, n_valid, bucket_ms, *, num_groups: int,
+                   num_buckets: int) -> dict:
+    """Per-shard prelude shared by the mesh aggregation programs: one
+    window's partial grids from its (1, capacity) block."""
+    _check_block_is_one(ts)
+    return downsample.partial_aggregate(
+        ts[0], gid[0], vals[0], n_valid[0], bucket_ms[0],
+        num_groups=num_groups, num_buckets=num_buckets)
+
+
+_ROW_SPECS = (P(SEGMENT_AXIS, None), P(SEGMENT_AXIS, None),
+              P(SEGMENT_AXIS, None), P(SEGMENT_AXIS), P())
+
+
+def sharded_window_partials(mesh, *, num_groups: int, num_buckets: int):
+    """Build the compiled multi-chip PARTIAL aggregation used by the
+    engine: every chip aggregates its window into a (groups, buckets)
+    grid; the per-shard grids come back stacked (n_devices, G, B) so the
+    host folds them in float64 — BIT-EQUAL to the single-device path
+    (an on-device f32 psum would drift; see sharded_downsample_query for
+    the collective variant used by all-on-device queries).
+
+    fn(ts, gid, vals, n_valid, bucket_ms): (n_devices, capacity) arrays
+    sharded on the leading axis; n_valid (n_devices,); bucket_ms (1,).
+    """
+
+    def shard_fn(ts, gid, vals, n_valid, bucket_ms):
+        p = _shard_partial(ts, gid, vals, n_valid, bucket_ms,
+                           num_groups=num_groups, num_buckets=num_buckets)
+        return {k: v[None] for k, v in p.items()}
+
+    mapped = shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=_ROW_SPECS,
+        out_specs=P(SEGMENT_AXIS),
         check_vma=False,
     )
     return jax.jit(mapped)

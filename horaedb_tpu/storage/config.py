@@ -103,6 +103,10 @@ class ScanConfig:
     # (segment, SST set, columns) so writes/compaction invalidate
     # structurally
     cache_max_rows: int = 4 << 20
+    # devices for the multi-chip aggregate path (0 = single-device);
+    # windows batch onto a 1-D segment mesh in rounds of this size with
+    # partial grids combined via ICI psum/pmin/pmax
+    mesh_devices: int = 0
 
 
 @dataclass

@@ -122,6 +122,12 @@ class ParquetReader:
         from horaedb_tpu.storage.scan_cache import ScanCache
 
         self.scan_cache = ScanCache(config.scan.cache_max_rows)
+        self.mesh = None
+        self._mesh_agg_fns: dict = {}
+        if config.scan.mesh_devices > 0:
+            from horaedb_tpu.parallel import segment_mesh
+
+            self.mesh = segment_mesh(config.scan.mesh_devices)
 
     # ---- plan construction -------------------------------------------------
 
@@ -395,13 +401,18 @@ class ParquetReader:
                "aggregate pushdown requires Overwrite mode")
         async for seg, windows, read_s in self._cached_windows(plan):
             t0 = time.perf_counter()
-            seg_parts = []
-            for out_batch in windows:
-                part = self._aggregate_window(out_batch, spec, plan)
-                if part is not None:
-                    seg_parts.append(part)
-                # same semantics as the row path: post-dedup rows
-                _ROWS_SCANNED.inc(out_batch.n_valid)
+            if self.mesh is not None and len(windows) > 1:
+                seg_parts = self._aggregate_windows_mesh(windows, spec, plan)
+                for out_batch in windows:
+                    _ROWS_SCANNED.inc(out_batch.n_valid)
+            else:
+                seg_parts = []
+                for out_batch in windows:
+                    part = self._aggregate_window(out_batch, spec, plan)
+                    if part is not None:
+                        seg_parts.append(part)
+                    # same semantics as the row path: post-dedup rows
+                    _ROWS_SCANNED.inc(out_batch.n_valid)
             _SCAN_LATENCY.observe(read_s + (time.perf_counter() - t0))
             yield seg.segment_start, seg_parts
 
@@ -414,9 +425,10 @@ class ParquetReader:
             grids["last_ts"] = grids["last_ts"] + spec.range_start
         return group_values, grids
 
-    def _aggregate_window(self, out_batch: encode.DeviceBatch,
-                          spec: AggregateSpec,
-                          plan: ScanPlan) -> Optional[tuple[np.ndarray, dict]]:
+    def _window_groups(self, out_batch: encode.DeviceBatch,
+                       spec: AggregateSpec, plan: ScanPlan):
+        """Shared per-window prep: (group_values, gid_full, ts_shift) or
+        None when the window contributes nothing."""
         k = out_batch.n_valid
         cap = out_batch.capacity
         if k == 0:
@@ -442,18 +454,94 @@ class ParquetReader:
                f"{ts_enc.kind!r} encoding for {spec.ts_col!r}")
         shift = ts_enc.epoch - spec.range_start  # host_ts = dev_ts + epoch
         ensure(abs(shift) < 2**31, "query range too far from segment epoch")
+        group_values = _decode_group_values(
+            uniq, out_batch.encodings[spec.group_col])
+        return group_values, gid_full, shift
 
-        g_pad = max(8, 1 << (len(uniq) - 1).bit_length())
+    def _aggregate_window(self, out_batch: encode.DeviceBatch,
+                          spec: AggregateSpec,
+                          plan: ScanPlan) -> Optional[tuple[np.ndarray, dict]]:
+        prep = self._window_groups(out_batch, spec, plan)
+        if prep is None:
+            return None
+        group_values, gid_full, shift = prep
+        cap = out_batch.capacity
+        g_pad = max(8, 1 << (len(group_values) - 1).bit_length())
         partial = _partial_aggregate_jit(
             out_batch.columns[spec.ts_col], jnp.asarray(gid_full),
             out_batch.columns[spec.value_col],
             jnp.int32(cap), jnp.int32(shift), jnp.int32(spec.bucket_ms),
             num_groups=g_pad, num_buckets=spec.num_buckets)
-        host_partial = {name: np.asarray(a)[: len(uniq)]
+        host_partial = {name: np.asarray(a)[: len(group_values)]
                         for name, a in partial.items()}
-        group_values = _decode_group_values(
-            uniq, out_batch.encodings[spec.group_col])
         return group_values, host_partial
+
+    def _aggregate_windows_mesh(self, windows: list, spec: AggregateSpec,
+                                plan: ScanPlan) -> list:
+        """Multi-chip aggregation of one segment's windows: rounds of
+        mesh-size windows run as ONE shard_map program; the per-shard
+        partial grids fold on host in float64, keeping results bit-equal
+        to the single-device path.  Windows never share (group, bucket,
+        timestamp) cells — windows partition PKs and segments partition
+        time — so cross-window combination has no tie-break subtleties.
+        Returns parts in the (group_values, partial grids) shape the
+        host combiner eats.
+
+        Staging cost note: windows round-trip device->host->device to
+        stack onto the mesh; keeping them mesh-resident end-to-end is
+        ROADMAP.md item 2 (needs device-side resharding)."""
+        from horaedb_tpu.parallel.scan import (
+            shard_leading_axis,
+            sharded_window_partials,
+        )
+
+        n_dev = self.mesh.devices.size
+        preps = []
+        for w in windows:
+            prep = self._window_groups(w, spec, plan)
+            if prep is not None:
+                preps.append((w, *prep))
+        parts = []
+        for i in range(0, len(preps), n_dev):
+            round_preps = preps[i:i + n_dev]
+            # union the round's group values; remap window gids into it
+            round_values = np.unique(np.concatenate(
+                [p[1] for p in round_preps]))
+            g = len(round_values)
+            g_pad = max(8, 1 << (g - 1).bit_length())
+            cap = max(p[0].capacity for p in round_preps)
+            ts = np.zeros((n_dev, cap), dtype=np.int32)
+            gid = np.full((n_dev, cap), -1, dtype=np.int32)
+            vals = np.zeros((n_dev, cap), dtype=np.float32)
+            n_valid = np.zeros(n_dev, dtype=np.int32)
+            for d, (w, values, gid_full, shift) in enumerate(round_preps):
+                wc = w.capacity
+                remap = np.searchsorted(round_values, values).astype(np.int32)
+                ts[d, :wc] = np.asarray(w.columns[spec.ts_col]) + shift
+                gid[d, :wc] = np.where(gid_full >= 0, remap[gid_full], -1)
+                vals[d, :wc] = np.asarray(w.columns[spec.value_col])
+                n_valid[d] = wc  # gid=-1 already drops non-kept rows
+            # memoize the compiled program per grid shape — rebuilding the
+            # shard_map closure would recompile every round
+            fn_key = (g_pad, spec.num_buckets)
+            fn = self._mesh_agg_fns.get(fn_key)
+            if fn is None:
+                fn = sharded_window_partials(self.mesh, num_groups=g_pad,
+                                             num_buckets=spec.num_buckets)
+                self._mesh_agg_fns[fn_key] = fn
+            stacked = fn(shard_leading_axis(self.mesh, ts),
+                         shard_leading_axis(self.mesh, gid),
+                         shard_leading_axis(self.mesh, vals),
+                         shard_leading_axis(self.mesh, n_valid),
+                         jnp.asarray([spec.bucket_ms], dtype=jnp.int32))
+            # per-shard partials fold on host in f64 (bit-equal to the
+            # single-device path); padding shards beyond the round's real
+            # windows are sliced away
+            host = {k: np.asarray(v) for k, v in stacked.items()}
+            for d in range(len(round_preps)):
+                parts.append((round_values,
+                              {k: v[d, :g] for k, v in host.items()}))
+        return parts
 
     def _merge_on_host(self, batch: pa.RecordBatch,
                        plan: ScanPlan) -> pa.RecordBatch:

@@ -148,3 +148,72 @@ class TestGuards:
                shard_leading_axis(mesh, big.astype(np.float32)),
                shard_leading_axis(mesh, np.ones(NDEV * 2, dtype=np.int32)),
                jnp.asarray([1000], dtype=jnp.int32))
+
+
+class TestEngineMeshAggregation:
+    """The engine's multi-chip aggregate path folds per-shard partials on
+    host in f64.  With identical windowing it matches the single-device
+    path BIT-FOR-BIT; across different window sizes a small f32
+    within-window accumulation tolerance applies."""
+
+    def test_mesh_downsample_equals_single_device(self):
+        import asyncio
+
+        import pyarrow as pa
+
+        from horaedb_tpu.metric_engine import MetricEngine
+        from horaedb_tpu.objstore import MemoryObjectStore
+        from horaedb_tpu.storage.config import StorageConfig, from_dict
+        from horaedb_tpu.storage.types import TimeRange
+
+        T0 = (1_700_000_000_000 // 7_200_000) * 7_200_000
+        H = 3_600_000
+
+        async def run(mesh_devices, window_rows):
+            cfg = from_dict(StorageConfig, {
+                "scheduler": {"schedule_interval": "1h"},
+                "scan": {"mesh_devices": mesh_devices,
+                         "max_window_rows": window_rows},
+            })
+            e = await MetricEngine.open("m", MemoryObjectStore(),
+                                        segment_ms=2 * H, config=cfg)
+            try:
+                rng = np.random.default_rng(0)
+                n, hosts = 4000, 30
+                names = np.array([f"h{i:02d}" for i in range(hosts)],
+                                 dtype=object)
+                sel = rng.integers(0, hosts, n)
+                batch = pa.record_batch({
+                    "host": pa.array(names[sel]),
+                    "timestamp": pa.array(
+                        T0 + rng.integers(0, 2 * H - 1, n), type=pa.int64()),
+                    "value": pa.array(rng.random(n) * 100,
+                                      type=pa.float64()),
+                })
+                await e.write_arrow("cpu", ["host"], batch)
+                return await e.query_downsample(
+                    "cpu", [], TimeRange.new(T0, T0 + 2 * H),
+                    bucket_ms=600_000)
+            finally:
+                await e.close()
+
+        async def go():
+            # small windows force many windows per segment -> mesh rounds
+            single = await run(mesh_devices=0, window_rows=1 << 20)
+            meshed = await run(mesh_devices=4, window_rows=256)
+            assert single["tsids"] == meshed["tsids"]
+            for key in ("count", "sum", "min", "max", "avg", "last"):
+                np.testing.assert_allclose(
+                    np.asarray(single["aggs"][key]),
+                    np.asarray(meshed["aggs"][key]), rtol=2e-4,
+                    err_msg=key)
+            # identical windowing: mesh must be BIT-equal to single-device
+            single_small = await run(mesh_devices=0, window_rows=256)
+            meshed_small = await run(mesh_devices=4, window_rows=256)
+            assert single_small["tsids"] == meshed_small["tsids"]
+            for key in ("count", "sum", "min", "max", "avg", "last"):
+                np.testing.assert_array_equal(
+                    np.asarray(single_small["aggs"][key]),
+                    np.asarray(meshed_small["aggs"][key]), err_msg=key)
+
+        asyncio.run(go())
