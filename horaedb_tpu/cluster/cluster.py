@@ -45,13 +45,47 @@ class Cluster:
                    segment_ms: int = 2 * 3600 * 1000,
                    config: Optional[StorageConfig] = None,
                    routing: Optional[RoutingTable] = None) -> "Cluster":
-        routing = routing or RoutingTable.uniform(list(range(num_regions)))
+        from horaedb_tpu.objstore import NotFoundError
+
+        if routing is None:
+            # the persisted routing table (the cluster's "root table"
+            # state) wins over a fresh uniform layout
+            try:
+                routing = RoutingTable.from_json(
+                    (await store.get(f"{root_path}/routing.json")).decode())
+            except NotFoundError:
+                routing = RoutingTable.uniform(list(range(num_regions)))
         regions = {}
         for rid in routing.region_ids():
             regions[rid] = await MetricEngine.open(
                 f"{root_path}/region_{rid}", store, segment_ms=segment_ms,
                 config=config)
         return cls(regions, routing, root_path, store, segment_ms, config)
+
+    async def save_routing(self) -> None:
+        """Persist the routing table (atomic object-store put)."""
+        await self._store.put(f"{self._root_path}/routing.json",
+                              self.routing.to_json().encode())
+
+    async def split_region(self, region_id: int, pivot_key: int,
+                           new_region_id: int, table_ttl_ms: int) -> None:
+        """The full split flow, ordered so a failure at any step leaves a
+        consistent cluster: (1) provision the new region, (2) build and
+        PERSIST the new routing on a copy, (3) swap it live.  Writes
+        route to the new region only after the durable routing exists —
+        a crash mid-split can orphan an empty region directory, never
+        lose a routed write."""
+        import copy
+
+        await self.add_region(new_region_id)
+        new_routing = RoutingTable(rules=list(self.routing.rules),
+                                   strict_time_routing=self.routing
+                                   .strict_time_routing)
+        new_routing.split(region_id, pivot_key, new_region_id,
+                          now_ms(), table_ttl_ms)
+        await self._store.put(f"{self._root_path}/routing.json",
+                              new_routing.to_json().encode())
+        self.routing = new_routing
 
     async def close(self) -> None:
         for e in self.regions.values():

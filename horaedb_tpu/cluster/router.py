@@ -147,3 +147,26 @@ class RoutingTable:
             if r.region_id not in out:
                 out.append(r.region_id)
         return out
+
+    # ---- persistence (the cluster's "root table" state) -------------------
+
+    def to_json(self) -> str:
+        import json
+
+        return json.dumps({
+            "strict_time_routing": self.strict_time_routing,
+            "rules": [{"start_key": r.start_key, "end_key": r.end_key,
+                       "region_id": r.region_id,
+                       "created_at": r.created_at,
+                       "ttl_expire_at": r.ttl_expire_at}
+                      for r in self.rules],
+        })
+
+    @classmethod
+    def from_json(cls, data: str) -> "RoutingTable":
+        import json
+
+        doc = json.loads(data)
+        return cls(
+            rules=[PartitionRule(**r) for r in doc["rules"]],
+            strict_time_routing=doc.get("strict_time_routing", False))

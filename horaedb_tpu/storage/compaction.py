@@ -5,9 +5,10 @@
   segment, newest segment first, require >= input_sst_min_num files, pack
   smallest-first up to input_sst_max_num while total size stays within
   1.1 x new_sst_max_size (ref: picker.rs:62-188).  TTL-expired files are
-  split out and deleted alongside.  Parity note: like the reference, a
-  task is only produced when a segment qualifies — expired files alone
-  don't trigger work (picker.rs:96's early return drops them).
+  split out and deleted alongside.  Intentional divergence: the
+  reference drops expireds when no segment qualifies (picker.rs:96's
+  early return), so TTL'd files linger until a rewrite fires; here an
+  expireds-only GC task deletes them without a rewrite.
   TTL math stays in milliseconds (the reference subtracts micros from a
   millis clock — a unit bug SURVEY.md flags; not replicated).
 - Executor: memory-gated rewrite (ref: executor.rs:93-114) running THE
@@ -50,6 +51,8 @@ _COMPACTIONS = registry.counter(
     "compaction_completed_total", "compaction tasks completed")
 _COMPACTION_ROWS = registry.counter(
     "compaction_rows_rewritten_total", "rows rewritten by compaction")
+_TTL_GC_FILES = registry.counter(
+    "ttl_gc_files_total", "expired ssts removed by TTL garbage collection")
 
 
 @dataclass
@@ -86,7 +89,15 @@ class TimeWindowCompactionStrategy:
 
         inputs = self._pick_files(by_segment)
         if inputs is None:
-            return None
+            # The reference drops expireds here (picker.rs:96's early
+            # return), so TTL'd files linger until a rewrite also fires.
+            # We instead emit an expireds-only GC task — pure deletes,
+            # no rewrite (executor.gc_expired).
+            if not expireds:
+                return None
+            for f in expireds:
+                f.mark_compaction()
+            return Task(inputs=[], expireds=expireds)
         for f in inputs:
             f.mark_compaction()
         for f in expireds:
@@ -169,6 +180,9 @@ class Executor:
             pass
 
     async def execute(self, task: Task) -> None:
+        if not task.inputs:
+            await self.gc_expired(task)
+            return
         try:
             self._pre_check(task)
         except Error:
@@ -181,6 +195,38 @@ class Executor:
             ok = True
         finally:
             self.inused_memory -= task.input_size
+            if not ok:
+                self._unmark(task)
+
+    async def _delete_objects(self, file_ids: list[int]) -> None:
+        """Best-effort parallel SST object deletes (manifest already
+        updated, so errors are logged, never raised —
+        ref: executor.rs:224-253)."""
+        results = await asyncio.gather(
+            *(self.storage.store.delete(
+                sst_path(self.storage.root_path, fid))
+              for fid in file_ids),
+            return_exceptions=True)
+        for fid, res in zip(file_ids, results):
+            if isinstance(res, BaseException):
+                logger.error("failed to delete sst %s: %s", fid, res)
+
+    async def gc_expired(self, task: Task) -> None:
+        """TTL garbage collection: drop expired SSTs from the manifest,
+        then best-effort delete the objects.  No rewrite, no memory gate
+        (nothing is read)."""
+        ok = False
+        try:
+            to_deletes = [f.id for f in task.expireds]
+            if not to_deletes:
+                ok = True
+                return
+            await self.storage.manifest.update(
+                ManifestUpdate(to_adds=[], to_deletes=to_deletes))
+            ok = True
+            _TTL_GC_FILES.inc(len(to_deletes))
+            await self._delete_objects(to_deletes)
+        finally:
             if not ok:
                 self._unmark(task)
 
@@ -206,14 +252,17 @@ class Executor:
 
         file_id = SstFile.allocate_id()
         path = sst_path(storage.root_path, file_id)
-        num_rows = 0
-        out_batches: list[pa.RecordBatch] = []
-        async for batch in storage.reader.execute(plan):
-            batch = _restore_reserved_column(batch, storage.schema())
-            num_rows += batch.num_rows
-            out_batches.append(batch)
-        size = await parquet_io.write_sst(storage.store, path, out_batches,
-                                          storage.config.write, storage.schema())
+
+        # stream batches into the parquet encoder as they arrive — peak
+        # memory is the compressed output, not the raw row batches
+        async def restored():
+            async for batch in storage.reader.execute(plan):
+                yield _restore_reserved_column(batch, storage.schema())
+
+        data, num_rows = await parquet_io.encode_sst_stream(
+            restored(), storage.config.write, storage.schema())
+        await storage.store.put(path, data)
+        size = len(data)
         meta = FileMeta(max_sequence=file_id, num_rows=num_rows, size=size,
                         time_range=time_range)
         logger.debug("compaction output sst id=%s rows=%s size=%s",
@@ -229,13 +278,7 @@ class Executor:
         _COMPACTION_ROWS.inc(num_rows)
 
         # From here on, errors must not propagate (manifest already updated).
-        results = await asyncio.gather(
-            *(storage.store.delete(sst_path(storage.root_path, fid))
-              for fid in to_deletes),
-            return_exceptions=True)
-        for fid, res in zip(to_deletes, results):
-            if isinstance(res, BaseException):
-                logger.error("failed to delete compacted sst %s: %s", fid, res)
+        await self._delete_objects(to_deletes)
 
 
 def _restore_reserved_column(batch: pa.RecordBatch, schema) -> pa.RecordBatch:

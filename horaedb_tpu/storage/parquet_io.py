@@ -87,6 +87,24 @@ def encode_sst(batches: list[pa.RecordBatch], config: WriteConfig,
     return sink.getvalue()
 
 
+async def encode_sst_stream(batches, config: WriteConfig,
+                            schema: StorageSchema) -> tuple[bytes, int]:
+    """Streaming twin of encode_sst over an async batch iterator: batches
+    feed the parquet encoder as they arrive, so peak memory is the
+    compressed output.  Returns (bytes, num_rows)."""
+    sink = io.BytesIO()
+    writer = pq.ParquetWriter(sink, schema.arrow_schema,
+                              **writer_options(config, schema))
+    num_rows = 0
+    try:
+        async for batch in batches:
+            num_rows += batch.num_rows
+            writer.write_batch(batch, row_group_size=config.max_row_group_size)
+    finally:
+        writer.close()
+    return sink.getvalue(), num_rows
+
+
 async def write_sst(store: ObjectStore, path: str,
                     batches: list[pa.RecordBatch], config: WriteConfig,
                     schema: StorageSchema) -> int:

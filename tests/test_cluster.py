@@ -235,3 +235,43 @@ class TestRemoteRegion:
                 await remote_engine.close()
 
         asyncio.run(go())
+
+
+class TestRoutingPersistence:
+    def test_split_survives_reopen(self):
+        async def go():
+            store = MemoryObjectStore()
+            c = await Cluster.open("prod", store, num_regions=1,
+                                   segment_ms=2 * HOUR)
+            await c.write([sample("cpu", [("h", "a")], T0 + 1000, 1.0)])
+            await c.split_region(0, 1 << 62, 5, table_ttl_ms=30 * DAY)
+            await c.write([sample("cpu", [("h", f"x{i}")], T0 + 2000, float(i))
+                           for i in range(16)])
+            r5_rows = (await c.regions[5].query(
+                "cpu", [], TimeRange.new(T0, T0 + HOUR))).num_rows
+            assert r5_rows > 0
+            await c.close()
+
+            # reopen: persisted routing wins over the uniform default
+            c2 = await Cluster.open("prod", store, num_regions=1,
+                                    segment_ms=2 * HOUR)
+            try:
+                assert sorted(c2.routing.region_ids()) == [0, 5]
+                assert 5 in c2.regions
+                t = await c2.query("cpu", [], TimeRange.new(T0, T0 + HOUR))
+                assert t.num_rows == 17
+                # writes still route to the split layout
+                await c2.write([sample("cpu", [("h", "post")],
+                                       T0 + 3000, 9.0)])
+            finally:
+                await c2.close()
+
+        asyncio.run(go())
+
+    def test_routing_json_roundtrip(self):
+        rt = RoutingTable.uniform([0, 1])
+        rt.strict_time_routing = True
+        rt.split(0, 1 << 61, 7, now_ms=T0, table_ttl_ms=DAY)
+        back = RoutingTable.from_json(rt.to_json())
+        assert back.rules == rt.rules
+        assert back.strict_time_routing is True

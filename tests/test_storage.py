@@ -661,3 +661,43 @@ class TestScanCache:
                 await s.close()
 
         asyncio.run(go())
+
+
+class TestTtlGc:
+    def test_expired_only_gc_runs_without_rewrite(self):
+        async def go():
+            from horaedb_tpu.common import ReadableDuration, now_ms
+
+            store = MemoryObjectStore()
+            cfg = from_dict(StorageConfig, {
+                "scheduler": {"schedule_interval": "1h", "ttl": "1h",
+                              "input_sst_min_num": 5}})
+            s = await CloudObjectStorage.open(
+                "db", SEGMENT_MS, store, user_schema(), 2, cfg)
+            try:
+                now = now_ms()
+                old = now - 3 * SEGMENT_MS  # ended long before now-ttl
+                await s.write(WriteRequest(
+                    make_batch([("old", old, 1.0)]),
+                    TimeRange.new(old, old + 1)))
+                await s.write(WriteRequest(
+                    make_batch([("new", now, 2.0)]),
+                    TimeRange.new(now, now + 1)))
+                assert len(await s.manifest.all_ssts()) == 2
+
+                task = await s.compact_scheduler.picker.pick_candidate()
+                assert task is not None
+                assert task.inputs == [] and len(task.expireds) == 1
+                await s.compact_scheduler.executor.execute(task)
+
+                ssts = await s.manifest.all_ssts()
+                assert len(ssts) == 1  # expired file gone from manifest
+                objs = [m.path for m in await store.list("db/data/")]
+                assert len(objs) == 1  # and from the object store
+                got = rows_of(await collect(s.scan(
+                    ScanRequest(range=TimeRange.new(0, now + SEGMENT_MS)))))
+                assert got == [("new", now, 2.0)]
+            finally:
+                await s.close()
+
+        asyncio.run(go())
