@@ -170,6 +170,17 @@ class MetricManager:
             names.update(col.to_pylist())
         return sorted(names)
 
+    async def list_fields(self, metric_name: str,
+                          time_range: TimeRange) -> list[str]:
+        """Distinct field names registered for a metric in the window."""
+        fields: set[str] = set()
+        for b in await _collect(self.table.scan(ScanRequest(
+                range=time_range,
+                predicate=Eq("metric_name", metric_name)))):
+            col = b.column(b.schema.names.index("field_name"))
+            fields.update(col.to_pylist())
+        return sorted(fields)
+
 
 class IndexManager:
     """TSID resolution + series/tags/index registration per segment
@@ -746,3 +757,8 @@ class MetricEngine:
         """Distinct metric names active in the window (Prometheus
         /api/v1/label/__name__/values analogue)."""
         return await self.metric_manager.list_metrics(time_range)
+
+    async def list_fields(self, metric: str,
+                          time_range: TimeRange) -> list[str]:
+        """Distinct field names of a metric in the window."""
+        return await self.metric_manager.list_fields(metric, time_range)

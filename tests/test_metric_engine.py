@@ -788,3 +788,20 @@ class TestDiscoveryApis:
                 await e.close()
 
         asyncio.run(go())
+
+    def test_list_fields(self):
+        async def go():
+            e = await open_engine()
+            try:
+                await e.write([
+                    sample("mem", [("h", "a")], T0 + 1000, 1.0),
+                ])
+                await e.write([Sample("mem", [Label("h", "a")], T0 + 1000,
+                                      2.0, field_name="free")])
+                rng = TimeRange.new(T0, T0 + HOUR)
+                assert await e.list_fields("mem", rng) == ["free", "value"]
+                assert await e.list_fields("nope", rng) == []
+            finally:
+                await e.close()
+
+        asyncio.run(go())
