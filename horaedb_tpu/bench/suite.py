@@ -224,14 +224,7 @@ def run_config3(rows: int, iters: int) -> dict:
 def run_config4(rows: int, iters: int, num_ssts: int = 64) -> dict:
     import pyarrow as pa
 
-    import jax
-    import jax.numpy as jnp
-
     from horaedb_tpu.objstore import MemoryObjectStore
-    from horaedb_tpu.ops import encode_batch
-    from horaedb_tpu.ops.merge import merge_dedup_last
-    from horaedb_tpu.ops.downsample import time_bucket_aggregate
-    from horaedb_tpu.ops.topk import top_k_groups
     from horaedb_tpu.storage.config import StorageConfig, from_dict
     from horaedb_tpu.storage.read import ScanRequest
     from horaedb_tpu.storage.storage import CloudObjectStorage, WriteRequest
@@ -269,22 +262,22 @@ def run_config4(rows: int, iters: int, num_ssts: int = 64) -> dict:
         return s
 
     async def query_once(s):
-        """Full device pipeline: scan (parquet decode + device merge-dedup)
-        -> downsample -> top-k.  This is what the metric times."""
-        batches = []
-        async for b in s.scan(ScanRequest(range=TimeRange.new(T0, T0 + span))):
-            batches.append(b)
-        merged = pa.Table.from_batches(batches).combine_chunks()
-        dev = encode_batch(merged.to_batches()[0], device_put=jax.device_put)
-        aggs = time_bucket_aggregate(
-            dev.columns["ts"], dev.columns["host"], dev.columns["cpu"],
-            dev.n_valid, span, num_groups=hosts, num_buckets=1)
-        scores = jnp.where(aggs["count"][:, 0] > 0, aggs["max"][:, 0],
-                           -jnp.inf).astype(jnp.float32)
-        top_vals, top_idx = top_k_groups(scores, k=10)
-        jax.block_until_ready(top_vals)
-        n_out = sum(b.num_rows for b in batches)
-        return n_out, np.asarray(top_idx), dev.encodings["host"].dictionary
+        """Full device pipeline via the aggregate pushdown: scan (parquet
+        decode + device merge-dedup) -> downsample grids -> top-k, with
+        merge windows staying device-resident (no Arrow round trip).
+        This is what the metric times."""
+        from horaedb_tpu.storage.read import AggregateSpec
+
+        spec = AggregateSpec(group_col="host", ts_col="ts",
+                             value_col="cpu", range_start=T0,
+                             bucket_ms=span, num_buckets=1)
+        group_values, grids = await s.scan_aggregate(
+            ScanRequest(range=TimeRange.new(T0, T0 + span)), spec)
+        maxes = np.where(grids["count"][:, 0] > 0, grids["max"][:, 0],
+                         -np.inf)
+        top = np.argsort(maxes)[-10:]
+        n_out = int(grids["count"].sum())
+        return n_out, top, group_values
 
     async def bench():
         s = await setup()
@@ -323,7 +316,7 @@ def run_config4(rows: int, iters: int, num_ssts: int = 64) -> dict:
 
     # cross-check: dedup count and top-k set must match numpy on same data
     assert n_out == ref_n, (n_out, ref_n)
-    got_hosts = {str(host_dict[i]) for i in np.asarray(top_idx)}
+    got_hosts = {str(host_dict[i]) for i in top_idx}
     assert got_hosts == {f"host_{g}" for g in ref_top}, (got_hosts, ref_top)
 
     _log(f"config4: {num_ssts} SSTs, {len(all_h):,} rows in, {n_out:,} out; "

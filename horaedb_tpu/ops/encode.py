@@ -71,13 +71,16 @@ class DeviceBatch:
     `columns` maps name → (capacity,)-shaped jax/numpy array (int32 or
     float32); rows [0, n_valid) are real, the rest padding.  `encodings`
     carries the host-side metadata needed to decode or to translate
-    predicate constants.
+    predicate constants.  `memo` holds derived per-batch artifacts (e.g.
+    dense group mappings) so repeat queries over a cached batch skip
+    recomputation; it is never part of the batch's identity.
     """
 
     columns: dict
     encodings: dict[str, ColumnEncoding]
     n_valid: int
     capacity: int
+    memo: dict = field(default_factory=dict)
 
     @property
     def names(self) -> list[str]:

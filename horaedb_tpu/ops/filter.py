@@ -131,6 +131,29 @@ def _const_code_upper(enc: ColumnEncoding, value: Any):
     raise Error(f"unknown encoding kind: {enc.kind}")
 
 
+def canonical_predicate_key(pred: Optional[Predicate]) -> str:
+    """Complete, deterministic identity string for a predicate tree.
+
+    repr() is NOT sufficient: In.values may be a numpy array whose repr
+    elides long contents, so two different predicates could collide.
+    Every leaf value is rendered in full here.
+    """
+    if pred is None:
+        return ""
+    if isinstance(pred, (And, Or)):
+        op = "and" if isinstance(pred, And) else "or"
+        inner = " ".join(canonical_predicate_key(c) for c in pred.children)
+        return f"({op} {inner})"
+    if isinstance(pred, Not):
+        return f"(not {canonical_predicate_key(pred.child)})"
+    if isinstance(pred, In):
+        vals = ",".join(repr(v) for v in list(pred.values))
+        return f"(in {pred.column} [{vals}])"
+    if isinstance(pred, TimeRangePred):
+        return f"(range {pred.column} {pred.start} {pred.end})"
+    return f"({type(pred).__name__.lower()} {pred.column} {pred.value!r})"
+
+
 def predicate_columns(pred: Predicate) -> set[str]:
     """All column names a predicate references."""
     if isinstance(pred, (And, Or)):

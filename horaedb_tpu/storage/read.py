@@ -428,7 +428,24 @@ class ParquetReader:
     def _window_groups(self, out_batch: encode.DeviceBatch,
                        spec: AggregateSpec, plan: ScanPlan):
         """Shared per-window prep: (group_values, gid_full, ts_shift) or
-        None when the window contributes nothing."""
+        None when the window contributes nothing.  Memoized on the batch
+        (keyed by group column + full predicate) so repeat queries over
+        scan-cached windows skip the dense-ification."""
+        memo_key = ("window_groups", spec.group_col, spec.ts_col,
+                    spec.range_start,
+                    filter_ops.canonical_predicate_key(plan.predicate))
+        if memo_key in out_batch.memo:
+            return out_batch.memo[memo_key]
+        result = self._window_groups_uncached(out_batch, spec, plan)
+        # small bound: each entry holds a capacity-sized gid array that the
+        # scan cache's row budget does not account for
+        if len(out_batch.memo) >= 4:
+            out_batch.memo.clear()
+        out_batch.memo[memo_key] = result
+        return result
+
+    def _window_groups_uncached(self, out_batch: encode.DeviceBatch,
+                                spec: AggregateSpec, plan: ScanPlan):
         k = out_batch.n_valid
         cap = out_batch.capacity
         if k == 0:
