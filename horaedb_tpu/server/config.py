@@ -2,9 +2,10 @@
 
 Same layered-TOML shape: port, test write-load generator knobs, and the
 metric-engine section holding the object-store choice plus the
-TimeMergeStorage config.  S3 config keys parse (the reference defines
-them fully, config.rs:82-160) but, like the reference (main.rs:112),
-selecting S3 is rejected at startup.
+TimeMergeStorage config.  The reference defines the S3 keys fully
+(config.rs:82-160) but panics on selection (main.rs:112); here
+kind = "S3Like" is actually supported via objstore.s3.S3ObjectStore
+(endpoint/bucket/credentials validated at load time).
 """
 
 from __future__ import annotations
@@ -32,8 +33,9 @@ class TestConfig:
 
 @dataclass
 class S3Config:
-    """Parsed for compatibility; unsupported at runtime like the
-    reference (main.rs:112)."""
+    """S3-compatible backend settings (same keys the reference defines,
+    config.rs:82-160 — but actually supported here via
+    objstore.s3.S3ObjectStore, where the reference panics)."""
 
     region: str = ""
     key_id: str = ""
@@ -118,8 +120,14 @@ def load_config(path: Optional[str] = None) -> ServerConfig:
     with open(path, "rb") as f:
         data = tomllib.load(f)
     cfg = _dc_from_dict(ServerConfig, data)
-    if cfg.metric_engine.object_store.kind not in ("Local",):
-        # parity with the reference: S3 parses but is not supported yet
-        raise Error(
-            f"object store {cfg.metric_engine.object_store.kind!r} not supported yet")
+    kind = cfg.metric_engine.object_store.kind
+    if kind not in ("Local", "S3Like"):
+        raise Error(f"object store {kind!r} not supported "
+                    "(expected Local or S3Like)")
+    if kind == "S3Like":
+        s3 = cfg.metric_engine.object_store.s3
+        ensure(s3 is not None and s3.endpoint and s3.bucket
+               and s3.key_id and s3.key_secret,
+               "S3Like object store requires [metric_engine.object_store.s3] "
+               "with endpoint, bucket, key_id, and key_secret")
     return cfg

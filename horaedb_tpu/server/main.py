@@ -284,9 +284,21 @@ def _grid_json(grid) -> list:
     return out
 
 
+def _build_store(config: ServerConfig):
+    oc = config.metric_engine.object_store
+    if oc.kind == "S3Like":
+        from horaedb_tpu.objstore.s3 import S3ObjectStore, S3Options
+
+        return S3ObjectStore(S3Options(
+            endpoint=oc.s3.endpoint, region=oc.s3.region or "us-east-1",
+            bucket=oc.s3.bucket, access_key_id=oc.s3.key_id,
+            secret_access_key=oc.s3.key_secret))
+    return LocalObjectStore(oc.data_dir)
+
+
 async def run_server(config: ServerConfig,
                      ready: Optional[asyncio.Event] = None) -> None:
-    store = LocalObjectStore(config.metric_engine.object_store.data_dir)
+    store = _build_store(config)
     engine = await MetricEngine.open(
         "metrics", store,
         segment_ms=config.metric_engine.segment_duration.millis,
@@ -312,6 +324,9 @@ async def run_server(config: ServerConfig,
         await state.stop_generators()
         await runner.cleanup()
         await engine.close()
+        closer = getattr(store, "close", None)
+        if closer is not None:
+            await closer()
 
 
 def main() -> None:

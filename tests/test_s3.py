@@ -1,0 +1,182 @@
+"""S3 ObjectStore tests against an in-process S3-compatible fake.
+
+The fake validates what a real endpoint would: SigV4 Authorization
+header shape and that x-amz-content-sha256 matches the actual body —
+so payload signing is exercised, not just assumed.  ListObjectsV2
+paginates with a small page size to cover continuation tokens.
+"""
+
+import asyncio
+import hashlib
+
+import pyarrow as pa
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestServer
+
+from horaedb_tpu.common import Error
+from horaedb_tpu.objstore import NotFoundError
+from horaedb_tpu.objstore.s3 import S3ObjectStore, S3Options
+
+PAGE = 3  # tiny ListObjectsV2 page size to force continuation
+
+
+def make_fake_s3(bucket: str):
+    objects: dict[str, bytes] = {}
+
+    def check_auth(request: web.Request, body: bytes):
+        auth = request.headers.get("Authorization", "")
+        assert auth.startswith("AWS4-HMAC-SHA256 Credential="), auth
+        assert "SignedHeaders=" in auth and "Signature=" in auth
+        declared = request.headers.get("x-amz-content-sha256", "")
+        assert declared == hashlib.sha256(body).hexdigest(), \
+            "payload hash mismatch"
+
+    async def handle_object(request: web.Request):
+        key = request.match_info["key"]
+        body = await request.read()
+        check_auth(request, body)
+        if request.method == "PUT":
+            objects[key] = body
+            return web.Response(status=200)
+        if request.method in ("GET", "HEAD"):
+            if key not in objects:
+                return web.Response(status=404)
+            data = objects[key]
+            rng = request.headers.get("Range")
+            if rng and request.method == "GET":
+                spec = rng.removeprefix("bytes=")
+                lo, hi = spec.split("-")
+                data = data[int(lo): int(hi) + 1]
+                return web.Response(status=206, body=data)
+            if request.method == "HEAD":
+                return web.Response(status=200,
+                                    headers={"Content-Length": str(len(data))})
+            return web.Response(status=200, body=data)
+        if request.method == "DELETE":
+            objects.pop(key, None)
+            return web.Response(status=204)  # idempotent like real S3
+        return web.Response(status=405)
+
+    async def handle_bucket(request: web.Request):
+        check_auth(request, b"")
+        assert request.query.get("list-type") == "2"
+        prefix = request.query.get("prefix", "")
+        start_after = request.query.get("continuation-token", "")
+        keys = sorted(k for k in objects if k.startswith(prefix)
+                      and k > start_after)
+        page, rest = keys[:PAGE], keys[PAGE:]
+        contents = "".join(
+            f"<Contents><Key>{k}</Key><Size>{len(objects[k])}</Size></Contents>"
+            for k in page)
+        truncated = "true" if rest else "false"
+        token = (f"<NextContinuationToken>{page[-1]}</NextContinuationToken>"
+                 if rest else "")
+        xml = (f'<?xml version="1.0"?><ListBucketResult>'
+               f"<IsTruncated>{truncated}</IsTruncated>{token}{contents}"
+               f"</ListBucketResult>")
+        return web.Response(status=200, body=xml.encode(),
+                            content_type="application/xml")
+
+    app = web.Application()
+    app.router.add_route("*", f"/{bucket}/{{key:.+}}", handle_object)
+    app.router.add_route("GET", f"/{bucket}", handle_bucket)
+    return app, objects
+
+
+async def make_store():
+    app, objects = make_fake_s3("tsdb")
+    server = TestServer(app)
+    await server.start_server()
+    opts = S3Options(endpoint=str(server.make_url("")).rstrip("/"),
+                     region="us-east-1", bucket="tsdb",
+                     access_key_id="AKIATEST",
+                     secret_access_key="secretsecret")
+    store = S3ObjectStore(opts)
+    return store, server, objects
+
+
+class TestS3Store:
+    def test_crud_roundtrip(self):
+        async def go():
+            store, server, _ = await make_store()
+            try:
+                await store.put("db/data/1.sst", b"hello world")
+                assert await store.get("db/data/1.sst") == b"hello world"
+                assert (await store.head("db/data/1.sst")).size == 11
+                assert await store.get_range("db/data/1.sst", 6, 11) == b"world"
+                await store.delete("db/data/1.sst")
+                with pytest.raises(NotFoundError):
+                    await store.get("db/data/1.sst")
+                with pytest.raises(NotFoundError):
+                    await store.delete("db/data/1.sst")
+            finally:
+                await store.close()
+                await server.close()
+
+        asyncio.run(go())
+
+    def test_list_with_continuation(self):
+        async def go():
+            store, server, _ = await make_store()
+            try:
+                for i in range(8):  # > 2 pages of 3
+                    await store.put(f"m/delta/{i:03d}", bytes(i))
+                await store.put("other/x", b"z")
+                metas = await store.list("m/delta/")
+                assert [m.path for m in metas] == \
+                    [f"m/delta/{i:03d}" for i in range(8)]
+                assert [m.size for m in metas] == list(range(8))
+            finally:
+                await store.close()
+                await server.close()
+
+        asyncio.run(go())
+
+    def test_whole_engine_over_s3(self):
+        """The full storage engine (writes, manifest merge, scan with
+        dedup, compaction) running against the S3 protocol."""
+
+        async def go():
+            from horaedb_tpu.storage.config import StorageConfig, from_dict
+            from horaedb_tpu.storage.read import ScanRequest
+            from horaedb_tpu.storage.storage import (
+                CloudObjectStorage,
+                WriteRequest,
+            )
+            from horaedb_tpu.storage.types import TimeRange
+
+            store, server, objects = await make_store()
+            try:
+                schema = pa.schema([("k", pa.string()), ("ts", pa.int64()),
+                                    ("v", pa.float64())])
+                cfg = from_dict(StorageConfig, {
+                    "scheduler": {"schedule_interval": "1h",
+                                  "input_sst_min_num": 2}})
+                s = await CloudObjectStorage.open("db", 3_600_000, store,
+                                                  schema, 2, cfg)
+                for val in (1.0, 2.0, 3.0):
+                    await s.write(WriteRequest(
+                        pa.record_batch([pa.array(["a"]),
+                                         pa.array([5], type=pa.int64()),
+                                         pa.array([val])], schema=schema),
+                        TimeRange.new(5, 6)))
+                rows = []
+                async for b in s.scan(ScanRequest(range=TimeRange.new(0, 10))):
+                    rows += b.column(2).to_pylist()
+                assert rows == [3.0]
+
+                task = await s.compact_scheduler.picker.pick_candidate()
+                await s.compact_scheduler.executor.execute(task)
+                assert len(await s.manifest.all_ssts()) == 1
+                await s.manifest.trigger_merge()
+                await s.close()
+
+                # everything lives behind the S3 API
+                assert any(k.startswith("db/data/") for k in objects)
+                assert "db/manifest/snapshot" in objects
+            finally:
+                await store.close()
+                await server.close()
+
+        asyncio.run(go())

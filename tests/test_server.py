@@ -128,10 +128,22 @@ class TestConfig:
         assert cfg.metric_engine.segment_duration.millis == 2 * HOUR
         assert cfg.metric_engine.time_merge_storage.manifest.hard_merge_threshold == 90
 
-    def test_s3_rejected(self, tmp_path):
+    def test_s3_requires_settings(self, tmp_path):
         p = tmp_path / "s3.toml"
         p.write_text('[metric_engine.object_store]\nkind = "S3Like"\n')
-        with pytest.raises(Error, match="not supported yet"):
+        with pytest.raises(Error, match="endpoint, bucket"):
+            load_config(str(p))
+        p.write_text('[metric_engine.object_store]\nkind = "S3Like"\n'
+                     '[metric_engine.object_store.s3]\n'
+                     'endpoint = "http://127.0.0.1:9000"\n'
+                     'bucket = "tsdb"\nkey_id = "k"\nkey_secret = "s"\n')
+        cfg = load_config(str(p))
+        assert cfg.metric_engine.object_store.s3.bucket == "tsdb"
+
+    def test_unknown_store_kind_rejected(self, tmp_path):
+        p = tmp_path / "bad.toml"
+        p.write_text('[metric_engine.object_store]\nkind = "Gcs"\n')
+        with pytest.raises(Error, match="Local or S3Like"):
             load_config(str(p))
 
     def test_unknown_key_rejected(self, tmp_path):
