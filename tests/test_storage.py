@@ -701,3 +701,50 @@ class TestTtlGc:
                 await s.close()
 
         asyncio.run(go())
+
+
+class TestAppendModeWindowing:
+    def test_windowed_append_equals_single_shot(self):
+        async def go():
+            import numpy as np
+            schema = pa.schema([pa.field("k", pa.string()),
+                                pa.field("payload", pa.binary())])
+            rng = np.random.default_rng(5)
+
+            async def run(window):
+                cfg = StorageConfig(update_mode=UpdateMode.APPEND)
+                cfg.scheduler.schedule_interval = ReadableDuration.parse("1h")
+                cfg.scan.max_window_rows = window
+                s = await CloudObjectStorage.open(
+                    "db", SEGMENT_MS, MemoryObjectStore(), schema, 1, cfg)
+                try:
+                    for _ in range(3):
+                        n = 300
+                        keys = [f"k{int(i):03d}"
+                                for i in rng.integers(0, 40, n)]
+                        payloads = [bytes([i % 250, (i * 7) % 250])
+                                    for i in range(n)]
+                        b = pa.record_batch(
+                            [pa.array(keys),
+                             pa.array(payloads, type=pa.binary())],
+                            schema=schema)
+                        await s.write(WriteRequest(b, TimeRange.new(0, 10)))
+                    out = {}
+                    order = []
+                    async for b in s.scan(ScanRequest(
+                            range=TimeRange.new(0, 100))):
+                        for k, v in zip(b.column(0).to_pylist(),
+                                        b.column(1).to_pylist()):
+                            out[k] = v
+                            order.append(k)
+                    return out, order
+                finally:
+                    await s.close()
+
+            rng = np.random.default_rng(5)
+            full, order_full = await run(1 << 20)
+            rng = np.random.default_rng(5)
+            windowed, order_win = await run(64)
+            assert windowed == full
+            assert order_win == sorted(order_win)  # global key order kept
+        asyncio.run(go())
