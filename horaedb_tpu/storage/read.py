@@ -308,34 +308,23 @@ class ParquetReader:
         window = self.config.scan.max_window_rows
         if batch.num_rows <= window:
             merged = self._merge_on_host(batch, plan)
-        else:
-            pk1 = batch.column(batch.schema.names.index(
-                self._pk_names_in(batch.schema.names)[0]))
-            d = pa.compute.dictionary_encode(pk1)
-            d = d.combine_chunks() if isinstance(d, pa.ChunkedArray) else d
-            codes = np.asarray(d.indices)
-            # dictionary codes are first-appearance order; window planning
-            # only needs grouping, and output re-sorts per window, but
-            # cross-window ORDER must follow value order — remap to ranks
-            order = np.argsort(np.asarray(d.dictionary.to_pylist(),
-                                          dtype=object))
-            rank = np.empty(len(order), dtype=np.int64)
-            rank[order] = np.arange(len(order))
-            parts = []
-            for sel in _plan_pk_windows(rank[codes], window):
-                part = self._merge_on_host(batch.take(pa.array(sel)), plan)
-                if part is not None and part.num_rows:
-                    parts.append(part)
-            if not parts:
-                return None
-            merged = (parts[0] if len(parts) == 1 else
-                      pa.Table.from_batches(parts).combine_chunks()
-                      .to_batches()[0])
-        if not plan.keep_builtin and merged is not None:
-            keep = [c for c in merged.schema.names
-                    if not self.schema.is_builtin_name(c)]
-            merged = merged.select(keep)
-        return merged
+            if not plan.keep_builtin and merged is not None:
+                keep = [c for c in merged.schema.names
+                        if not self.schema.is_builtin_name(c)]
+                merged = merged.select(keep)
+            return merged
+        pk1 = batch.column(batch.schema.names.index(
+            self._pk_names_in(batch.schema.names)[0]))
+        # dense value-order ranks straight from Arrow (same comparator the
+        # merge sort uses); cross-window order then follows value order
+        ranks = np.asarray(pa.compute.rank(pk1, sort_keys="ascending",
+                                           tiebreaker="dense"))
+        parts = []
+        for sel in _plan_pk_windows(ranks, window):
+            part = self._merge_on_host(batch.take(pa.array(sel)), plan)
+            if part is not None and part.num_rows:
+                parts.append(part)
+        return self._combine_and_strip(parts, plan)
 
     def _pk_names_in(self, columns: list[str]) -> list[str]:
         """PK names present, in SCHEMA order — the merge must sort by the
