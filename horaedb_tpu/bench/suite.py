@@ -87,14 +87,20 @@ def run_config2(rows: int, iters: int) -> dict:
     is_host = cols["host_id"] == target_host
     vals = cols["usage_user"].astype(np.float32)
 
-    # device: WHERE host=? becomes group -1 for non-matching rows
-    gid = np.where(is_host & in_range, 0, -1).astype(np.int32)
-    d_ts = jax.device_put(_pad_pow2(np.clip(ts_off, 0, None), np.int32))
-    d_gid = jax.device_put(_pad_pow2(gid, np.int32))
-    d_vals = jax.device_put(_pad_pow2(vals, np.float32))
-
+    # WHERE host=? is a PK predicate: the engine pushes it into the
+    # Parquet read, so the device only ever sees matching rows.  The
+    # timed step models that: host-side selection (the pushdown's role)
+    # + device transfer + downsample of the selected rows.
     def device_run():
-        out = time_bucket_aggregate(d_ts, d_gid, d_vals, n, bucket,
+        m = is_host & in_range
+        sel_ts = ts_off[m].astype(np.int32)
+        sel_vals = vals[m]
+        k = len(sel_ts)
+        d_ts = jax.device_put(_pad_pow2(sel_ts, np.int32))
+        d_gid = jax.device_put(
+            _pad_pow2(np.zeros(k, dtype=np.int32), np.int32))
+        d_vals = jax.device_put(_pad_pow2(sel_vals, np.float32))
+        out = time_bucket_aggregate(d_ts, d_gid, d_vals, k, bucket,
                                     num_groups=1, num_buckets=num_buckets)
         jax.block_until_ready(out["avg"])
         return out
