@@ -28,17 +28,31 @@ _F32_MAX = jnp.float32(jnp.finfo(jnp.float32).max)
 _I32_MIN = jnp.int32(-(2**31))
 
 
+ALL_AGGS = ("count", "sum", "min", "max", "avg", "last")
+
+
 def partial_aggregate(ts_offset: jax.Array, group_ids: jax.Array,
                       values: jax.Array, n_valid, bucket_ms,
-                      num_groups: int, num_buckets: int) -> dict:
+                      num_groups: int, num_buckets: int,
+                      which: tuple = ALL_AGGS) -> dict:
     """Raw per-shard aggregate grids, all (num_groups, num_buckets):
 
       sum (0-init), count (0), min (+F32_MAX), max (-F32_MAX),
       last_ts (I32_MIN), last (0 where empty).
 
+    `which` restricts computation to the requested aggregates (plus
+    their dependencies: avg needs sum+count, last needs last_ts; count
+    is always produced — finalize and cross-shard combining key on it).
     Combinable across shards: sum/count by +, min by min, max by max,
     (last_ts, last) by argmax-ts with later-shard tie-break.
     """
+    want = set(which)
+    unknown = want - set(ALL_AGGS)
+    if unknown:
+        raise ValueError(f"unknown aggregates {sorted(unknown)}; "
+                         f"supported: {ALL_AGGS}")
+    if "avg" in want:
+        want.add("sum")
     capacity = ts_offset.shape[0]
     iota = jnp.arange(capacity, dtype=jnp.int32)
     valid = iota < jnp.asarray(n_valid, dtype=jnp.int32)
@@ -50,57 +64,81 @@ def partial_aggregate(ts_offset: jax.Array, group_ids: jax.Array,
     # out-of-grid rows land in an overflow cell that is sliced away
     seg = jnp.where(in_grid, group_ids * num_buckets + bucket, num_cells)
 
-    ones = in_grid.astype(jnp.float32)
-    count = jax.ops.segment_sum(ones, seg, num_segments=num_cells + 1)[:num_cells]
-    total = jax.ops.segment_sum(jnp.where(in_grid, values, 0.0), seg,
-                                num_segments=num_cells + 1)[:num_cells]
-    vmin = jax.ops.segment_min(jnp.where(in_grid, values, _F32_MAX), seg,
-                               num_segments=num_cells + 1)[:num_cells]
-    vmax = jax.ops.segment_max(jnp.where(in_grid, values, -_F32_MAX), seg,
-                               num_segments=num_cells + 1)[:num_cells]
-
-    # "last" = value at the highest timestamp in the cell (later row wins
-    # ties, mirroring last-value merge semantics).  Two segmented passes:
-    # max ts per cell, then max row index among rows at that ts.
-    tmax = jax.ops.segment_max(jnp.where(in_grid, ts_offset, _I32_MIN), seg,
-                               num_segments=num_cells + 1)
-    at_max_ts = in_grid & (ts_offset == tmax[seg])
-    last_row = jax.ops.segment_max(jnp.where(at_max_ts, iota, -1), seg,
-                                   num_segments=num_cells + 1)[:num_cells]
-    last = jnp.where(last_row >= 0,
-                     values[jnp.clip(last_row, 0, capacity - 1)], 0.0)
-
     grid = lambda a: a.reshape(num_groups, num_buckets)
-    return {
-        "count": grid(count),
-        "sum": grid(total),
-        "min": grid(vmin),
-        "max": grid(vmax),
-        "last_ts": grid(tmax[:num_cells]),
-        "last": grid(last),
-    }
+    ones = in_grid.astype(jnp.float32)
+    out = {"count": grid(jax.ops.segment_sum(
+        ones, seg, num_segments=num_cells + 1)[:num_cells])}
+    if "sum" in want:
+        out["sum"] = grid(jax.ops.segment_sum(
+            jnp.where(in_grid, values, 0.0), seg,
+            num_segments=num_cells + 1)[:num_cells])
+    if "min" in want:
+        out["min"] = grid(jax.ops.segment_min(
+            jnp.where(in_grid, values, _F32_MAX), seg,
+            num_segments=num_cells + 1)[:num_cells])
+    if "max" in want:
+        out["max"] = grid(jax.ops.segment_max(
+            jnp.where(in_grid, values, -_F32_MAX), seg,
+            num_segments=num_cells + 1)[:num_cells])
+    if "last" in want:
+        # "last" = value at the highest timestamp in the cell (later row
+        # wins ties, mirroring last-value merge semantics).  Two segmented
+        # passes: max ts per cell, then max row index at that ts.
+        tmax = jax.ops.segment_max(
+            jnp.where(in_grid, ts_offset, _I32_MIN), seg,
+            num_segments=num_cells + 1)
+        at_max_ts = in_grid & (ts_offset == tmax[seg])
+        last_row = jax.ops.segment_max(
+            jnp.where(at_max_ts, iota, -1), seg,
+            num_segments=num_cells + 1)[:num_cells]
+        out["last"] = grid(jnp.where(
+            last_row >= 0, values[jnp.clip(last_row, 0, capacity - 1)], 0.0))
+        out["last_ts"] = grid(tmax[:num_cells])
+    return out
 
 
-def finalize_aggregate(partial: dict) -> dict:
+def finalize_aggregate(partial: dict, which: tuple = ALL_AGGS) -> dict:
     """Turn combined partial grids into user-facing aggregates.
-    Empty cells: count 0, sum 0, min +inf, max -inf, avg/last NaN."""
+    Empty cells: count 0, sum 0, min +inf, max -inf, avg/last NaN.
+    Emits the requested aggregates that `partial` can supply (count is
+    always present)."""
+    want = set(which) | {"count"}
     count = partial["count"]
     empty = count == 0
     nan = jnp.float32(jnp.nan)
-    return {
-        "count": count,
-        "sum": partial["sum"],
-        "min": partial["min"],
-        "max": partial["max"],
-        "avg": jnp.where(empty, nan, partial["sum"] / jnp.maximum(count, 1.0)),
-        "last": jnp.where(empty, nan, partial["last"]),
-    }
+    out = {"count": count}
+    if "sum" in partial and "sum" in want:
+        out["sum"] = partial["sum"]
+    if "sum" in partial and "avg" in want:
+        out["avg"] = jnp.where(empty, nan,
+                               partial["sum"] / jnp.maximum(count, 1.0))
+    if "min" in partial and "min" in want:
+        out["min"] = partial["min"]
+    if "max" in partial and "max" in want:
+        out["max"] = partial["max"]
+    if "last" in partial and "last" in want:
+        out["last"] = jnp.where(empty, nan, partial["last"])
+    return out
 
 
-@functools.partial(jax.jit, static_argnames=("num_groups", "num_buckets"))
 def time_bucket_aggregate(ts_offset: jax.Array, group_ids: jax.Array,
                           values: jax.Array, n_valid, bucket_ms,
-                          num_groups: int, num_buckets: int) -> dict:
+                          num_groups: int, num_buckets: int,
+                          which: tuple = ALL_AGGS) -> dict:
+    """See _time_bucket_aggregate_impl; this thin wrapper canonicalizes
+    `which` so permutations/duplicates share one compiled program."""
+    return _time_bucket_aggregate_impl(
+        ts_offset, group_ids, values, n_valid, bucket_ms,
+        num_groups=num_groups, num_buckets=num_buckets,
+        which=tuple(sorted(set(which))))
+
+
+@functools.partial(jax.jit, static_argnames=("num_groups", "num_buckets",
+                                             "which"))
+def _time_bucket_aggregate_impl(ts_offset: jax.Array, group_ids: jax.Array,
+                                values: jax.Array, n_valid, bucket_ms,
+                                num_groups: int, num_buckets: int,
+                                which: tuple = ALL_AGGS) -> dict:
     """Single-shard aggregate: partial + finalize in one compiled program.
 
     Args:
@@ -112,9 +150,11 @@ def time_bucket_aggregate(ts_offset: jax.Array, group_ids: jax.Array,
       bucket_ms: scalar int32 — bucket width in the ts unit.
       num_groups / num_buckets: static grid extents.
 
-    Returns dict of (num_groups, num_buckets) float32 arrays:
-      sum, count, min, max, avg, last (value at max ts per cell).
+    Returns a dict of (num_groups, num_buckets) float32 grids holding
+    `count` plus the aggregates requested via `which` (default: sum,
+    min, max, avg, last — `last` is the value at max ts per cell).
     """
-    return finalize_aggregate(partial_aggregate(
-        ts_offset, group_ids, values, n_valid, bucket_ms,
-        num_groups, num_buckets))
+    return finalize_aggregate(
+        partial_aggregate(ts_offset, group_ids, values, n_valid, bucket_ms,
+                          num_groups, num_buckets, which=which),
+        which=which)

@@ -377,3 +377,45 @@ class TestArrowPushdown:
         # Or with a fully-unpushable branch stays unpushable
         assert to_arrow_expression(
             Or([Eq("host", "a"), Gt("cpu", 0.5)]), pks) is None
+
+
+class TestAggregateSubset:
+    def base(self):
+        rng = np.random.default_rng(0)
+        cap = 128
+        return (jnp.asarray(rng.integers(0, 500, cap).astype(np.int32)),
+                jnp.asarray(rng.integers(0, 3, cap).astype(np.int32)),
+                jnp.asarray(rng.random(cap).astype(np.float32)))
+
+    def test_subset_matches_full(self):
+        ts, gid, vals = self.base()
+        full = time_bucket_aggregate(ts, gid, vals, 100, 100,
+                                     num_groups=3, num_buckets=5)
+        avg_only = time_bucket_aggregate(ts, gid, vals, 100, 100,
+                                         num_groups=3, num_buckets=5,
+                                         which=("avg",))
+        assert set(avg_only) == {"count", "avg"}
+        np.testing.assert_array_equal(np.asarray(full["avg"]),
+                                      np.asarray(avg_only["avg"]))
+        sum_only = time_bucket_aggregate(ts, gid, vals, 100, 100,
+                                         num_groups=3, num_buckets=5,
+                                         which=("sum",))
+        assert set(sum_only) == {"count", "sum"}
+
+    def test_unknown_aggregate_rejected(self):
+        ts, gid, vals = self.base()
+        with pytest.raises(ValueError, match="mean"):
+            time_bucket_aggregate(ts, gid, vals, 100, 100,
+                                  num_groups=3, num_buckets=5,
+                                  which=("mean",))
+
+    def test_which_order_canonicalized(self):
+        from horaedb_tpu.ops.downsample import _time_bucket_aggregate_impl
+        ts, gid, vals = self.base()
+        before = _time_bucket_aggregate_impl._cache_size()
+        time_bucket_aggregate(ts, gid, vals, 100, 100, num_groups=3,
+                              num_buckets=5, which=("count", "avg"))
+        mid = _time_bucket_aggregate_impl._cache_size()
+        time_bucket_aggregate(ts, gid, vals, 100, 100, num_groups=3,
+                              num_buckets=5, which=("avg", "count", "avg"))
+        assert _time_bucket_aggregate_impl._cache_size() == mid
