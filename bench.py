@@ -164,7 +164,7 @@ def main() -> None:
         (sums / np.maximum(counts, 1))[occupied], rtol=2e-4)
 
     print(json.dumps({
-        "metric": f"single-table avg GROUP BY time(1m), {n/1e6:.0f}M rows, p50",
+        "metric": f"single-table avg GROUP BY time(1m), {n/1e6:.1f}M rows, p50",
         "value": round(tpu_p50 * 1e3, 3),
         "unit": "ms",
         "vs_baseline": round(tpu_p50 / cpu_p50, 4),
