@@ -92,6 +92,12 @@ def snapshot_encode(records: np.ndarray) -> bytes:
     """records: RECORD_DTYPE structured array -> snapshot bytes."""
     records = np.ascontiguousarray(records, dtype=RECORD_DTYPE)
     n = len(records)
+    if n == 0:
+        # Empty bytes, not a header-only buffer: the reference decodes
+        # empty bytes as the default snapshot but REJECTS header-only
+        # buffers (encoding.rs requires record_total_length > 0) — its
+        # own empty into_bytes() is unreadable, a quirk we don't copy.
+        return b""
     lib = _load()
     out = np.empty(_HEADER_LEN + n * _RECORD_LEN, dtype=np.uint8)
     if lib is not None:
@@ -127,6 +133,9 @@ def snapshot_decode(buf: bytes) -> np.ndarray:
         if n == -5:
             raise Error(f"snapshot version is newer than supported "
                         f"{SNAPSHOT_VERSION}")
+        if n == -6:
+            raise Error("snapshot body is empty (header-only buffer); "
+                        "an empty snapshot is encoded as zero bytes")
         ensure(n >= 0, f"snapshot decode failed (code {n}): length mismatch")
         return out[:n]
     import struct
@@ -138,6 +147,8 @@ def snapshot_decode(buf: bytes) -> np.ndarray:
            f"snapshot version {ver} is newer than supported "
            f"{SNAPSHOT_VERSION}")
     body = buf[_HEADER_LEN:]
+    ensure(length > 0, "snapshot body is empty (header-only buffer); "
+           "an empty snapshot is encoded as zero bytes")
     ensure(length == len(body) and length % _RECORD_LEN == 0,
            f"snapshot length mismatch: header={length}, body={len(body)}")
     return np.frombuffer(body, dtype=RECORD_DTYPE).copy()

@@ -24,6 +24,7 @@ from dataclasses import dataclass
 from typing import Optional
 
 import aiohttp
+import yarl
 
 from horaedb_tpu.common.error import Error
 from horaedb_tpu.objstore.api import NotFoundError, ObjectMeta, ObjectStore
@@ -54,6 +55,16 @@ def _uri_encode(s: str, *, encode_slash: bool) -> str:
     return urllib.parse.quote(s, safe=safe)
 
 
+def _canonical_query(query: dict[str, str]) -> str:
+    """AWS-canonical query string — used both for signing and for the
+    URL actually sent, so signed and sent bytes cannot diverge (aiohttp's
+    yarl encoding differs from AWS's, e.g. '/' left raw in values)."""
+    return "&".join(
+        f"{_uri_encode(k, encode_slash=True)}="
+        f"{_uri_encode(v, encode_slash=True)}"
+        for k, v in sorted(query.items()))
+
+
 class SigV4Signer:
     """AWS Signature Version 4 (the s3 service flavor: single-chunk,
     signed payload hash)."""
@@ -61,18 +72,17 @@ class SigV4Signer:
     def __init__(self, opts: S3Options):
         self.opts = opts
 
-    def sign(self, method: str, path: str, query: dict[str, str],
+    def sign(self, method: str, path: str, canonical_query: str,
              payload_sha256: str,
              now: Optional[datetime.datetime] = None) -> dict[str, str]:
+        """canonical_query MUST be the exact query string sent on the
+        wire (produced by _canonical_query) — taking the string rather
+        than a dict makes signed==sent structural, not coincidental."""
         now = now or datetime.datetime.now(datetime.timezone.utc)
         amz_date = now.strftime("%Y%m%dT%H%M%SZ")
         datestamp = now.strftime("%Y%m%d")
         host = urllib.parse.urlparse(self.opts.endpoint).netloc
 
-        canonical_query = "&".join(
-            f"{_uri_encode(k, encode_slash=True)}="
-            f"{_uri_encode(v, encode_slash=True)}"
-            for k, v in sorted(query.items()))
         headers = {
             "host": host,
             "x-amz-content-sha256": payload_sha256,
@@ -138,12 +148,18 @@ class S3ObjectStore(ObjectStore):
         path = self._path(key) if key is not None else f"/{self.opts.bucket}"
         payload_hash = (hashlib.sha256(data).hexdigest()
                         if data else _EMPTY_SHA256)
-        headers = self.signer.sign(method, path, query, payload_hash)
+        cq = _canonical_query(query)
+        headers = self.signer.sign(method, path, cq, payload_hash)
         if extra_headers:
             headers.update(extra_headers)
         session = await self._ensure()
-        url = self.opts.endpoint + path
-        resp = await session.request(method, url, params=query, data=data,
+        # send the EXACT bytes that were signed: canonical-encoded path +
+        # canonical query, marked pre-encoded so yarl doesn't re-quote
+        url = yarl.URL(
+            self.opts.endpoint + _uri_encode(path, encode_slash=False)
+            + (f"?{cq}" if cq else ""),
+            encoded=True)
+        resp = await session.request(method, url, data=data,
                                      headers=headers)
         if resp.status == 404:
             resp.release()

@@ -105,7 +105,9 @@ def _dictionary_encode(np_col: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
     # code comparison == value comparison.  Load-bearing for the device
     # sort producing the same order as the reference's arrow sort.
     dictionary, codes = np.unique(np_col, return_inverse=True)
-    ensure(len(dictionary) <= int(_INT32_MAX), "dictionary overflow")
+    # strictly below INT32_MAX: the merge kernel reserves the max int32 as
+    # its padding sentinel, so the largest code must never equal it
+    ensure(len(dictionary) < int(_INT32_MAX), "dictionary overflow")
     return codes.astype(np.int32), dictionary
 
 
@@ -122,7 +124,8 @@ def _dictionary_encode_arrow(col: pa.Array) -> tuple[np.ndarray, np.ndarray]:
         dict_arr = dict_arr.combine_chunks()
     codes = dict_arr.indices.to_numpy(zero_copy_only=False)
     dictionary = dict_arr.dictionary.to_numpy(zero_copy_only=False)
-    ensure(len(dictionary) <= int(_INT32_MAX), "dictionary overflow")
+    # see _dictionary_encode: max code must stay below the pad sentinel
+    ensure(len(dictionary) < int(_INT32_MAX), "dictionary overflow")
     order = np.argsort(dictionary)  # sorts only the uniques
     rank = np.empty(len(order), dtype=np.int32)
     rank[order] = np.arange(len(order), dtype=np.int32)

@@ -15,7 +15,7 @@ all-false (Eq/In) or correct-by-order (range) mask via searchsorted.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, Sequence, Union
+from typing import Any, Optional, Sequence, Union
 
 import jax.numpy as jnp
 import numpy as np

@@ -1,0 +1,54 @@
+"""Force the JAX CPU backend with N virtual devices.
+
+Single home for the tunnel-hazard recipe shared by tests/conftest.py and
+__graft_entry__.dryrun_multichip: the axon sitecustomize hook registers
+the TPU tunnel plugin at interpreter start and forces
+jax_platforms="axon,cpu"; initializing that backend dials a single-client
+relay and can wedge the process. The env var alone is too late once jax
+is imported, so the jax.config itself must be overridden before the
+first backend initialization — and any already-initialized backend that
+is non-CPU or has too few devices must be dropped.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+_FLAG = "--xla_force_host_platform_device_count"
+
+
+def force_cpu_devices(n_devices: int) -> None:
+    """Make `jax.devices()` return >= n_devices virtual CPU devices.
+
+    Safe to call before OR after `import jax`, but must run before the
+    backend the caller relies on is initialized (an already-initialized
+    sufficient CPU backend is left untouched; insufficient or non-CPU
+    backends are cleared so re-initialization picks up the new flags).
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(rf"{_FLAG}=(\d+)", flags)
+    if m is None or int(m.group(1)) < n_devices:
+        want = f"{_FLAG}={n_devices}"
+        flags = flags.replace(m.group(0), want) if m else f"{flags} {want}"
+        os.environ["XLA_FLAGS"] = flags.strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from jax._src import xla_bridge as _xb
+
+    backends = getattr(_xb, "_backends", {})
+    # jax.devices() would itself initialize a backend — only probe when
+    # one already exists
+    if backends and (any(p != "cpu" for p in backends)
+                     or len(jax.devices()) < n_devices):
+        # XLA_FLAGS is parsed once per process, so a rebuilt client won't
+        # see a raised device count; jax_num_cpu_devices IS re-read at
+        # client creation (but may only be set while no backend exists)
+        import jax.extend.backend as jeb
+
+        jeb.clear_backends()
+        jax.config.update("jax_num_cpu_devices", n_devices)

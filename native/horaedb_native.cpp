@@ -63,7 +63,9 @@ long long snapshot_encode(const SnapshotRecordC* recs, size_t n,
 
 // Returns record count, or a negative error:
 //   -1 truncated header, -2 bad magic, -3 length mismatch,
-//   -4 cap too small, -5 unsupported (newer) version
+//   -4 cap too small, -5 unsupported (newer) version,
+//   -6 header-only buffer (reference requires record_total_length > 0;
+//      an empty snapshot is encoded as zero bytes)
 long long snapshot_decode(const uint8_t* buf, size_t len,
                           SnapshotRecordC* out, size_t out_cap) {
   if (len == 0) return 0;
@@ -71,6 +73,7 @@ long long snapshot_decode(const uint8_t* buf, size_t len,
   if (get_u32(buf) != kSnapshotMagic) return -2;
   if (buf[4] > kSnapshotVersion) return -5;
   const uint64_t body = get_u64(buf + 6);
+  if (body == 0) return -6;
   if (body != len - kHeaderLen || body % kRecordLen != 0) return -3;
   const size_t n = body / kRecordLen;
   if (out_cap < n) return -4;

@@ -35,7 +35,20 @@ class TestSnapshotCodec:
     def test_empty(self):
         assert len(native.snapshot_decode(b"")) == 0
         buf = native.snapshot_encode(np.empty(0, dtype=native.RECORD_DTYPE))
+        # empty snapshots encode to zero bytes, not a header-only buffer
+        # (the reference rejects header-only: encoding.rs requires
+        # record_total_length > 0)
+        assert buf == b""
         assert len(native.snapshot_decode(buf)) == 0
+
+    def test_header_only_rejected(self):
+        import struct
+
+        from horaedb_tpu.common import Error
+        header_only = struct.pack("<IBBQ", native.SNAPSHOT_MAGIC,
+                                  native.SNAPSHOT_VERSION, 0, 0)
+        with pytest.raises(Error, match="empty"):
+            native.snapshot_decode(header_only)
 
     def test_wire_layout_golden(self):
         """The structured dtype's memory IS the wire format."""
