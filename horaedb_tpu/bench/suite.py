@@ -30,6 +30,21 @@ def _log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def provenance() -> dict:
+    """Backend identity for result lines — a CPU-fallback number must
+    never masquerade as a device number (round-1 lesson).  `fallback`
+    is true whenever the run did NOT execute on an accelerator,
+    including deliberate CPU runs."""
+    import os
+
+    import jax
+
+    platform = jax.devices()[0].platform
+    return {"backend": platform,
+            "fallback": os.environ.get("_HORAEDB_BENCH_REEXEC") == "1"
+            or platform == "cpu"}
+
+
 def _p50(fn, iters: int) -> float:
     times = []
     for _ in range(iters):
@@ -443,6 +458,7 @@ def main() -> None:
     parser.add_argument("--iters", type=int, default=10)
     args = parser.parse_args()
     result = RUNNERS[args.config](args.rows, args.iters)
+    result.update(provenance())
     print(json.dumps(result))
 
 

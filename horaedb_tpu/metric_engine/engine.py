@@ -33,6 +33,7 @@ import pyarrow as pa
 from horaedb_tpu.common.error import Error, ensure
 from horaedb_tpu.objstore import ObjectStore
 from horaedb_tpu.ops import And, Eq, In, TimeRangePred
+from horaedb_tpu.ops.downsample import ALL_AGGS
 from horaedb_tpu.storage.config import StorageConfig
 from horaedb_tpu.storage.read import AggregateSpec, ScanRequest
 from horaedb_tpu.storage.storage import CloudObjectStorage, WriteRequest
@@ -675,11 +676,14 @@ class MetricEngine:
     async def query_downsample(self, metric: str,
                                filters: list[tuple[str, str]],
                                time_range: TimeRange, bucket_ms: int,
-                               field: str = "value") -> dict:
+                               field: str = "value",
+                               aggs: tuple = ALL_AGGS) -> dict:
         """GROUP BY series, time(bucket) — the north-star query, executed
         as an aggregate pushdown: the data-table merge output is
         downsampled on device without ever materializing rows as Arrow.
-        Returns {tsids, num_buckets, aggs: {agg -> (series, bucket) grid}}.
+        `aggs` restricts which aggregates are computed (count always
+        rides along).  Returns {tsids, num_buckets,
+        aggs: {agg -> (series, bucket) grid}}.
         """
         span = int(time_range.end) - int(time_range.start)
         ensure(span < 2**31,
@@ -699,7 +703,8 @@ class MetricEngine:
         spec = AggregateSpec(group_col="tsid", ts_col="timestamp",
                              value_col="value",
                              range_start=int(time_range.start),
-                             bucket_ms=bucket_ms, num_buckets=num_buckets)
+                             bucket_ms=bucket_ms, num_buckets=num_buckets,
+                             which=tuple(aggs))
         group_values, aggs = await self.tables["data"].scan_aggregate(
             ScanRequest(range=time_range, predicate=pred), spec)
         return {"tsids": [int(t) for t in group_values],

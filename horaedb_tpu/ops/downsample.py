@@ -97,6 +97,43 @@ def partial_aggregate(ts_offset: jax.Array, group_ids: jax.Array,
     return out
 
 
+def window_local_partials(ts, gid_local, vals, remap, shift, lo,
+                          total_buckets, bucket_ms, *, num_groups: int,
+                          num_buckets: int, which: tuple = ALL_AGGS) -> dict:
+    """One window's partial grids over its LOCAL bucket range — the
+    shared inner of the engine's batched (vmap) and meshed (shard_map)
+    aggregation programs.
+
+    Args:
+      ts: int32 (capacity,) — encoded ts (offsets from the window's
+        epoch).
+      gid_local: int32 (capacity,) — window-local dense group codes;
+        -1 = dropped row (padding or predicate-filtered).
+      remap: int32 (num_groups,) — local code -> union-group row.
+      shift: scalar int32 — ts + shift = offset from the query range
+        start.
+      lo: scalar int32 — first bucket this window's grid covers; local
+        grid bucket b corresponds to global bucket lo + b.
+      total_buckets: traced scalar — global bucket count; rows at or
+        beyond it are dropped (windows may overhang the query range).
+      num_buckets: static LOCAL grid width.
+    """
+    gid_union = jnp.where(
+        gid_local >= 0,
+        remap[jnp.clip(gid_local, 0, remap.shape[0] - 1)], -1)
+    bucket_ms = jnp.asarray(bucket_ms, jnp.int32)
+    ts_global = ts + jnp.asarray(shift, jnp.int32)
+    bucket_global = ts_global // bucket_ms
+    gid_union = jnp.where(
+        bucket_global < jnp.asarray(total_buckets, jnp.int32),
+        gid_union, -1)
+    # exact: (a - lo*b) // b == a//b - lo for integer floor division
+    ts_local = ts_global - jnp.asarray(lo, jnp.int32) * bucket_ms
+    return partial_aggregate(ts_local, gid_union, vals, ts.shape[0],
+                             bucket_ms, num_groups=num_groups,
+                             num_buckets=num_buckets, which=which)
+
+
 def finalize_aggregate(partial: dict, which: tuple = ALL_AGGS) -> dict:
     """Turn combined partial grids into user-facing aggregates.
     Empty cells: count 0, sum 0, min +inf, max -inf, avg/last NaN.

@@ -134,6 +134,49 @@ def sharded_window_partials(mesh, *, num_groups: int, num_buckets: int):
     return jax.jit(mapped)
 
 
+def sharded_remap_partials(mesh, *, num_groups: int, num_buckets: int,
+                           which: tuple = downsample.ALL_AGGS):
+    """Batched multi-chip partial aggregation with the per-window group
+    remap fused into the compiled program.
+
+    Windows from DIFFERENT segments batch onto the mesh (the reference's
+    UnionExec axis, storage.rs:342-368): each chip remaps its window's
+    local dense group ids into the round's union group space via a
+    (num_groups,) remap row, shifts timestamps into query-range offsets,
+    and aggregates into a window-LOCAL grid (num_buckets wide, starting
+    at the window's `lo` bucket) — all without leaving the device.
+    Per-shard grids come back stacked (n_devices, G, B) for the host's
+    float64 fold (bit-equal to the single-device path).
+
+    fn(ts, gid, vals, remap, shift, lo, total_buckets, bucket_ms):
+      ts/gid/vals: (n_devices, capacity) sharded on the leading axis,
+        gid rows are window-local dense codes with -1 = dropped row;
+      remap: (n_devices, num_groups) int32 — local code -> union row;
+      shift: (n_devices,) int32 added to ts (per-window epoch offset);
+      lo: (n_devices,) int32 first covered bucket per window;
+      total_buckets: replicated scalar — global bucket count;
+      bucket_ms: (1,) replicated.
+    """
+
+    def shard_fn(ts, gid, vals, remap, shift, lo, total, bucket_ms):
+        _check_block_is_one(ts)
+        p = downsample.window_local_partials(
+            ts[0], gid[0], vals[0], remap[0], shift[0], lo[0], total,
+            bucket_ms[0], num_groups=num_groups, num_buckets=num_buckets,
+            which=which)
+        return {k: v[None] for k, v in p.items()}
+
+    mapped = shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P(SEGMENT_AXIS, None), P(SEGMENT_AXIS, None),
+                  P(SEGMENT_AXIS, None), P(SEGMENT_AXIS, None),
+                  P(SEGMENT_AXIS), P(SEGMENT_AXIS), P(), P()),
+        out_specs=P(SEGMENT_AXIS),
+        check_vma=False,
+    )
+    return jax.jit(mapped)
+
+
 def sharded_merge_dedup(mesh, *, num_pks: int):
     """Build the compiled multi-chip merge-dedup.
 

@@ -107,6 +107,10 @@ class ScanConfig:
     # windows batch onto a 1-D segment mesh in rounds of this size with
     # partial grids combined via ICI psum/pmin/pmax
     mesh_devices: int = 0
+    # single-device aggregate rounds: windows (across segments) batched
+    # into one compiled program per round — the UnionExec axis as a vmap.
+    # Meshed scans use mesh_devices as the round size instead.
+    agg_batch_windows: int = 16
 
 
 @dataclass

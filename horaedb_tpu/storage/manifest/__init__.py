@@ -184,6 +184,14 @@ class Manifest:
 
     async def update(self, update: ManifestUpdate) -> None:
         self._merger.maybe_schedule_merge()
+        if self._merger.deltas_num > self._merger.config.soft_merge_threshold:
+            # Backpressure must actually let the merger run: with an
+            # in-memory/local store no await in the write path truly
+            # suspends, so a tight writer loop would starve the merger
+            # task until the hard limit fails every write (the reference
+            # runs its merger on a separate tokio thread; a single
+            # asyncio loop needs an explicit yield).
+            await asyncio.sleep(0)
         self._merger.deltas_num += 1
         try:
             await self._update_inner(update)

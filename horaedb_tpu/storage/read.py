@@ -38,6 +38,7 @@ import jax.numpy as jnp
 
 from horaedb_tpu.common.error import ensure
 from horaedb_tpu.objstore import ObjectStore
+from horaedb_tpu.ops import downsample as downsample_ops
 from horaedb_tpu.ops import encode, filter as filter_ops, merge as merge_ops
 from horaedb_tpu.storage.config import StorageConfig, UpdateMode
 from horaedb_tpu.storage.operator import build_operator
@@ -82,6 +83,12 @@ class AggregateSpec:
     range_start: int  # host-time of bucket 0
     bucket_ms: int
     num_buckets: int
+    # which aggregates to compute (canonicalized; count always rides
+    # along — combining and finalize key on it)
+    which: tuple = downsample_ops.ALL_AGGS
+
+    def __post_init__(self):
+        self.which = tuple(sorted(set(self.which)))
 
 
 @dataclass
@@ -411,33 +418,66 @@ class ParquetReader:
     async def aggregate_segments(self, plan: ScanPlan, spec: AggregateSpec):
         """Per segment, yield (segment_start, partial parts) — the
         retryable unit for scan_aggregate (segments already yielded are
-        skipped on a replan).  Aggregation proceeds in segment order so
-        `last` tie-breaks stay deterministic."""
+        skipped on a replan; a segment is yielded only once ALL its
+        windows are aggregated).
+
+        Windows from different segments batch into rounds of
+        `scan.agg_batch_windows` (mesh size when meshed) and run as ONE
+        compiled program per round — the reference parallelizes segments
+        under UnionExec (storage.rs:342-368); here segments share the
+        batch/mesh leading axis.  Cross-segment batching is safe because
+        segments partition time and windows partition PKs: no two
+        windows share a (group, bucket, timestamp) cell, so the host
+        combine has no tie-break subtleties."""
         ensure(plan.mode is UpdateMode.OVERWRITE,
                "aggregate pushdown requires Overwrite mode")
+        from collections import deque
+
+        batch_w = (self.mesh.devices.size if self.mesh is not None
+                   else max(1, self.config.scan.agg_batch_windows))
+        queue: list[tuple[int, encode.DeviceBatch, tuple]] = []
+        parts: dict[int, list] = {}
+        pending: dict[int, int] = {}
+        arrived: "deque[int]" = deque()
+
+        def flush(k: int) -> None:
+            for seg_start, part in self._flush_window_batch(queue[:k], spec):
+                parts[seg_start].append(part)
+                pending[seg_start] -= 1
+            del queue[:k]
+
         async for seg, windows, read_s in self._cached_windows(plan):
             t0 = time.perf_counter()
-            if self.mesh is not None and len(windows) > 1:
-                seg_parts = self._aggregate_windows_mesh(windows, spec, plan)
-                for out_batch in windows:
-                    _ROWS_SCANNED.inc(out_batch.n_valid)
-            else:
-                seg_parts = []
-                for out_batch in windows:
-                    part = self._aggregate_window(out_batch, spec, plan)
-                    if part is not None:
-                        seg_parts.append(part)
-                    # same semantics as the row path: post-dedup rows
-                    _ROWS_SCANNED.inc(out_batch.n_valid)
+            s = seg.segment_start
+            arrived.append(s)
+            parts[s] = []
+            pending[s] = 0
+            for w in windows:
+                # same semantics as the row path: post-dedup rows
+                _ROWS_SCANNED.inc(w.n_valid)
+                prep = self._window_groups(w, spec, plan)
+                if prep is not None:
+                    queue.append((s, w, prep))
+                    pending[s] += 1
+            while len(queue) >= batch_w:
+                flush(batch_w)
             _SCAN_LATENCY.observe(read_s + (time.perf_counter() - t0))
-            yield seg.segment_start, seg_parts
+            while arrived and pending[arrived[0]] == 0:
+                s0 = arrived.popleft()
+                yield s0, parts.pop(s0)
+        if queue:
+            flush(len(queue))
+        while arrived:
+            s0 = arrived.popleft()
+            yield s0, parts.pop(s0)
 
     @staticmethod
     def finalize_aggregate(parts: list, spec: AggregateSpec):
-        group_values, grids = combine_aggregate_parts(parts, spec.num_buckets)
+        group_values, grids = combine_aggregate_parts(parts, spec.num_buckets,
+                                                      which=spec.which)
         # last_ts is computed relative to range_start on device; expose it
         # as ABSOLUTE time so all downsample paths share one unit
-        if len(group_values):
+        if len(group_values) and "last_ts" in grids:
             grids["last_ts"] = grids["last_ts"] + spec.range_start
         return group_values, grids
 
@@ -472,7 +512,9 @@ class ParquetReader:
             keep &= np.asarray(mask)
 
         # dense group ids: one int32 column roundtrips to host (cheap),
-        # values/timestamps stay on device
+        # values/timestamps stay on device; the dense-id array itself is
+        # memoized DEVICE-resident so repeat queries over cached windows
+        # upload nothing
         codes = np.asarray(out_batch.columns[spec.group_col])
         sel_codes = codes[keep]
         if len(sel_codes) == 0:
@@ -489,91 +531,122 @@ class ParquetReader:
         ensure(abs(shift) < 2**31, "query range too far from segment epoch")
         group_values = _decode_group_values(
             uniq, out_batch.encodings[spec.group_col])
-        return group_values, gid_full, shift
+        return group_values, jnp.asarray(gid_full), shift
 
-    def _aggregate_window(self, out_batch: encode.DeviceBatch,
-                          spec: AggregateSpec,
-                          plan: ScanPlan) -> Optional[tuple[np.ndarray, dict]]:
-        prep = self._window_groups(out_batch, spec, plan)
-        if prep is None:
-            return None
-        group_values, gid_full, shift = prep
-        cap = out_batch.capacity
-        g_pad = max(8, 1 << (len(group_values) - 1).bit_length())
-        partial = _partial_aggregate_jit(
-            out_batch.columns[spec.ts_col], jnp.asarray(gid_full),
-            out_batch.columns[spec.value_col],
-            jnp.int32(cap), jnp.int32(shift), jnp.int32(spec.bucket_ms),
-            num_groups=g_pad, num_buckets=spec.num_buckets)
-        host_partial = {name: np.asarray(a)[: len(group_values)]
-                        for name, a in partial.items()}
-        return group_values, host_partial
+    def _window_grid_width(self, spec: AggregateSpec) -> int:
+        """Static per-window grid width: a window's rows span at most one
+        segment, so its buckets span at most segment_ms/bucket_ms (+2
+        for epoch/range misalignment).  Per-window grids cover only that
+        local range and carry a bucket offset into the host combine —
+        a full-query-width grid per window would move groups x
+        total_buckets cells to host PER WINDOW (10s of MB each on long
+        ranges) instead of groups x window_span."""
+        need = self.segment_duration_ms // max(1, spec.bucket_ms) + 2
+        return int(min(spec.num_buckets,
+                       max(8, 1 << (need - 1).bit_length())))
 
-    def _aggregate_windows_mesh(self, windows: list, spec: AggregateSpec,
-                                plan: ScanPlan) -> list:
-        """Multi-chip aggregation of one segment's windows: rounds of
-        mesh-size windows run as ONE shard_map program; the per-shard
-        partial grids fold on host in float64, keeping results bit-equal
-        to the single-device path.  Windows never share (group, bucket,
-        timestamp) cells — windows partition PKs and segments partition
-        time — so cross-window combination has no tie-break subtleties.
-        Returns parts in the (group_values, partial grids) shape the
-        host combiner eats.
+    def _flush_window_batch(self, items: list, spec: AggregateSpec) -> list:
+        """Aggregate one round of windows (possibly from several
+        segments) as a single compiled program, staying device-resident
+        between merge and aggregate.
 
-        Staging cost note: windows round-trip device->host->device to
-        stack onto the mesh; keeping them mesh-resident end-to-end is
-        ROADMAP.md item 2 (needs device-side resharding)."""
-        from horaedb_tpu.parallel.scan import (
-            shard_leading_axis,
-            sharded_window_partials,
-        )
+        items: [(seg_start, window, (group_values, gid_dev, shift))].
+        Returns [(seg_start, (round_values, bucket_lo, partial grids))]
+        in item order; every part shares the round's union group values
+        (rows a window didn't touch have count 0 and fold away in the
+        combiner).  Rounds are padded to the full batch width with empty
+        windows so one program shape serves every flush."""
+        if self.mesh is not None:
+            batch_w = self.mesh.devices.size
+        else:
+            # pow2 width >= len(items): full rounds share one program,
+            # tail/small queries use narrower ones (bounded variants)
+            batch_w = min(max(1, self.config.scan.agg_batch_windows),
+                          1 << (len(items) - 1).bit_length())
+        round_values = np.unique(np.concatenate([it[2][0] for it in items]))
+        g = len(round_values)
+        g_pad = max(8, 1 << (g - 1).bit_length())
+        cap = max(it[1].capacity for it in items)
+        # offset-encoded ts columns bound each window's bucket range (the
+        # epoch is the segment table's min ts); anything else falls back
+        # to full-range grids with lo=0
+        local_ok = all(
+            it[1].encodings[spec.ts_col].kind == "offset" for it in items)
+        width = self._window_grid_width(spec) if local_ok \
+            else spec.num_buckets
 
-        n_dev = self.mesh.devices.size
-        preps = []
-        for w in windows:
-            prep = self._window_groups(w, spec, plan)
-            if prep is not None:
-                preps.append((w, *prep))
-        parts = []
-        for i in range(0, len(preps), n_dev):
-            round_preps = preps[i:i + n_dev]
-            # union the round's group values; remap window gids into it
-            round_values = np.unique(np.concatenate(
-                [p[1] for p in round_preps]))
-            g = len(round_values)
-            g_pad = max(8, 1 << (g - 1).bit_length())
-            cap = max(p[0].capacity for p in round_preps)
-            ts = np.zeros((n_dev, cap), dtype=np.int32)
-            gid = np.full((n_dev, cap), -1, dtype=np.int32)
-            vals = np.zeros((n_dev, cap), dtype=np.float32)
-            n_valid = np.zeros(n_dev, dtype=np.int32)
-            for d, (w, values, gid_full, shift) in enumerate(round_preps):
-                wc = w.capacity
-                remap = np.searchsorted(round_values, values).astype(np.int32)
-                ts[d, :wc] = np.asarray(w.columns[spec.ts_col]) + shift
-                gid[d, :wc] = np.where(gid_full >= 0, remap[gid_full], -1)
-                vals[d, :wc] = np.asarray(w.columns[spec.value_col])
-                n_valid[d] = wc  # gid=-1 already drops non-kept rows
-            # memoize the compiled program per grid shape — rebuilding the
-            # shard_map closure would recompile every round
-            fn_key = (g_pad, spec.num_buckets)
+        ts_rows, gid_rows, val_rows = [], [], []
+        remap = np.zeros((batch_w, g_pad), dtype=np.int32)
+        shift = np.zeros(batch_w, dtype=np.int32)
+        lo = np.zeros(batch_w, dtype=np.int32)
+        for d, (_seg_start, w, (values, gid_dev, sh)) in enumerate(items):
+            ts_d = w.columns[spec.ts_col]
+            val_d = w.columns[spec.value_col]
+            if w.capacity < cap:
+                pad_n = cap - w.capacity
+                ts_d = jnp.pad(ts_d, (0, pad_n))
+                gid_dev = jnp.pad(gid_dev, (0, pad_n), constant_values=-1)
+                val_d = jnp.pad(val_d, (0, pad_n))
+            ts_rows.append(ts_d)
+            gid_rows.append(gid_dev)
+            val_rows.append(val_d)
+            remap[d, : len(values)] = np.searchsorted(round_values, values)
+            shift[d] = sh
+            if local_ok:
+                lo[d] = max(0, sh // spec.bucket_ms)
+        if len(items) < batch_w:  # pad the round with no-op windows
+            empty_gid = jnp.full(cap, -1, dtype=jnp.int32)
+            zeros_i = jnp.zeros(cap, dtype=jnp.int32)
+            zeros_f = jnp.zeros(cap, dtype=jnp.float32)
+            for _ in range(batch_w - len(items)):
+                ts_rows.append(zeros_i)
+                gid_rows.append(empty_gid)
+                val_rows.append(zeros_f)
+        ts_s = jnp.stack(ts_rows)
+        gid_s = jnp.stack(gid_rows)
+        val_s = jnp.stack(val_rows)
+        total = jnp.int32(spec.num_buckets)
+
+        if self.mesh is not None:
+            from horaedb_tpu.parallel.scan import (
+                shard_leading_axis,
+                sharded_remap_partials,
+            )
+
+            # memoize the compiled program per grid shape — rebuilding
+            # the shard_map closure would recompile every round
+            fn_key = (g_pad, width, spec.which)
             fn = self._mesh_agg_fns.get(fn_key)
             if fn is None:
-                fn = sharded_window_partials(self.mesh, num_groups=g_pad,
-                                             num_buckets=spec.num_buckets)
+                fn = sharded_remap_partials(self.mesh, num_groups=g_pad,
+                                            num_buckets=width,
+                                            which=spec.which)
                 self._mesh_agg_fns[fn_key] = fn
-            stacked = fn(shard_leading_axis(self.mesh, ts),
-                         shard_leading_axis(self.mesh, gid),
-                         shard_leading_axis(self.mesh, vals),
-                         shard_leading_axis(self.mesh, n_valid),
+            shard = functools.partial(shard_leading_axis, self.mesh)
+            stacked = fn(shard(ts_s), shard(gid_s), shard(val_s),
+                         shard(jnp.asarray(remap)), shard(jnp.asarray(shift)),
+                         shard(jnp.asarray(lo)), total,
                          jnp.asarray([spec.bucket_ms], dtype=jnp.int32))
-            # per-shard partials fold on host in f64 (bit-equal to the
-            # single-device path); padding shards beyond the round's real
-            # windows are sliced away
-            host = {k: np.asarray(v) for k, v in stacked.items()}
-            for d in range(len(round_preps)):
-                parts.append((round_values,
-                              {k: v[d, :g] for k, v in host.items()}))
+        else:
+            stacked = _batched_window_partials_jit(
+                ts_s, gid_s, val_s, jnp.asarray(remap), jnp.asarray(shift),
+                jnp.asarray(lo), total, jnp.int32(spec.bucket_ms),
+                num_groups=g_pad, num_buckets=width, which=spec.which)
+        # per-window partials fold on host in f64 (bit-equal to the
+        # single-window path); padding windows are sliced away
+        host = {k: np.asarray(v) for k, v in stacked.items()}
+        parts = []
+        for d in range(len(items)):
+            lo_d = int(lo[d])
+            w_eff = min(width, spec.num_buckets - lo_d)
+            grids = {k: v[d, :g, :w_eff] for k, v in host.items()}
+            if "last_ts" in grids:
+                # re-base window-local last_ts to range_start-relative so
+                # parts with different offsets compare correctly
+                lt = grids["last_ts"].astype(np.int64)
+                grids["last_ts"] = np.where(
+                    grids["count"] > 0, lt + lo_d * spec.bucket_ms, lt)
+            parts.append((items[d][0], (round_values, lo_d, grids)))
         return parts
 
     def _merge_on_host(self, batch: pa.RecordBatch,
@@ -595,14 +668,23 @@ class ParquetReader:
         return merged
 
 
-@functools.partial(jax.jit, static_argnames=("num_groups", "num_buckets"))
-def _partial_aggregate_jit(ts, gid, vals, n_valid, shift, bucket_ms,
-                           num_groups: int, num_buckets: int):
+@functools.partial(jax.jit, static_argnames=("num_groups", "num_buckets",
+                                             "which"))
+def _batched_window_partials_jit(ts, gid, vals, remap, shift, lo, total,
+                                 bucket_ms, num_groups: int,
+                                 num_buckets: int, which: tuple):
+    """Single-device twin of parallel.scan.sharded_remap_partials: vmap
+    over the window axis instead of shard_map over the mesh — one device
+    dispatch aggregates a whole round of windows into window-LOCAL grids
+    of `num_buckets` buckets starting at each window's `lo` bucket."""
     from horaedb_tpu.ops import downsample
 
-    return downsample.partial_aggregate(
-        ts + shift, gid, vals, n_valid, bucket_ms,
-        num_groups=num_groups, num_buckets=num_buckets)
+    def one(ts_b, gid_b, vals_b, remap_b, shift_b, lo_b):
+        return downsample.window_local_partials(
+            ts_b, gid_b, vals_b, remap_b, shift_b, lo_b, total, bucket_ms,
+            num_groups=num_groups, num_buckets=num_buckets, which=which)
+
+    return jax.vmap(one)(ts, gid, vals, remap, shift, lo)
 
 
 def _decode_group_values(codes: np.ndarray, enc) -> np.ndarray:
@@ -615,58 +697,81 @@ def _decode_group_values(codes: np.ndarray, enc) -> np.ndarray:
     return codes
 
 
-def combine_aggregate_parts(parts: list[tuple[np.ndarray, dict]],
-                            num_buckets: int) -> tuple[np.ndarray, dict]:
+def combine_aggregate_parts(parts: list[tuple[np.ndarray, int, dict]],
+                            num_buckets: int,
+                            which: tuple = downsample_ops.ALL_AGGS
+                            ) -> tuple[np.ndarray, dict]:
     """Combine per-window partial grids (from disjoint-or-overlapping
     group sets) into one finalized grid, keyed by the union of group
-    values.  Grids are small (groups x buckets), so this is cheap host
-    numpy.  `last` combines by latest timestamp, later part winning ties
-    (parts arrive in segment/window order)."""
+    values.  Each part is (group_values, bucket_lo, grids): its grids
+    cover LOCAL buckets [bucket_lo, bucket_lo + width) of the global
+    range, so a window only ever moves groups x window-span cells.
+    `last` combines by latest (range-relative) timestamp, later part
+    winning ties (parts arrive in segment/window order)."""
+    requested = set(which) | {"count"}
+    want = set(requested)
+    if "avg" in want:
+        want.add("sum")  # dependency only — not emitted unless requested
+    emit = [k for k in ("count", "sum", "min", "max", "avg", "last",
+                        "last_ts") if k in requested or
+            (k == "last_ts" and "last" in requested)]
     if not parts:
         empty = np.zeros((0, num_buckets), dtype=np.float32)
-        return np.asarray([]), {k: empty.copy() for k in
-                                ("count", "sum", "min", "max", "avg", "last",
-                                 "last_ts")}
-    all_values = np.unique(np.concatenate([v for v, _ in parts]))
+        return np.asarray([]), {k: empty.copy() for k in emit}
+    all_values = np.unique(np.concatenate([v for v, _, _ in parts]))
     g = len(all_values)
-    acc = {
-        "count": np.zeros((g, num_buckets), dtype=np.float64),
-        "sum": np.zeros((g, num_buckets), dtype=np.float64),
-        "min": np.full((g, num_buckets), np.inf, dtype=np.float64),
-        "max": np.full((g, num_buckets), -np.inf, dtype=np.float64),
-        "last": np.zeros((g, num_buckets), dtype=np.float64),
-        "last_ts": np.full((g, num_buckets), np.iinfo(np.int64).min,
-                           dtype=np.int64),
-    }
-    for values, p in parts:
+    acc: dict = {"count": np.zeros((g, num_buckets), dtype=np.float64)}
+    if "sum" in want:
+        acc["sum"] = np.zeros((g, num_buckets), dtype=np.float64)
+    if "min" in want:
+        acc["min"] = np.full((g, num_buckets), np.inf, dtype=np.float64)
+    if "max" in want:
+        acc["max"] = np.full((g, num_buckets), -np.inf, dtype=np.float64)
+    if "last" in want:
+        acc["last"] = np.zeros((g, num_buckets), dtype=np.float64)
+        acc["last_ts"] = np.full((g, num_buckets), np.iinfo(np.int64).min,
+                                 dtype=np.int64)
+    for values, lo, p in parts:
         rows = np.searchsorted(all_values, values)
-        acc["count"][rows] += p["count"]
-        acc["sum"][rows] += p["sum"]
-        acc["min"][rows] = np.minimum(acc["min"][rows], p["min"])
-        acc["max"][rows] = np.maximum(acc["max"][rows], p["max"])
-        newer = p["last_ts"].astype(np.int64) >= acc["last_ts"][rows]
-        has_data = p["count"] > 0
-        take = newer & has_data
-        last_rows = acc["last"][rows]
-        last_rows[take] = p["last"][take]
-        acc["last"][rows] = last_rows
-        lt_rows = acc["last_ts"][rows]
-        lt_rows[take] = p["last_ts"].astype(np.int64)[take]
-        acc["last_ts"][rows] = lt_rows
+        width = p["count"].shape[1]
+        sl = slice(lo, lo + width)
+        acc["count"][rows, sl] += p["count"]
+        if "sum" in acc:
+            acc["sum"][rows, sl] += p["sum"]
+        if "min" in acc:
+            acc["min"][rows, sl] = np.minimum(acc["min"][rows, sl], p["min"])
+        if "max" in acc:
+            acc["max"][rows, sl] = np.maximum(acc["max"][rows, sl], p["max"])
+        if "last" in acc:
+            newer = p["last_ts"].astype(np.int64) >= acc["last_ts"][rows, sl]
+            has_data = p["count"] > 0
+            take = newer & has_data
+            last_rows = acc["last"][rows, sl]
+            last_rows[take] = p["last"][take]
+            acc["last"][rows, sl] = last_rows
+            lt_rows = acc["last_ts"][rows, sl]
+            lt_rows[take] = p["last_ts"].astype(np.int64)[take]
+            acc["last_ts"][rows, sl] = lt_rows
     empty = acc["count"] == 0
-    with np.errstate(invalid="ignore", divide="ignore"):
-        avg = np.where(empty, np.nan, acc["sum"] / np.maximum(acc["count"], 1))
-    out = {
-        "count": acc["count"],
-        "sum": acc["sum"],
-        "min": acc["min"],
-        "max": acc["max"],
-        "avg": avg,
-        "last": np.where(empty, np.nan, acc["last"]),
+    out = {"count": acc["count"]}
+    # expose sum only when EXPLICITLY requested — it may be present in
+    # acc merely as avg's dependency
+    if "sum" in acc and "sum" in requested:
+        out["sum"] = acc["sum"]
+    if "sum" in acc and "avg" in want:
+        with np.errstate(invalid="ignore", divide="ignore"):
+            out["avg"] = np.where(empty, np.nan,
+                                  acc["sum"] / np.maximum(acc["count"], 1))
+    if "min" in acc:
+        out["min"] = acc["min"]
+    if "max" in acc:
+        out["max"] = acc["max"]
+    if "last" in acc:
+        out["last"] = np.where(empty, np.nan, acc["last"])
         # exposed (as float, NaN for empty) so cross-region merges can
         # pick `last` by actual sample time instead of region order
-        "last_ts": np.where(empty, np.nan, acc["last_ts"].astype(np.float64)),
-    }
+        out["last_ts"] = np.where(empty, np.nan,
+                                  acc["last_ts"].astype(np.float64))
     return all_values, out
 
 

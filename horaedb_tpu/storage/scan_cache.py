@@ -63,6 +63,13 @@ class ScanCache:
             self._total_rows -= evicted_rows
             _EVICTIONS.inc()
 
+    def clear(self) -> None:
+        """Drop every entry (releases device buffers via refcounting).
+        Used by cold-path benchmarks and tests; production invalidation
+        is structural (SST-set keys), never explicit."""
+        self._entries.clear()
+        self._total_rows = 0
+
     @property
     def total_rows(self) -> int:
         return self._total_rows

@@ -45,6 +45,23 @@ def test_add_and_find():
     asyncio.run(go())
 
 
+def test_burst_writes_never_starve_merger():
+    """A tight writer loop on an in-memory store has no true suspension
+    points, so without an explicit yield the background merger would
+    starve and every write past the hard threshold would fail."""
+    async def go():
+        store = MemoryObjectStore()
+        m = await Manifest.open("root", store, fast_config())
+        try:
+            for i in range(3 * m._merger.config.hard_merge_threshold):
+                await m.add_file(i + 1, meta(i, i + 1, seq=i + 1))
+            assert m.deltas_num <= m._merger.config.hard_merge_threshold
+        finally:
+            await m.close()
+
+    asyncio.run(go())
+
+
 def test_update_delete_from_cache():
     async def go():
         store = MemoryObjectStore()

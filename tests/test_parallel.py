@@ -217,3 +217,70 @@ class TestEngineMeshAggregation:
                     np.asarray(meshed_small["aggs"][key]), err_msg=key)
 
         asyncio.run(go())
+
+    def test_mesh_spans_segments_and_agg_subset(self):
+        """Windows from DIFFERENT segments batch onto one mesh round (the
+        UnionExec axis); restricting `aggs` must not change the computed
+        grids."""
+        import asyncio
+
+        import pyarrow as pa
+
+        from horaedb_tpu.metric_engine import MetricEngine
+        from horaedb_tpu.objstore import MemoryObjectStore
+        from horaedb_tpu.storage.config import StorageConfig, from_dict
+        from horaedb_tpu.storage.types import TimeRange
+
+        H = 3_600_000
+        T0 = (1_700_000_000_000 // (2 * H)) * 2 * H
+        SPAN = 12 * H  # 6 two-hour segments, one window each
+
+        async def run(mesh_devices, aggs):
+            cfg = from_dict(StorageConfig, {
+                "scheduler": {"schedule_interval": "1h"},
+                "scan": {"mesh_devices": mesh_devices,
+                         "agg_batch_windows": 4},
+            })
+            e = await MetricEngine.open("m", MemoryObjectStore(),
+                                        segment_ms=2 * H, config=cfg)
+            try:
+                rng = np.random.default_rng(3)
+                n, hosts = 6000, 10
+                names = np.array([f"h{i:02d}" for i in range(hosts)],
+                                 dtype=object)
+                sel = rng.integers(0, hosts, n)
+                batch = pa.record_batch({
+                    "host": pa.array(names[sel]),
+                    "timestamp": pa.array(
+                        T0 + rng.integers(0, SPAN, n), type=pa.int64()),
+                    "value": pa.array(rng.random(n) * 100,
+                                      type=pa.float64()),
+                })
+                await e.write_arrow("cpu", ["host"], batch)
+                return await e.query_downsample(
+                    "cpu", [], TimeRange.new(T0, T0 + SPAN),
+                    bucket_ms=600_000, aggs=aggs)
+            finally:
+                await e.close()
+
+        async def go():
+            from horaedb_tpu.ops.downsample import ALL_AGGS
+
+            single = await run(0, ALL_AGGS)
+            meshed = await run(4, ALL_AGGS)
+            assert single["tsids"] == meshed["tsids"]
+            for key in ("count", "sum", "min", "max", "avg", "last"):
+                np.testing.assert_array_equal(
+                    np.asarray(single["aggs"][key]),
+                    np.asarray(meshed["aggs"][key]), err_msg=key)
+            # restricted aggregates: same numbers, fewer grids
+            subset = await run(0, ("avg",))
+            assert "min" not in subset["aggs"] and "last" not in subset["aggs"]
+            # sum is avg's dependency but was not requested
+            assert "sum" not in subset["aggs"]
+            np.testing.assert_array_equal(subset["aggs"]["avg"],
+                                          np.asarray(single["aggs"]["avg"]))
+            np.testing.assert_array_equal(subset["aggs"]["count"],
+                                          np.asarray(single["aggs"]["count"]))
+
+        asyncio.run(go())
