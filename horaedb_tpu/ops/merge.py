@@ -52,7 +52,9 @@ def _merge_dedup_impl(cols: tuple, n_valid: jax.Array, num_pks: int, num_keys: i
     # are fetched afterwards with a single fused gather.  With V value
     # columns this moves V arrays out of the O(n log n) sort and into an
     # O(n) gather.  Padding must sort last: pad keys become the int32 max
-    # sentinel.
+    # sentinel.  num_keys may be num_pks (seq known row-ordered: the
+    # stable sort then keeps original order within a run, so last row per
+    # run == highest seq without paying for seq as a sort operand).
     keys = tuple(jnp.where(valid, c, _PAD_SENTINEL) for c in cols[:num_keys])
     sorted_all = jax.lax.sort(keys + (iota,), num_keys=num_keys, is_stable=True)
     sorted_keys, perm = sorted_all[:-1], sorted_all[-1]
@@ -77,7 +79,8 @@ def _merge_dedup_impl(cols: tuple, n_valid: jax.Array, num_pks: int, num_keys: i
 
 
 def merge_dedup_last(pk_cols: tuple, seq: jax.Array, value_cols: tuple,
-                     n_valid) -> tuple[tuple, tuple, jax.Array, jax.Array]:
+                     n_valid, seq_in_row_order: bool = False
+                     ) -> tuple[tuple, tuple, jax.Array, jax.Array]:
     """Sort + dedup, keeping the last-by-sequence row per primary key.
 
     Args:
@@ -85,6 +88,11 @@ def merge_dedup_last(pk_cols: tuple, seq: jax.Array, value_cols: tuple,
       seq: int32 array — per-row sequence rank (order-preserving).
       value_cols: arrays (capacity,) — carried value columns (any dtype).
       n_valid: scalar — number of real rows.
+      seq_in_row_order: set True ONLY when seq is non-decreasing with
+        row index (e.g. rows are concatenated SSTs sorted by file id and
+        seq is the file id).  The stable PK sort then already places the
+        highest-seq row last within each run, so seq is carried as a
+        value column instead of paying for it as a sort operand.
 
     Returns (out_pk_cols, out_seq, out_value_cols, out_valid_mask, num_runs);
     outputs are sorted by PK ascending, padded to capacity.  out_seq carries
@@ -94,7 +102,8 @@ def merge_dedup_last(pk_cols: tuple, seq: jax.Array, value_cols: tuple,
     cols = tuple(pk_cols) + (seq,) + tuple(value_cols)
     out_cols, out_valid, num_runs = _merge_dedup_impl(
         cols, jnp.asarray(n_valid, dtype=jnp.int32),
-        num_pks=len(pk_cols), num_keys=len(pk_cols) + 1)
+        num_pks=len(pk_cols),
+        num_keys=len(pk_cols) + (0 if seq_in_row_order else 1))
     out_pks = out_cols[: len(pk_cols)]
     out_seq = out_cols[len(pk_cols)]
     out_values = out_cols[len(pk_cols) + 1:]

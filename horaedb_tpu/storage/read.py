@@ -131,6 +131,7 @@ class ParquetReader:
         self.scan_cache = ScanCache(config.scan.cache_max_rows)
         self.mesh = None
         self._mesh_agg_fns: dict = {}
+        self._mesh_merge_fns: dict = {}
         if config.scan.mesh_devices > 0:
             from horaedb_tpu.parallel import segment_mesh
 
@@ -224,11 +225,22 @@ class ParquetReader:
             seg.segment_start, (f.id for f in seg.ssts),
             tuple(seg.columns) + (plan.pushdown_key,))
 
+    # segments whose merges are dispatched but not yet synced: overlaps
+    # device merge compute with the NEXT segments' host decode/encode
+    _MERGE_LOOKAHEAD = 2
+
     async def _cached_windows(self, plan: ScanPlan):
         """Per segment, yield (seg, post-merge DeviceBatch windows,
         read_seconds) — from the HBM-resident cache when the segment's
         (SST set, columns, pushdown) is unchanged, else by reading +
-        merging (and populating the cache unless the plan opted out)."""
+        merging (and populating the cache unless the plan opted out).
+
+        Merge programs for up to _MERGE_LOOKAHEAD upcoming segments are
+        dispatched before the current segment's run counts are synced,
+        so the device pipeline never drains while the host prepares the
+        next segment."""
+        from collections import deque
+
         cached: dict[int, list] = {}
         to_read: list[SegmentPlan] = []
         for seg in plan.segments:
@@ -238,21 +250,139 @@ class ParquetReader:
                 to_read.append(seg)
             else:
                 cached[id(seg)] = windows
+        if self.mesh is not None:
+            async for out in self._cached_windows_mesh(plan, cached, to_read):
+                yield out
+            return
+
         read_iter = self._prefetch_tables(to_read, plan).__aiter__()
+        pending: "deque[tuple[SegmentPlan, list, float]]" = deque()
+        exhausted = False
+
+        async def pump() -> None:
+            nonlocal exhausted
+            try:
+                read_seg, table, read_s = await read_iter.__anext__()
+            except StopAsyncIteration:
+                exhausted = True
+                return
+            dispatched: list = []
+            if table.num_rows:
+                batch = table.combine_chunks().to_batches()[0]
+                dispatched = self._dispatch_merged_windows(batch)
+            pending.append((read_seg, dispatched, read_s))
+
         for seg in plan.segments:
             if id(seg) in cached:
                 yield seg, cached[id(seg)], 0.0
                 continue
-            read_seg, table, read_s = await read_iter.__anext__()
+            while len(pending) <= self._MERGE_LOOKAHEAD and not exhausted:
+                await pump()
+            read_seg, dispatched, read_s = pending.popleft()
             assert read_seg is seg
-            windows = []
-            if table.num_rows:
-                batch = table.combine_chunks().to_batches()[0]
-                windows = list(self._merged_windows(batch))
+            windows = self._finalize_windows(dispatched)
             if plan.use_cache:
                 self.scan_cache.put(self._cache_key(seg, plan), windows,
                                     sum(w.capacity for w in windows))
             yield seg, windows, read_s
+
+    async def _cached_windows_mesh(self, plan: ScanPlan, cached: dict,
+                                   to_read: list):
+        """Mesh twin of _cached_windows' read path: merge windows from
+        DIFFERENT segments batch into rounds of mesh-size
+        sharded_merge_dedup programs (shard-local sort/dedup, no
+        collectives), so every query shape drives all chips — the
+        reference's UnionExec-parallel merge (storage.rs:342-368) with
+        segments as the shard axis.  Segments still yield in plan order,
+        each one only after all its windows' rounds have run."""
+        from horaedb_tpu.parallel.scan import shard_leading_axis
+
+        n_dev = self.mesh.devices.size
+        read_iter = self._prefetch_tables(to_read, plan).__aiter__()
+        # buffer entries: [seg, windows(list, filled in round order),
+        #                  outstanding window count, read_s]
+        buffer: list[list] = []
+        pending: list[tuple[list, dict, int, int, dict]] = []
+
+        def run_round(round_items: list) -> None:
+            cap = max(it[3] for it in round_items)
+            names = list(round_items[0][1].keys())
+            stacks = {}
+            for name in names:
+                rows = np.zeros(
+                    (n_dev, cap), dtype=round_items[0][1][name].dtype)
+                for d, (_e, cols, n_win, wcap, _enc) in enumerate(round_items):
+                    rows[d, :wcap] = cols[name]
+                stacks[name] = shard_leading_axis(self.mesh, rows)
+            n_valid = np.zeros(n_dev, dtype=np.int32)
+            for d, it in enumerate(round_items):
+                n_valid[d] = it[2]
+            pk_names = self._pk_names_in(names)
+            value_names = [nm for nm in names
+                           if nm not in pk_names and nm != SEQ_COLUMN_NAME]
+            fn = self._mesh_merge_fns.get(len(pk_names))
+            if fn is None:
+                from horaedb_tpu.parallel.scan import sharded_merge_dedup
+
+                fn = sharded_merge_dedup(self.mesh, num_pks=len(pk_names))
+                self._mesh_merge_fns[len(pk_names)] = fn
+            out_pks, out_seq, out_vals, _valid, num_runs = fn(
+                tuple(stacks[nm] for nm in pk_names),
+                stacks[SEQ_COLUMN_NAME],
+                tuple(stacks[nm] for nm in value_names),
+                shard_leading_axis(self.mesh, n_valid))
+            runs_host = np.asarray(num_runs)
+            for d, (entry, _cols, _n, _wcap, enc) in enumerate(round_items):
+                columns = {
+                    **{nm: a[d] for nm, a in zip(pk_names, out_pks)},
+                    SEQ_COLUMN_NAME: out_seq[d],
+                    **{nm: a[d] for nm, a in zip(value_names, out_vals)},
+                }
+                entry[1].append(encode.DeviceBatch(
+                    columns=columns, encodings=enc,
+                    n_valid=int(runs_host[d]), capacity=cap))
+                entry[2] -= 1
+
+        for seg in plan.segments:
+            if id(seg) in cached:
+                buffer.append([seg, cached[id(seg)], 0, 0.0])
+            else:
+                read_seg, table, read_s = await read_iter.__anext__()
+                assert read_seg is seg
+                descs = []
+                if table.num_rows:
+                    batch = table.combine_chunks().to_batches()[0]
+                    descs = self._prepare_merge_windows(batch)
+                entry = [seg, [], len(descs), read_s]
+                buffer.append(entry)
+                for cols, n_win, wcap, enc in descs:
+                    pending.append((entry, cols, n_win, wcap, enc))
+                while len(pending) >= n_dev:
+                    run_round(pending[:n_dev])
+                    del pending[:n_dev]
+            while buffer and buffer[0][2] == 0:
+                seg0, windows, _outstanding, read_s0 = buffer.pop(0)
+                if plan.use_cache and id(seg0) not in cached:
+                    self.scan_cache.put(self._cache_key(seg0, plan), windows,
+                                        sum(w.capacity for w in windows))
+                yield seg0, windows, read_s0
+        if pending:
+            # tail round: pad with empty windows bound to a discard
+            # entry so real segments' window lists stay exact
+            discard = [None, [], len(pending) - n_dev, 0.0]
+            _e, cols0, _n, wcap0, enc0 = pending[-1]
+            tail = list(pending)
+            while len(tail) < n_dev:
+                tail.append((discard, cols0, 0, wcap0, enc0))
+            run_round(tail)
+            pending.clear()
+        while buffer:
+            seg0, windows, outstanding, read_s0 = buffer.pop(0)
+            assert outstanding == 0
+            if plan.use_cache and id(seg0) not in cached:
+                self.scan_cache.put(self._cache_key(seg0, plan), windows,
+                                    sum(w.capacity for w in windows))
+            yield seg0, windows, read_s0
 
     async def _prefetch_tables(self, segments: list[SegmentPlan],
                                plan: ScanPlan):
@@ -339,15 +469,54 @@ class ParquetReader:
         present = set(columns)
         return [n for n in self.schema.primary_key_names if n in present]
 
-    def _merged_windows(self, batch: pa.RecordBatch):
+    def _prepare_merge_windows(self, batch: pa.RecordBatch) -> list:
+        """Host half of the merge: encode + PK-window planning + padding,
+        WITHOUT dispatching any device program.  Returns
+        [(padded host cols, n_win, capacity, encodings)] — the mesh
+        round scheduler stacks these onto the shard axis."""
+        dev = encode.encode_batch(batch)
+        pk_names = self._pk_names_in(batch.schema.names)
+        ensure(len(pk_names) == self.schema.num_primary_keys,
+               "projection lost primary key columns")
+        n = dev.n_valid
+        window = self.config.scan.max_window_rows
+        if n == 0:
+            return []
+        if n <= window:
+            cols = {k: np.asarray(v) for k, v in dev.columns.items()}
+            return [(cols, n, dev.capacity, dev.encodings)]
+        host_cols = {name: np.asarray(c)[:n]
+                     for name, c in dev.columns.items()}
+        # partition on the first NON-constant pk (same as the non-mesh
+        # path): windowing on a constant column would produce one
+        # unbounded window and defeat the HBM budget
+        part_name = next(
+            (nm for nm in pk_names
+             if host_cols[nm][0] != host_cols[nm][-1]
+             or not bool((host_cols[nm] == host_cols[nm][0]).all())),
+            pk_names[0])
+        descs = []
+        for sel in _plan_pk_windows(host_cols[part_name], window):
+            if not len(sel):
+                continue
+            n_win = len(sel)
+            cap = encode.pad_capacity(n_win)
+            padded = {k: np.pad(v[sel], (0, cap - n_win))
+                      for k, v in host_cols.items()}
+            descs.append((padded, n_win, cap, dev.encodings))
+        return descs
+
+    def _dispatch_merged_windows(self, batch: pa.RecordBatch) -> list:
         """Device merge with bounded HBM: segments above
         scan.max_window_rows are split into PK-code-range windows, each a
         complete set of PK groups, merged independently in key order
         (windows are PK-ascending, so global order is preserved).  The
         streaming analogue of the reference's pull-based MergeStream
-        (SURVEY.md hard part #5).  Yields post-dedup DeviceBatches —
-        consumers decode to Arrow (row scan) or aggregate in place
-        (pushdown path) without leaving the device.
+        (SURVEY.md hard part #5).  Dispatches every window's merge
+        program WITHOUT syncing; _finalize_windows turns the results
+        into post-dedup DeviceBatches — consumers decode to Arrow (row
+        scan) or aggregate in place (pushdown path) without leaving the
+        device.
         """
         dev = encode.encode_batch(batch)  # host-resident numpy columns
         pk_names = self._pk_names_in(batch.schema.names)
@@ -358,12 +527,36 @@ class ParquetReader:
         n = dev.n_valid
         host_cols = {name: np.asarray(c)[:n] for name, c in dev.columns.items()}
 
+        # sort-operand elision (the variadic sort is the scan's hottest
+        # kernel; comparator cost and data movement scale with operands):
+        # - PK columns constant across the segment (e.g. a single-metric
+        #   table's metric/field ids) can't affect the order — carry them
+        #   as values instead of sorting by them;
+        # - seq non-decreasing with row index (SSTs are concatenated in
+        #   file-id order and seq IS the file id) means the stable PK
+        #   sort already leaves the highest-seq row last per run.
+        def is_const(a: np.ndarray) -> bool:
+            # first!=last shortcuts the full scan for sorted columns
+            return len(a) == 0 or (a[0] == a[-1] and bool((a == a[0]).all()))
+
+        sort_pk_names = [nm for nm in pk_names
+                         if not is_const(host_cols[nm])]
+        if not sort_pk_names:
+            sort_pk_names = pk_names[:1]
+        carry_names = [nm for nm in pk_names
+                       if nm not in sort_pk_names] + value_names
+        seq_h = host_cols[SEQ_COLUMN_NAME]
+        seq_ordered = bool(n == 0 or np.all(seq_h[1:] >= seq_h[:-1]))
+
         window = self.config.scan.max_window_rows
         if n <= window:
             selections: list[Optional[np.ndarray]] = [None]
         else:
-            selections = _plan_pk_windows(host_cols[pk_names[0]], window)
+            # partition on the first NON-constant pk so windows stay
+            # meaningfully bounded even when pk 0 is constant
+            selections = _plan_pk_windows(host_cols[sort_pk_names[0]], window)
 
+        dispatched = []
         for sel in selections:
             if sel is None:
                 # single-window fast path: encode_batch already padded
@@ -377,16 +570,28 @@ class ParquetReader:
             if n_win == 0:
                 continue
             dev_cols = {name: jax.device_put(c) for name, c in padded.items()}
-            pks = tuple(dev_cols[name] for name in pk_names)
+            pks = tuple(dev_cols[name] for name in sort_pk_names)
             seq = dev_cols[SEQ_COLUMN_NAME]
-            values = tuple(dev_cols[name] for name in value_names)
+            values = tuple(dev_cols[name] for name in carry_names)
             out_pks, out_seq, out_values, _out_valid, num_runs = \
-                merge_ops.merge_dedup_last(pks, seq, values, n_win)
-            yield encode.DeviceBatch(
-                columns={**{name: a for name, a in zip(pk_names, out_pks)},
-                         SEQ_COLUMN_NAME: out_seq,
-                         **{name: a for name, a in zip(value_names, out_values)}},
-                encodings=dev.encodings, n_valid=int(num_runs), capacity=cap)
+                merge_ops.merge_dedup_last(pks, seq, values, n_win,
+                                           seq_in_row_order=seq_ordered)
+            columns = {**{name: a for name, a in zip(sort_pk_names, out_pks)},
+                       SEQ_COLUMN_NAME: out_seq,
+                       **{name: a for name, a in zip(carry_names, out_values)}}
+            dispatched.append((columns, dev.encodings, num_runs, cap))
+        return dispatched
+
+    @staticmethod
+    def _finalize_windows(dispatched: list) -> list:
+        """Sync the dispatched merges' run counts (int() blocks until the
+        device finishes) and wrap them as DeviceBatches.  Split from
+        dispatch so callers can overlap merge compute across segments."""
+        return [
+            encode.DeviceBatch(columns=columns, encodings=encodings,
+                               n_valid=int(num_runs), capacity=cap)
+            for columns, encodings, num_runs, cap in dispatched
+        ]
 
     def _window_to_arrow(self, out_batch: encode.DeviceBatch,
                          out_names: list[str],

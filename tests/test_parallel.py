@@ -218,6 +218,62 @@ class TestEngineMeshAggregation:
 
         asyncio.run(go())
 
+    def test_mesh_row_scan_equals_single_device(self):
+        """The ROW scan path (not just the aggregate pushdown) must
+        produce identical tables when merges run as mesh rounds."""
+        import asyncio
+
+        import pyarrow as pa
+
+        from horaedb_tpu.metric_engine import MetricEngine
+        from horaedb_tpu.objstore import MemoryObjectStore
+        from horaedb_tpu.storage.config import StorageConfig, from_dict
+        from horaedb_tpu.storage.types import TimeRange
+
+        H = 3_600_000
+        T0 = (1_700_000_000_000 // (2 * H)) * 2 * H
+        SPAN = 8 * H  # 4 segments
+
+        async def run(mesh_devices):
+            cfg = from_dict(StorageConfig, {
+                "scheduler": {"schedule_interval": "1h"},
+                "scan": {"mesh_devices": mesh_devices,
+                         "max_window_rows": 512},
+            })
+            e = await MetricEngine.open("m", MemoryObjectStore(),
+                                        segment_ms=2 * H, config=cfg)
+            try:
+                rng = np.random.default_rng(11)
+                n, hosts = 5000, 12
+                names = np.array([f"h{i:02d}" for i in range(hosts)],
+                                 dtype=object)
+                # duplicate (host, ts) pairs across two writes so dedup
+                # actually bites on the mesh merge
+                ts_vals = T0 + rng.integers(0, SPAN, n)
+                for round_i in range(2):
+                    batch = pa.record_batch({
+                        "host": pa.array(names[rng.integers(0, hosts, n)]),
+                        "timestamp": pa.array(ts_vals, type=pa.int64()),
+                        "value": pa.array(
+                            rng.random(n) * 100 + round_i,
+                            type=pa.float64()),
+                    })
+                    await e.write_arrow("cpu", ["host"], batch)
+                tbl = await e.query("cpu", [],
+                                    TimeRange.new(T0, T0 + SPAN))
+                return tbl.sort_by([("tsid", "ascending"),
+                                    ("timestamp", "ascending")])
+            finally:
+                await e.close()
+
+        async def go():
+            single = await run(0)
+            meshed = await run(4)
+            assert single.num_rows == meshed.num_rows
+            assert single.equals(meshed)
+
+        asyncio.run(go())
+
     def test_mesh_spans_segments_and_agg_subset(self):
         """Windows from DIFFERENT segments batch onto one mesh round (the
         UnionExec axis); restricting `aggs` must not change the computed
