@@ -111,6 +111,13 @@ class ScanConfig:
     # into one compiled program per round — the UnionExec axis as a vmap.
     # Meshed scans use mesh_devices as the round size instead.
     agg_batch_windows: int = 16
+    # segments whose manifest row count exceeds this stream window-by-
+    # window: a first pass over one PK column plans value-range windows,
+    # then each window's rows are read via parquet predicate pushdown,
+    # so host materialization is bounded by the window budget instead of
+    # the segment size (the reference's pull-streaming, read.rs:346-385).
+    # 0 disables streaming (always read whole segments).
+    stream_read_min_rows: int = 8 << 20
 
 
 @dataclass

@@ -114,6 +114,78 @@ async def write_sst(store: ObjectStore, path: str,
     return len(data)
 
 
+def merge_value_counts(pairs: list) -> tuple:
+    """Fold (values, counts) pairs into one sorted pair.  Dtype-
+    preserving: the first non-empty pair fixes the value dtype (uint64
+    tsids must never pass through a float64 concat)."""
+    import numpy as np
+
+    values = counts = None
+    for v, c in pairs:
+        if not len(v):
+            continue
+        if values is None:
+            values, counts = v, np.asarray(c, dtype=np.int64)
+            continue
+        allv = np.concatenate([values, v])
+        allc = np.concatenate([counts, c])
+        values, inv = np.unique(allv, return_inverse=True)
+        counts = np.bincount(inv, weights=allc).astype(np.int64)
+    if values is None:
+        return np.asarray([]), np.asarray([], dtype=np.int64)
+    return values, counts
+
+
+class SstSource:
+    """One SST opened for several reads (the streamed segment read does
+    one pass-1 column scan plus one pass-2 filtered read PER WINDOW).
+    Local stores serve every read from the mmap'd file; other stores
+    fetch the object bytes ONCE and serve all reads from that buffer —
+    never one download per window.  Methods are synchronous; call them
+    via asyncio.to_thread from async code."""
+
+    def __init__(self, path: Optional[str] = None,
+                 data: Optional[bytes] = None):
+        self._path = path
+        self._data = data
+
+    def _source(self):
+        # a fresh reader per call: BufferReader is stateful and parquet
+        # readers seek it
+        return self._path if self._path is not None \
+            else pa.BufferReader(self._data)
+
+    def read(self, columns: Optional[list[str]] = None,
+             filters=None) -> pa.Table:
+        return pq.read_table(self._source(), columns=columns,
+                             memory_map=self._path is not None,
+                             filters=filters)
+
+    def value_counts(self, column: str) -> tuple:
+        """(values, counts) of one column, streamed row-group-wise so
+        host memory is bounded by row-group size + distinct values."""
+        import numpy as np
+
+        pf = pq.ParquetFile(self._source(),
+                            memory_map=self._path is not None)
+        acc = (np.asarray([]), np.asarray([], dtype=np.int64))
+        try:
+            for batch in pf.iter_batches(columns=[column]):
+                col = batch.column(0).to_numpy(zero_copy_only=False)
+                v, c = np.unique(col, return_counts=True)
+                acc = merge_value_counts([acc, (v, c)])
+        finally:
+            pf.close()
+        return acc
+
+
+async def open_sst_source(store: ObjectStore, path: str) -> SstSource:
+    local_path = getattr(store, "local_path", None)
+    if local_path is not None:
+        return SstSource(path=local_path(path))
+    return SstSource(data=await store.get(path))
+
+
 async def read_sst(store: ObjectStore, path: str,
                    columns: Optional[list[str]] = None,
                    filters=None) -> pa.Table:

@@ -180,10 +180,13 @@ class ParquetReader:
                 yield batch
 
     async def execute_segments(self, plan: ScanPlan):
-        """Like execute(), but yields (segment_start, batch_or_None) for
-        EVERY segment — callers that must retry after a concurrent
-        compaction (see CloudObjectStorage.scan) track completed segments
-        by start time."""
+        """Like execute(), but yields (segment_start, batch_or_None) —
+        callers that must retry after a concurrent compaction (see
+        CloudObjectStorage.scan) track completed segments by start time.
+        A segment may yield SEVERAL batches (one per merge window) so
+        large segments never re-materialize whole on the host, and ends
+        with an explicit (segment_start, None) completion marker — only
+        that marker makes the segment retry-safe to skip."""
         if plan.mode is not UpdateMode.OVERWRITE:
             # host (Append) path: uncached streaming merge
             async for seg, table, read_s in self._prefetch_tables(
@@ -194,23 +197,25 @@ class ParquetReader:
                 if batch is not None and batch.num_rows:
                     _ROWS_SCANNED.inc(batch.num_rows)
                     yield seg.segment_start, batch
-                else:
-                    yield seg.segment_start, None
+                yield seg.segment_start, None  # completion marker
             return
         async for seg, windows, read_s in self._cached_windows(plan):
-            t0 = time.perf_counter()
-            parts = []
-            for w in windows:
+            elapsed = 0.0  # decode work only — yields suspend into the
+            for w in windows:  # consumer and must not count as scan time
+                t0 = time.perf_counter()
                 part = self._window_to_arrow(w, list(seg.columns), plan)
+                if part is not None and part.num_rows \
+                        and not plan.keep_builtin:
+                    keep = [c for c in part.schema.names
+                            if not self.schema.is_builtin_name(c)]
+                    part = part.select(keep)
+                elapsed += time.perf_counter() - t0
                 if part is not None and part.num_rows:
-                    parts.append(part)
-            batch = self._combine_and_strip(parts, plan)
-            _SCAN_LATENCY.observe(read_s + (time.perf_counter() - t0))
-            if batch is not None and batch.num_rows:
-                _ROWS_SCANNED.inc(batch.num_rows)
-                yield seg.segment_start, batch
-            else:
-                yield seg.segment_start, None
+                    _ROWS_SCANNED.inc(part.num_rows)
+                    yield seg.segment_start, part
+            _SCAN_LATENCY.observe(read_s + elapsed)
+            # completion marker: consumers mark the segment done only now
+            yield seg.segment_start, None
 
     def _cache_key(self, seg: SegmentPlan, plan: ScanPlan):
         from horaedb_tpu.storage.scan_cache import segment_cache_key
@@ -255,6 +260,8 @@ class ParquetReader:
                 yield out
             return
 
+        streamed = {id(s) for s in to_read if self._stream_segment(s)}
+        to_read = [s for s in to_read if id(s) not in streamed]
         read_iter = self._prefetch_tables(to_read, plan).__aiter__()
         pending: "deque[tuple[SegmentPlan, list, float]]" = deque()
         exhausted = False
@@ -275,6 +282,17 @@ class ParquetReader:
         for seg in plan.segments:
             if id(seg) in cached:
                 yield seg, cached[id(seg)], 0.0
+                continue
+            if id(seg) in streamed:
+                t0 = time.perf_counter()
+                dispatched = []
+                async for batch in self._stream_window_batches(seg, plan):
+                    dispatched.extend(self._dispatch_merged_windows(batch))
+                windows = self._finalize_windows(dispatched)
+                if plan.use_cache:
+                    self.scan_cache.put(self._cache_key(seg, plan), windows,
+                                        sum(w.capacity for w in windows))
+                yield seg, windows, time.perf_counter() - t0
                 continue
             while len(pending) <= self._MERGE_LOOKAHEAD and not exhausted:
                 await pump()
@@ -298,7 +316,10 @@ class ParquetReader:
         from horaedb_tpu.parallel.scan import shard_leading_axis
 
         n_dev = self.mesh.devices.size
-        read_iter = self._prefetch_tables(to_read, plan).__aiter__()
+        streamed = {id(s) for s in to_read if self._stream_segment(s)}
+        read_iter = self._prefetch_tables(
+            [s for s in to_read if id(s) not in streamed],
+            plan).__aiter__()
         # buffer entries: [seg, windows(list, filled in round order),
         #                  outstanding window count, read_s]
         buffer: list[list] = []
@@ -343,9 +364,26 @@ class ParquetReader:
                     n_valid=int(runs_host[d]), capacity=cap))
                 entry[2] -= 1
 
+        def enqueue(entry: list, descs: list) -> None:
+            entry[2] += len(descs)
+            for cols, n_win, wcap, enc in descs:
+                pending.append((entry, cols, n_win, wcap, enc))
+            while len(pending) >= n_dev:
+                run_round(pending[:n_dev])
+                del pending[:n_dev]
+
         for seg in plan.segments:
             if id(seg) in cached:
                 buffer.append([seg, cached[id(seg)], 0, 0.0])
+            elif id(seg) in streamed:
+                # feed rounds window-by-window: at most a round's worth
+                # of un-merged host windows is ever resident
+                t0 = time.perf_counter()
+                entry = [seg, [], 0, 0.0]
+                buffer.append(entry)
+                async for batch in self._stream_window_batches(seg, plan):
+                    enqueue(entry, self._prepare_merge_windows(batch))
+                entry[3] = time.perf_counter() - t0
             else:
                 read_seg, table, read_s = await read_iter.__anext__()
                 assert read_seg is seg
@@ -353,13 +391,9 @@ class ParquetReader:
                 if table.num_rows:
                     batch = table.combine_chunks().to_batches()[0]
                     descs = self._prepare_merge_windows(batch)
-                entry = [seg, [], len(descs), read_s]
+                entry = [seg, [], 0, read_s]
                 buffer.append(entry)
-                for cols, n_win, wcap, enc in descs:
-                    pending.append((entry, cols, n_win, wcap, enc))
-                while len(pending) >= n_dev:
-                    run_round(pending[:n_dev])
-                    del pending[:n_dev]
+                enqueue(entry, descs)
             while buffer and buffer[0][2] == 0:
                 seg0, windows, _outstanding, read_s0 = buffer.pop(0)
                 if plan.use_cache and id(seg0) not in cached:
@@ -468,6 +502,70 @@ class ParquetReader:
         declared key order even when a projection reordered columns."""
         present = set(columns)
         return [n for n in self.schema.primary_key_names if n in present]
+
+    def _stream_segment(self, seg: SegmentPlan) -> bool:
+        """True when this segment should be read window-by-window instead
+        of fully materialized (manifest row count over the threshold)."""
+        threshold = self.config.scan.stream_read_min_rows
+        if threshold <= 0:
+            return False
+        return sum(f.meta.num_rows for f in seg.ssts) > max(
+            threshold, self.config.scan.max_window_rows)
+
+    async def _stream_window_batches(self, seg: SegmentPlan, plan: ScanPlan):
+        """Streamed segment read (the reference's pull-based batch
+        streaming, read.rs:346-385, re-shaped for device windows): pass 1
+        streams ONE PK column's row groups to plan value-range windows of
+        <= max_window_rows; pass 2 reads each window's rows via parquet
+        predicate pushdown.  Host materialization is bounded by the
+        window budget (plus file buffers on non-filesystem stores), not
+        the segment size.  Yields one Arrow batch per window, PK-range
+        ascending, each encoded WINDOW-LOCALLY downstream."""
+        import pyarrow.compute as pc
+
+        # one source per SST: local stores mmap, remote stores download
+        # the object ONCE and serve both passes and every window from it
+        sources = await asyncio.gather(*(
+            parquet_io.open_sst_source(self.store,
+                                       sst_path(self.root_path, f.id))
+            for f in seg.ssts))
+
+        pk_names = self._pk_names_in(seg.columns)
+        values = counts = None
+        part_col = pk_names[-1]
+        for nm in pk_names:
+            per_sst = await asyncio.gather(*(
+                asyncio.to_thread(src.value_counts, nm) for src in sources))
+            values, counts = parquet_io.merge_value_counts(per_sst)
+            if len(values) == 0:
+                return  # segment is empty
+            if len(values) > 1:
+                part_col = nm
+                break
+            # constant column: windowing on it cannot bound anything
+        window = self.config.scan.max_window_rows
+        ranges: list[tuple] = []
+        start = acc = 0
+        for i, c in enumerate(counts):
+            if acc and acc + int(c) > window:
+                ranges.append((values[start], values[i - 1]))
+                start, acc = i, 0
+            acc += int(c)
+        if acc:
+            ranges.append((values[start], values[-1]))
+        pyval = lambda x: x.item() if hasattr(x, "item") else x
+        for lo, hi in ranges:
+            expr = (pc.field(part_col) >= pyval(lo)) \
+                & (pc.field(part_col) <= pyval(hi))
+            if plan.pushdown is not None:
+                expr = expr & plan.pushdown
+            tables = await asyncio.gather(*(
+                asyncio.to_thread(src.read, columns=seg.columns,
+                                  filters=expr)
+                for src in sources))
+            tbl = pa.concat_tables(tables)
+            if tbl.num_rows:
+                yield tbl.combine_chunks().to_batches()[0]
 
     def _prepare_merge_windows(self, batch: pa.RecordBatch) -> list:
         """Host half of the merge: encode + PK-window planning + padding,

@@ -175,8 +175,12 @@ class CloudObjectStorage(TimeMergeStorage):
                              if s.segment_start not in done]
             try:
                 async for seg_start, batch in self.reader.execute_segments(plan):
-                    done.add(seg_start)
-                    if batch is not None:
+                    if batch is None:
+                        # explicit completion marker: only now is the
+                        # segment retry-safe to skip (it may have
+                        # spanned several window batches)
+                        done.add(seg_start)
+                    else:
                         yield batch
                 return
             except NotFoundError:
