@@ -402,25 +402,44 @@ class MetricEngine:
             ensure(chunk_window_ms <= segment_ms
                    and segment_ms % chunk_window_ms == 0,
                    "chunk window must evenly divide the segment duration")
+        from horaedb_tpu.common import runtimes as runtimes_mod
+
         tables = {}
         schemas = dict(_TABLE_SCHEMAS)
         if chunked_data:
             schemas["data"] = _CHUNKED_DATA_SCHEMA
-        for name, (schema, num_pks) in schemas.items():
-            cfg = config or StorageConfig()
-            if chunked_data and name == "data":
-                from horaedb_tpu.storage.config import UpdateMode
+        # one set of worker pools shared by all five tables — the
+        # reference's StorageRuntimes are likewise engine-wide
+        shared_runtimes = runtimes_mod.from_config(
+            (config or StorageConfig()).threads)
+        try:
+            for name, (schema, num_pks) in schemas.items():
+                cfg = config or StorageConfig()
+                if chunked_data and name == "data":
+                    from horaedb_tpu.storage.config import UpdateMode
 
-                cfg = dataclasses.replace(cfg, update_mode=UpdateMode.APPEND)
-            tables[name] = await CloudObjectStorage.open(
-                f"{root_path}/{name}", segment_ms, store, schema, num_pks,
-                cfg)
-        return cls(tables, segment_ms, chunked_data=chunked_data,
+                    cfg = dataclasses.replace(cfg,
+                                              update_mode=UpdateMode.APPEND)
+                tables[name] = await CloudObjectStorage.open(
+                    f"{root_path}/{name}", segment_ms, store, schema,
+                    num_pks, cfg, runtimes=shared_runtimes)
+        except BaseException:
+            # close whatever opened so a failed open leaks neither
+            # schedulers nor worker pools
+            for t in tables.values():
+                await t.close()
+            shared_runtimes.close()
+            raise
+        self = cls(tables, segment_ms, chunked_data=chunked_data,
                    chunk_window_ms=chunk_window_ms)
+        self._runtimes = shared_runtimes
+        return self
 
     async def close(self) -> None:
         for t in self.tables.values():
             await t.close()
+        if getattr(self, "_runtimes", None) is not None:
+            self._runtimes.close()
 
     # ---- write ------------------------------------------------------------
 

@@ -246,9 +246,11 @@ class Executor:
         # surviving rows retain their original sequences.
         # use_cache=False: the inputs are deleted right after, so caching
         # their merge would only evict hot query entries
+        # pool="compact": the rewrite's CPU work queues on the dedicated
+        # compaction pool, never in front of serving scans/writes
         plan = storage.reader.build_plan(
             task.inputs, ScanRequest(range=TimeRange.new(-(2**63), 2**63 - 1)),
-            keep_builtin=True, use_cache=False)
+            keep_builtin=True, use_cache=False, pool="compact")
 
         file_id = SstFile.allocate_id()
         path = sst_path(storage.root_path, file_id)
@@ -260,7 +262,8 @@ class Executor:
                 yield _restore_reserved_column(batch, storage.schema())
 
         data, num_rows = await parquet_io.encode_sst_stream(
-            restored(), storage.config.write, storage.schema())
+            restored(), storage.config.write, storage.schema(),
+            runtimes=storage.runtimes, pool="compact")
         await storage.store.put(path, data)
         size = len(data)
         meta = FileMeta(max_sequence=file_id, num_rows=num_rows, size=size,

@@ -89,6 +89,11 @@ class ManifestConfig:
     min_merge_threshold: int = 10
     hard_merge_threshold: int = 90
     soft_merge_threshold: int = 50
+    # how long a writer may throttle waiting for the background fold to
+    # drain below the soft threshold before proceeding toward the hard
+    # limit (no reference analogue: its merger runs on its own threads)
+    soft_merge_max_wait: ReadableDuration = field(
+        default_factory=lambda: ReadableDuration.from_secs(2))
 
 
 @dataclass
@@ -121,6 +126,16 @@ class ScanConfig:
 
 
 @dataclass
+class ThreadsConfig:
+    """Worker-pool sizes (ref: the server's threads config feeding
+    StorageRuntimes, src/server/src/main.rs:104-109)."""
+
+    sst_thread_num: int = 4
+    compact_thread_num: int = 2
+    manifest_thread_num: int = 1
+
+
+@dataclass
 class StorageConfig:
     """Top-level engine config (ref: config.rs:157-164)."""
 
@@ -128,6 +143,7 @@ class StorageConfig:
     manifest: ManifestConfig = field(default_factory=ManifestConfig)
     scheduler: SchedulerConfig = field(default_factory=SchedulerConfig)
     scan: ScanConfig = field(default_factory=ScanConfig)
+    threads: ThreadsConfig = field(default_factory=ThreadsConfig)
     update_mode: UpdateMode = UpdateMode.OVERWRITE
 
 
@@ -140,6 +156,7 @@ _NESTED = {
     "manifest": ManifestConfig,
     "scheduler": SchedulerConfig,
     "scan": ScanConfig,
+    "threads": ThreadsConfig,
 }
 
 

@@ -52,11 +52,12 @@ class _Merger:
     """Background delta→snapshot folder (ref: ManifestMerger, mod.rs:184-333)."""
 
     def __init__(self, snapshot_path: str, delta_dir: str, store: ObjectStore,
-                 config: ManifestConfig):
+                 config: ManifestConfig, runtimes=None):
         self.snapshot_path = snapshot_path
         self.delta_dir = delta_dir
         self.store = store
         self.config = config
+        self.runtimes = runtimes
         self.deltas_num = 0
         self._signal: asyncio.Queue[None] = asyncio.Queue(maxsize=config.channel_size)
         self._task: asyncio.Task | None = None
@@ -129,19 +130,34 @@ class _Merger:
             self.deltas_num = len(paths)
 
         delta_bufs = await asyncio.gather(*(self.store.get(p) for p in paths))
-        updates = [decode_manifest_update(buf) for buf in delta_bufs]
+        snapshot_buf = b""
+        try:
+            snapshot_buf = await self.store.get(self.snapshot_path)
+        except NotFoundError:
+            pass
 
-        snapshot = await _read_snapshot(self.store, self.snapshot_path)
-        # Deltas are unsorted, so add all new files first, then delete
-        # (ref: mod.rs:296-300).
-        to_deletes: list[FileId] = []
-        for update in updates:
-            snapshot.add_records(update.to_adds)
-            to_deletes.extend(update.to_deletes)
-        snapshot.delete_records(to_deletes)
+        def fold() -> bytes:
+            # pure CPU (protowire decode + snapshot codec) — runs on the
+            # manifest pool (ref: manifest_compact_runtime,
+            # storage.rs:91-104) so folds never block the event loop
+            updates = [decode_manifest_update(buf) for buf in delta_bufs]
+            snapshot = Snapshot.from_bytes(snapshot_buf)
+            # Deltas are unsorted, so add all new files first, then
+            # delete (ref: mod.rs:296-300).
+            to_deletes: list[FileId] = []
+            for update in updates:
+                snapshot.add_records(update.to_adds)
+                to_deletes.extend(update.to_deletes)
+            snapshot.delete_records(to_deletes)
+            return snapshot.into_bytes()
+
+        if self.runtimes is not None:
+            new_snapshot = await self.runtimes.run("manifest", fold)
+        else:
+            new_snapshot = await asyncio.to_thread(fold)
 
         # 1. Persist the snapshot, 2. then best-effort delete merged deltas.
-        await self.store.put(self.snapshot_path, snapshot.into_bytes())
+        await self.store.put(self.snapshot_path, new_snapshot)
         results = await asyncio.gather(
             *(self.store.delete(p) for p in paths), return_exceptions=True
         )
@@ -155,19 +171,23 @@ class _Merger:
 class Manifest:
     """SST metadata store (ref: Manifest, mod.rs:67-176)."""
 
-    def __init__(self, root_dir: str, store: ObjectStore, config: ManifestConfig):
+    def __init__(self, root_dir: str, store: ObjectStore,
+                 config: ManifestConfig, runtimes=None):
         base = root_dir.rstrip("/")
         self.snapshot_path = f"{base}/{PREFIX_PATH}/{SNAPSHOT_FILENAME}"
         self.delta_dir = f"{base}/{PREFIX_PATH}/{DELTA_PREFIX}"
         self.store = store
-        self._merger = _Merger(self.snapshot_path, self.delta_dir, store, config)
+        self._merger = _Merger(self.snapshot_path, self.delta_dir, store,
+                               config, runtimes=runtimes)
         self._ssts: list[SstFile] = []
         self._cache_lock = asyncio.Lock()
 
     @classmethod
     async def open(cls, root_dir: str, store: ObjectStore,
-                   config: ManifestConfig | None = None) -> "Manifest":
-        m = cls(root_dir, store, config or ManifestConfig())
+                   config: ManifestConfig | None = None,
+                   runtimes=None) -> "Manifest":
+        m = cls(root_dir, store, config or ManifestConfig(),
+                runtimes=runtimes)
         # Recovery: fold all deltas into the snapshot before serving.
         await m._merger.do_merge(first_run=True)
         snapshot = await _read_snapshot(store, m.snapshot_path)
@@ -185,13 +205,21 @@ class Manifest:
     async def update(self, update: ManifestUpdate) -> None:
         self._merger.maybe_schedule_merge()
         if self._merger.deltas_num > self._merger.config.soft_merge_threshold:
-            # Backpressure must actually let the merger run: with an
+            # Soft backpressure: THROTTLE the writer (bounded) until the
+            # background fold drains below the soft threshold.  With an
             # in-memory/local store no await in the write path truly
-            # suspends, so a tight writer loop would starve the merger
-            # task until the hard limit fails every write (the reference
-            # runs its merger on a separate tokio thread; a single
-            # asyncio loop needs an explicit yield).
-            await asyncio.sleep(0)
+            # suspends, so a tight writer loop would otherwise starve
+            # the merger until the hard limit failed every write (the
+            # reference runs its merger on separate tokio threads; a
+            # single asyncio loop needs an explicit pause).  The wait is
+            # bounded so a wedged store degrades to the hard-limit error
+            # instead of hanging writers.
+            deadline = (asyncio.get_running_loop().time()
+                        + self._merger.config.soft_merge_max_wait.seconds)
+            while (self._merger.deltas_num
+                   > self._merger.config.soft_merge_threshold
+                   and asyncio.get_running_loop().time() < deadline):
+                await asyncio.sleep(0.001)
         self._merger.deltas_num += 1
         try:
             await self._update_inner(update)

@@ -88,28 +88,57 @@ def encode_sst(batches: list[pa.RecordBatch], config: WriteConfig,
 
 
 async def encode_sst_stream(batches, config: WriteConfig,
-                            schema: StorageSchema) -> tuple[bytes, int]:
+                            schema: StorageSchema, runtimes=None,
+                            pool: str = "compact") -> tuple[bytes, int]:
     """Streaming twin of encode_sst over an async batch iterator: batches
     feed the parquet encoder as they arrive, so peak memory is the
-    compressed output.  Returns (bytes, num_rows)."""
+    compressed output.  Encoding runs on a worker pool batch by batch
+    (the writer is driven sequentially, never concurrently).
+    Returns (bytes, num_rows)."""
     sink = io.BytesIO()
     writer = pq.ParquetWriter(sink, schema.arrow_schema,
                               **writer_options(config, schema))
     num_rows = 0
+    finished = False
     try:
         async for batch in batches:
             num_rows += batch.num_rows
-            writer.write_batch(batch, row_group_size=config.max_row_group_size)
+            await _run(runtimes, pool, writer.write_batch, batch,
+                       row_group_size=config.max_row_group_size)
+
+        def finish() -> bytes:
+            # the close flushes the last row group + footer, and
+            # getvalue copies the whole SST — keep both off the loop
+            writer.close()
+            return sink.getvalue()
+
+        data = await _run(runtimes, pool, finish)
+        finished = True
+        return data, num_rows
     finally:
-        writer.close()
-    return sink.getvalue(), num_rows
+        if not finished:
+            writer.close()
+
+
+async def _run(runtimes, pool: str, fn, *args, **kwargs):
+    """Run CPU work on a named pool (common.runtimes), falling back to
+    asyncio's default thread pool when no runtimes were provided — the
+    event loop itself NEVER encodes/decodes parquet (ref: dedicated
+    runtimes, storage.rs:91-104)."""
+    import asyncio
+    import functools
+
+    if runtimes is not None:
+        return await runtimes.run(pool, fn, *args, **kwargs)
+    return await asyncio.to_thread(functools.partial(fn, *args, **kwargs))
 
 
 async def write_sst(store: ObjectStore, path: str,
                     batches: list[pa.RecordBatch], config: WriteConfig,
-                    schema: StorageSchema) -> int:
+                    schema: StorageSchema, runtimes=None,
+                    pool: str = "sst") -> int:
     """Encode + put; returns the file size in bytes."""
-    data = encode_sst(batches, config, schema)
+    data = await _run(runtimes, pool, encode_sst, batches, config, schema)
     await store.put(path, data)
     return len(data)
 
@@ -188,21 +217,19 @@ async def open_sst_source(store: ObjectStore, path: str) -> SstSource:
 
 async def read_sst(store: ObjectStore, path: str,
                    columns: Optional[list[str]] = None,
-                   filters=None) -> pa.Table:
+                   filters=None, runtimes=None,
+                   pool: str = "sst") -> pa.Table:
     """Read an SST, optionally a column subset and a pyarrow filter
     expression (row-group pruning via parquet statistics + row filtering
     — the reference's ParquetExec pruning predicate, read.rs:442-465).
 
     Local stores expose a filesystem path for mmap'd reads; other stores
-    go through a bytes buffer.
+    go through a bytes buffer.  Decode always runs on a worker pool.
     """
     local_path = getattr(store, "local_path", None)
     if local_path is not None:
-        import asyncio
-
-        return await asyncio.to_thread(
-            pq.read_table, local_path(path), columns=columns,
-            memory_map=True, filters=filters)
+        return await _run(runtimes, pool, pq.read_table, local_path(path),
+                          columns=columns, memory_map=True, filters=filters)
     data = await store.get(path)
-    return pq.read_table(pa.BufferReader(data), columns=columns,
-                         filters=filters)
+    return await _run(runtimes, pool, pq.read_table, pa.BufferReader(data),
+                      columns=columns, filters=filters)

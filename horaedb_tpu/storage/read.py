@@ -112,6 +112,10 @@ class ScanPlan:
     # compaction scans set this False: their input SST sets are deleted
     # right after, so caching them only evicts hot query entries
     use_cache: bool = True
+    # which worker pool (common.runtimes) carries this plan's CPU work —
+    # compaction plans use "compact" so rewrites queue behind each other
+    # instead of in front of serving scans (ref: storage.rs:91-104)
+    pool: str = "sst"
 
 
 class ParquetReader:
@@ -120,12 +124,13 @@ class ParquetReader:
 
     def __init__(self, store: ObjectStore, root_path: str,
                  schema: StorageSchema, config: StorageConfig,
-                 segment_duration_ms: int):
+                 segment_duration_ms: int, runtimes=None):
         self.store = store
         self.root_path = root_path
         self.schema = schema
         self.config = config
         self.segment_duration_ms = segment_duration_ms
+        self.runtimes = runtimes
         from horaedb_tpu.storage.scan_cache import ScanCache
 
         self.scan_cache = ScanCache(config.scan.cache_max_rows)
@@ -141,7 +146,7 @@ class ParquetReader:
 
     def build_plan(self, ssts: list[SstFile], request: ScanRequest,
                    keep_builtin: bool = False,
-                   use_cache: bool = True) -> ScanPlan:
+                   use_cache: bool = True, pool: str = "sst") -> ScanPlan:
         projections = self.schema.fill_required_projections(request.projections)
         if projections is None:
             columns = list(self.schema.arrow_schema.names)
@@ -170,7 +175,7 @@ class ParquetReader:
         return ScanPlan(segments=segments, mode=self.schema.update_mode,
                         predicate=request.predicate, keep_builtin=keep_builtin,
                         pushdown=pushdown, pushdown_key=pushdown_key,
-                        use_cache=use_cache)
+                        use_cache=use_cache, pool=pool)
 
     # ---- execution ---------------------------------------------------------
 
@@ -188,11 +193,35 @@ class ParquetReader:
         with an explicit (segment_start, None) completion marker — only
         that marker makes the segment retry-safe to skip."""
         if plan.mode is not UpdateMode.OVERWRITE:
-            # host (Append) path: uncached streaming merge
-            async for seg, table, read_s in self._prefetch_tables(
-                    plan.segments, plan):
+            # host (Append) path: uncached streaming merge.  Segments
+            # over the stream threshold merge window-by-window so the
+            # host bound holds for Append tables too (chunked-data
+            # tables are typically the largest).
+            streamed = {id(s) for s in plan.segments
+                        if self._stream_segment(s)}
+            bulk = [s for s in plan.segments if id(s) not in streamed]
+            read_iter = self._prefetch_tables(bulk, plan).__aiter__()
+            for seg in plan.segments:
+                if id(seg) in streamed:
+                    spent = 0.0
+                    async for batch in self._stream_window_batches(seg,
+                                                                   plan):
+                        t0 = time.perf_counter()
+                        part = await self._run_pool(
+                            plan.pool, self._merge_segment_table,
+                            pa.Table.from_batches([batch]), seg, plan)
+                        spent += time.perf_counter() - t0
+                        if part is not None and part.num_rows:
+                            _ROWS_SCANNED.inc(part.num_rows)
+                            yield seg.segment_start, part
+                    _SCAN_LATENCY.observe(spent)
+                    yield seg.segment_start, None  # completion marker
+                    continue
+                read_seg, table, read_s = await read_iter.__anext__()
+                assert read_seg is seg
                 t0 = time.perf_counter()
-                batch = self._merge_segment_table(table, seg, plan)
+                batch = await self._run_pool(
+                    plan.pool, self._merge_segment_table, table, seg, plan)
                 _SCAN_LATENCY.observe(read_s + (time.perf_counter() - t0))
                 if batch is not None and batch.num_rows:
                     _ROWS_SCANNED.inc(batch.num_rows)
@@ -203,12 +232,11 @@ class ParquetReader:
             elapsed = 0.0  # decode work only — yields suspend into the
             for w in windows:  # consumer and must not count as scan time
                 t0 = time.perf_counter()
-                part = self._window_to_arrow(w, list(seg.columns), plan)
-                if part is not None and part.num_rows \
-                        and not plan.keep_builtin:
-                    keep = [c for c in part.schema.names
-                            if not self.schema.is_builtin_name(c)]
-                    part = part.select(keep)
+                part = await self._run_pool(
+                    plan.pool, self._window_to_arrow, w, list(seg.columns),
+                    plan)
+                if part is not None and part.num_rows:
+                    part = self._strip_builtin(part, plan)
                 elapsed += time.perf_counter() - t0
                 if part is not None and part.num_rows:
                     _ROWS_SCANNED.inc(part.num_rows)
@@ -265,44 +293,66 @@ class ParquetReader:
         read_iter = self._prefetch_tables(to_read, plan).__aiter__()
         pending: "deque[tuple[SegmentPlan, list, float]]" = deque()
         exhausted = False
+        # prime the prefetch pipeline NOW: driving the generator's first
+        # step creates all its read tasks, so bulk segments' object-store
+        # reads overlap any streamed segment processed before them
+        primed: Optional[asyncio.Task] = (
+            asyncio.ensure_future(read_iter.__anext__()) if to_read
+            else None)
 
         async def pump() -> None:
-            nonlocal exhausted
+            nonlocal exhausted, primed
             try:
-                read_seg, table, read_s = await read_iter.__anext__()
+                if primed is not None:
+                    step, primed = primed, None
+                    read_seg, table, read_s = await step
+                else:
+                    read_seg, table, read_s = await read_iter.__anext__()
             except StopAsyncIteration:
                 exhausted = True
                 return
             dispatched: list = []
             if table.num_rows:
-                batch = table.combine_chunks().to_batches()[0]
-                dispatched = self._dispatch_merged_windows(batch)
+                def encode_and_dispatch(tbl=table):
+                    batch = tbl.combine_chunks().to_batches()[0]
+                    return self._dispatch_merged_windows(batch)
+
+                dispatched = await self._run_pool(plan.pool,
+                                                  encode_and_dispatch)
             pending.append((read_seg, dispatched, read_s))
 
-        for seg in plan.segments:
-            if id(seg) in cached:
-                yield seg, cached[id(seg)], 0.0
-                continue
-            if id(seg) in streamed:
-                t0 = time.perf_counter()
-                dispatched = []
-                async for batch in self._stream_window_batches(seg, plan):
-                    dispatched.extend(self._dispatch_merged_windows(batch))
-                windows = self._finalize_windows(dispatched)
+        try:
+            for seg in plan.segments:
+                if id(seg) in cached:
+                    yield seg, cached[id(seg)], 0.0
+                    continue
+                if id(seg) in streamed:
+                    t0 = time.perf_counter()
+                    dispatched = []
+                    async for batch in self._stream_window_batches(seg, plan):
+                        dispatched.extend(await self._run_pool(
+                            plan.pool, self._dispatch_merged_windows, batch))
+                    windows = await self._run_pool(
+                        plan.pool, self._finalize_windows, dispatched)
+                    if plan.use_cache:
+                        self.scan_cache.put(
+                            self._cache_key(seg, plan), windows,
+                            sum(w.capacity for w in windows))
+                    yield seg, windows, time.perf_counter() - t0
+                    continue
+                while len(pending) <= self._MERGE_LOOKAHEAD and not exhausted:
+                    await pump()
+                read_seg, dispatched, read_s = pending.popleft()
+                assert read_seg is seg
+                windows = await self._run_pool(
+                    plan.pool, self._finalize_windows, dispatched)
                 if plan.use_cache:
                     self.scan_cache.put(self._cache_key(seg, plan), windows,
                                         sum(w.capacity for w in windows))
-                yield seg, windows, time.perf_counter() - t0
-                continue
-            while len(pending) <= self._MERGE_LOOKAHEAD and not exhausted:
-                await pump()
-            read_seg, dispatched, read_s = pending.popleft()
-            assert read_seg is seg
-            windows = self._finalize_windows(dispatched)
-            if plan.use_cache:
-                self.scan_cache.put(self._cache_key(seg, plan), windows,
-                                    sum(w.capacity for w in windows))
-            yield seg, windows, read_s
+                yield seg, windows, read_s
+        finally:
+            if primed is not None:
+                primed.cancel()
 
     async def _cached_windows_mesh(self, plan: ScanPlan, cached: dict,
                                    to_read: list):
@@ -317,9 +367,18 @@ class ParquetReader:
 
         n_dev = self.mesh.devices.size
         streamed = {id(s) for s in to_read if self._stream_segment(s)}
-        read_iter = self._prefetch_tables(
-            [s for s in to_read if id(s) not in streamed],
-            plan).__aiter__()
+        bulk = [s for s in to_read if id(s) not in streamed]
+        read_iter = self._prefetch_tables(bulk, plan).__aiter__()
+        # prime the prefetch pipeline so bulk reads overlap streamed work
+        primed: Optional[asyncio.Task] = (
+            asyncio.ensure_future(read_iter.__anext__()) if bulk else None)
+
+        async def next_bulk():
+            nonlocal primed
+            if primed is not None:
+                step, primed = primed, None
+                return await step
+            return await read_iter.__anext__()
         # buffer entries: [seg, windows(list, filled in round order),
         #                  outstanding window count, read_s]
         buffer: list[list] = []
@@ -364,59 +423,68 @@ class ParquetReader:
                     n_valid=int(runs_host[d]), capacity=cap))
                 entry[2] -= 1
 
-        def enqueue(entry: list, descs: list) -> None:
+        async def enqueue(entry: list, descs: list) -> None:
             entry[2] += len(descs)
             for cols, n_win, wcap, enc in descs:
                 pending.append((entry, cols, n_win, wcap, enc))
             while len(pending) >= n_dev:
-                run_round(pending[:n_dev])
+                await self._run_pool(plan.pool, run_round, pending[:n_dev])
                 del pending[:n_dev]
 
-        for seg in plan.segments:
-            if id(seg) in cached:
-                buffer.append([seg, cached[id(seg)], 0, 0.0])
-            elif id(seg) in streamed:
-                # feed rounds window-by-window: at most a round's worth
-                # of un-merged host windows is ever resident
-                t0 = time.perf_counter()
-                entry = [seg, [], 0, 0.0]
-                buffer.append(entry)
-                async for batch in self._stream_window_batches(seg, plan):
-                    enqueue(entry, self._prepare_merge_windows(batch))
-                entry[3] = time.perf_counter() - t0
-            else:
-                read_seg, table, read_s = await read_iter.__anext__()
-                assert read_seg is seg
-                descs = []
-                if table.num_rows:
-                    batch = table.combine_chunks().to_batches()[0]
-                    descs = self._prepare_merge_windows(batch)
-                entry = [seg, [], 0, read_s]
-                buffer.append(entry)
-                enqueue(entry, descs)
-            while buffer and buffer[0][2] == 0:
-                seg0, windows, _outstanding, read_s0 = buffer.pop(0)
+        try:
+            for seg in plan.segments:
+                if id(seg) in cached:
+                    buffer.append([seg, cached[id(seg)], 0, 0.0])
+                elif id(seg) in streamed:
+                    # feed rounds window-by-window: at most a round's worth
+                    # of un-merged host windows is ever resident
+                    t0 = time.perf_counter()
+                    entry = [seg, [], 0, 0.0]
+                    buffer.append(entry)
+                    async for batch in self._stream_window_batches(seg, plan):
+                        await enqueue(entry, await self._run_pool(
+                            plan.pool, self._prepare_merge_windows, batch))
+                    entry[3] = time.perf_counter() - t0
+                else:
+                    read_seg, table, read_s = await next_bulk()
+                    assert read_seg is seg
+                    descs = []
+                    if table.num_rows:
+                        def encode_windows(tbl=table):
+                            batch = tbl.combine_chunks().to_batches()[0]
+                            return self._prepare_merge_windows(batch)
+
+                        descs = await self._run_pool(plan.pool, encode_windows)
+                    entry = [seg, [], 0, read_s]
+                    buffer.append(entry)
+                    await enqueue(entry, descs)
+                while buffer and buffer[0][2] == 0:
+                    seg0, windows, _outstanding, read_s0 = buffer.pop(0)
+                    if plan.use_cache and id(seg0) not in cached:
+                        self.scan_cache.put(self._cache_key(seg0, plan), windows,
+                                            sum(w.capacity for w in windows))
+                    yield seg0, windows, read_s0
+            if pending:
+                # tail round: pad with empty windows bound to a discard
+                # entry so real segments' window lists stay exact
+                discard = [None, [], len(pending) - n_dev, 0.0]
+                _e, cols0, _n, wcap0, enc0 = pending[-1]
+                tail = list(pending)
+                while len(tail) < n_dev:
+                    tail.append((discard, cols0, 0, wcap0, enc0))
+                await self._run_pool(plan.pool, run_round, tail)
+                pending.clear()
+            while buffer:
+                seg0, windows, outstanding, read_s0 = buffer.pop(0)
+                assert outstanding == 0
                 if plan.use_cache and id(seg0) not in cached:
                     self.scan_cache.put(self._cache_key(seg0, plan), windows,
                                         sum(w.capacity for w in windows))
                 yield seg0, windows, read_s0
-        if pending:
-            # tail round: pad with empty windows bound to a discard
-            # entry so real segments' window lists stay exact
-            discard = [None, [], len(pending) - n_dev, 0.0]
-            _e, cols0, _n, wcap0, enc0 = pending[-1]
-            tail = list(pending)
-            while len(tail) < n_dev:
-                tail.append((discard, cols0, 0, wcap0, enc0))
-            run_round(tail)
-            pending.clear()
-        while buffer:
-            seg0, windows, outstanding, read_s0 = buffer.pop(0)
-            assert outstanding == 0
-            if plan.use_cache and id(seg0) not in cached:
-                self.scan_cache.put(self._cache_key(seg0, plan), windows,
-                                    sum(w.capacity for w in windows))
-            yield seg0, windows, read_s0
+
+        finally:
+            if primed is not None:
+                primed.cancel()
 
     async def _prefetch_tables(self, segments: list[SegmentPlan],
                                plan: ScanPlan):
@@ -430,7 +498,8 @@ class ParquetReader:
         async def read(seg: SegmentPlan):
             await sem.acquire()
             t0 = time.perf_counter()
-            table = await self._read_segment_table(seg, plan.pushdown)
+            table = await self._read_segment_table(seg, plan.pushdown,
+                                                   pool=plan.pool)
             return table, time.perf_counter() - t0
 
         tasks = [asyncio.create_task(read(seg)) for seg in segments]
@@ -446,13 +515,32 @@ class ParquetReader:
                 task.cancel()
 
     async def _read_segment_table(self, seg: SegmentPlan,
-                                  pushdown=None) -> pa.Table:
+                                  pushdown=None,
+                                  pool: str = "sst") -> pa.Table:
         tables = await asyncio.gather(*(
             parquet_io.read_sst(self.store, sst_path(self.root_path, f.id),
-                                columns=seg.columns, filters=pushdown)
+                                columns=seg.columns, filters=pushdown,
+                                runtimes=self.runtimes, pool=pool)
             for f in seg.ssts
         ))
         return pa.concat_tables(tables)
+
+    async def _run_pool(self, pool: str, fn, *args, **kwargs):
+        """CPU work (parquet codec, host merge, numpy prep, device
+        dispatch/sync) runs on a named worker pool, never on the event
+        loop (ref: dedicated runtimes, storage.rs:91-104)."""
+        return await parquet_io._run(self.runtimes, pool, fn, *args,
+                                     **kwargs)
+
+    def _strip_builtin(self, batch: Optional[pa.RecordBatch],
+                       plan: ScanPlan) -> Optional[pa.RecordBatch]:
+        """Drop builtin columns unless the plan keeps them — the single
+        home for this rule across every row path."""
+        if batch is None or plan.keep_builtin:
+            return batch
+        keep = [c for c in batch.schema.names
+                if not self.schema.is_builtin_name(c)]
+        return batch.select(keep)
 
     def _combine_and_strip(self, parts: list[pa.RecordBatch],
                            plan: ScanPlan) -> Optional[pa.RecordBatch]:
@@ -462,11 +550,7 @@ class ParquetReader:
             return None
         batch = (parts[0] if len(parts) == 1 else
                  pa.Table.from_batches(parts).combine_chunks().to_batches()[0])
-        if not plan.keep_builtin:
-            keep = [c for c in batch.schema.names
-                    if not self.schema.is_builtin_name(c)]
-            batch = batch.select(keep)
-        return batch
+        return self._strip_builtin(batch, plan)
 
     def _merge_segment_table(self, table: pa.Table, seg: SegmentPlan,
                              plan: ScanPlan) -> Optional[pa.RecordBatch]:
@@ -478,12 +562,8 @@ class ParquetReader:
         batch = table.combine_chunks().to_batches()[0]
         window = self.config.scan.max_window_rows
         if batch.num_rows <= window:
-            merged = self._merge_on_host(batch, plan)
-            if not plan.keep_builtin and merged is not None:
-                keep = [c for c in merged.schema.names
-                        if not self.schema.is_builtin_name(c)]
-                merged = merged.select(keep)
-            return merged
+            return self._strip_builtin(self._merge_on_host(batch, plan),
+                                       plan)
         pk1 = batch.column(batch.schema.names.index(
             self._pk_names_in(batch.schema.names)[0]))
         # dense value-order ranks straight from Arrow (same comparator the
@@ -535,7 +615,8 @@ class ParquetReader:
         part_col = pk_names[-1]
         for nm in pk_names:
             per_sst = await asyncio.gather(*(
-                asyncio.to_thread(src.value_counts, nm) for src in sources))
+                self._run_pool(plan.pool, src.value_counts, nm)
+                for src in sources))
             values, counts = parquet_io.merge_value_counts(per_sst)
             if len(values) == 0:
                 return  # segment is empty
@@ -560,8 +641,8 @@ class ParquetReader:
             if plan.pushdown is not None:
                 expr = expr & plan.pushdown
             tables = await asyncio.gather(*(
-                asyncio.to_thread(src.read, columns=seg.columns,
-                                  filters=expr)
+                self._run_pool(plan.pool, src.read, columns=seg.columns,
+                               filters=expr)
                 for src in sources))
             tbl = pa.concat_tables(tables)
             if tbl.num_rows:
@@ -743,8 +824,10 @@ class ParquetReader:
         pending: dict[int, int] = {}
         arrived: "deque[int]" = deque()
 
-        def flush(k: int) -> None:
-            for seg_start, part in self._flush_window_batch(queue[:k], spec):
+        async def flush(k: int) -> None:
+            flushed = await self._run_pool(
+                plan.pool, self._flush_window_batch, queue[:k], spec)
+            for seg_start, part in flushed:
                 parts[seg_start].append(part)
                 pending[seg_start] -= 1
             del queue[:k]
@@ -755,21 +838,28 @@ class ParquetReader:
             arrived.append(s)
             parts[s] = []
             pending[s] = 0
-            for w in windows:
-                # same semantics as the row path: post-dedup rows
-                _ROWS_SCANNED.inc(w.n_valid)
-                prep = self._window_groups(w, spec, plan)
-                if prep is not None:
-                    queue.append((s, w, prep))
-                    pending[s] += 1
+
+            def prep_windows(ws=windows):
+                out = []
+                for w in ws:
+                    # same semantics as the row path: post-dedup rows
+                    _ROWS_SCANNED.inc(w.n_valid)
+                    prep = self._window_groups(w, spec, plan)
+                    if prep is not None:
+                        out.append((w, prep))
+                return out
+
+            for w, prep in await self._run_pool(plan.pool, prep_windows):
+                queue.append((s, w, prep))
+                pending[s] += 1
             while len(queue) >= batch_w:
-                flush(batch_w)
+                await flush(batch_w)
             _SCAN_LATENCY.observe(read_s + (time.perf_counter() - t0))
             while arrived and pending[arrived[0]] == 0:
                 s0 = arrived.popleft()
                 yield s0, parts.pop(s0)
         if queue:
-            flush(len(queue))
+            await flush(len(queue))
         while arrived:
             s0 = arrived.popleft()
             yield s0, parts.pop(s0)

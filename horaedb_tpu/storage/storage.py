@@ -84,7 +84,10 @@ class TimeMergeStorage(abc.ABC):
 class CloudObjectStorage(TimeMergeStorage):
     def __init__(self, root_path: str, segment_duration_ms: int,
                  store: ObjectStore, user_schema: pa.Schema,
-                 num_primary_keys: int, config: Optional[StorageConfig] = None):
+                 num_primary_keys: int, config: Optional[StorageConfig] = None,
+                 runtimes=None):
+        from horaedb_tpu.common import runtimes as runtimes_mod
+
         config = config or StorageConfig()
         self.root_path = root_path.rstrip("/")
         self.segment_duration_ms = segment_duration_ms
@@ -93,15 +96,21 @@ class CloudObjectStorage(TimeMergeStorage):
         self._schema = StorageSchema.try_new(user_schema, num_primary_keys,
                                              config.update_mode)
         self.manifest: Optional[Manifest] = None
+        # dedicated worker pools (ref: StorageRuntimes, storage.rs:91-104);
+        # shared when a parent (e.g. MetricEngine) passes its own
+        self._own_runtimes = runtimes is None
+        self.runtimes = runtimes or runtimes_mod.from_config(config.threads)
         self.reader = ParquetReader(store, self.root_path, self._schema,
-                                    config, segment_duration_ms)
+                                    config, segment_duration_ms,
+                                    runtimes=self.runtimes)
         self.compact_scheduler = None  # populated by open()
 
     @classmethod
     async def open(cls, *args, **kwargs) -> "CloudObjectStorage":
         self = cls(*args, **kwargs)
         self.manifest = await Manifest.open(self.root_path, self.store,
-                                            self.config.manifest)
+                                            self.config.manifest,
+                                            runtimes=self.runtimes)
         await self._start_compaction()
         return self
 
@@ -116,6 +125,8 @@ class CloudObjectStorage(TimeMergeStorage):
             await self.compact_scheduler.stop()
         if self.manifest is not None:
             await self.manifest.close()
+        if self._own_runtimes:
+            self.runtimes.close()
 
     # ------------------------------------------------------------------
 
@@ -149,11 +160,17 @@ class CloudObjectStorage(TimeMergeStorage):
     async def _write_batch(self, req: WriteRequest) -> WriteResult:
         t0 = time.perf_counter()
         file_id = SstFile.allocate_id()
-        sorted_batch = self._sort_batch(req.batch)
-        stamped = self._schema.fill_builtin_columns(sorted_batch, sequence=file_id)
+
+        def prep():  # sort + builtin stamping are CPU work — off the loop
+            sorted_batch = self._sort_batch(req.batch)
+            return self._schema.fill_builtin_columns(sorted_batch,
+                                                     sequence=file_id)
+
+        stamped = await self.runtimes.run("sst", prep)
         path = sst_path(self.root_path, file_id)
         size = await parquet_io.write_sst(self.store, path, [stamped],
-                                          self.config.write, self._schema)
+                                          self.config.write, self._schema,
+                                          runtimes=self.runtimes)
         meta = FileMeta(max_sequence=file_id, num_rows=req.batch.num_rows,
                         size=size, time_range=req.time_range)
         await self.manifest.add_file(file_id, meta)

@@ -158,9 +158,13 @@ def test_hard_threshold_rejects_write():
             soft_merge_threshold=2,
             hard_merge_threshold=4,
             min_merge_threshold=0,
+            soft_merge_max_wait=ReadableDuration.parse("1ms"),
         )
         m = await Manifest.open("root", store, cfg)
         try:
+            # a functioning merger would drain under the soft throttle
+            # and the hard gate would never fire; stop it to test the gate
+            await m._merger.stop()
             for i in range(5):
                 await m.add_file(i, meta(0, 10))
             with pytest.raises(Error, match="too many delta files"):

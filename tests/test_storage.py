@@ -546,6 +546,54 @@ class TestStreamedRead:
                           "max_window_rows": 1 << 20})
         assert streamed == bulk
 
+    def test_streamed_append_mode_equals_bulk(self):
+        """Append (host BytesMerge) tables stream too."""
+        import numpy as np
+
+        schema = pa.schema([pa.field("host", pa.string()),
+                            pa.field("ts", pa.int64()),
+                            pa.field("payload", pa.binary())])
+
+        def batches():
+            rng = np.random.default_rng(7)
+            out = []
+            for _ in range(4):
+                h = rng.integers(0, 40, 1500)
+                ts = rng.integers(0, SEGMENT_MS, 1500)
+                out.append(pa.record_batch(
+                    [pa.array([f"host_{int(i):02d}" for i in h]),
+                     pa.array(ts, type=pa.int64()),
+                     pa.array([b"%d" % v for v in
+                               rng.integers(0, 100, 1500)],
+                              type=pa.binary())],
+                    schema=schema))
+            return out
+
+        def run(scan_cfg):
+            async def go():
+                cfg = from_dict(StorageConfig, {"scan": scan_cfg})
+                cfg.update_mode = UpdateMode.APPEND
+                cfg.scheduler.schedule_interval = ReadableDuration.parse("1h")
+                s = await CloudObjectStorage.open(
+                    "db", SEGMENT_MS, MemoryObjectStore(), schema,
+                    num_primary_keys=2, config=cfg)
+                try:
+                    for b in batches():
+                        await s.write(WriteRequest(
+                            b, TimeRange.new(0, SEGMENT_MS)))
+                    got = rows_of(await collect(s.scan(
+                        ScanRequest(range=TimeRange.new(0, SEGMENT_MS)))))
+                    return sorted(got)
+                finally:
+                    await s.close()
+
+            return asyncio.run(go())
+
+        streamed = run({"stream_read_min_rows": 2000,
+                        "max_window_rows": 1024})
+        bulk = run({"stream_read_min_rows": 0, "max_window_rows": 1 << 20})
+        assert streamed == bulk and len(streamed) > 0
+
 
 class TestWindowedScan:
     """Bounded-HBM windowed execution must be semantically invisible."""
