@@ -41,11 +41,17 @@ DELTA_PREFIX = "delta"
 _DELTA_IDS = MonotonicIdAllocator()
 
 
-async def _read_snapshot(store: ObjectStore, path: str) -> Snapshot:
+async def _read_snapshot_bytes(store: ObjectStore, path: str) -> bytes:
+    """A missing snapshot reads as empty bytes (the single home for the
+    snapshot-missing rule)."""
     try:
-        return Snapshot.from_bytes(await store.get(path))
+        return await store.get(path)
     except NotFoundError:
-        return Snapshot()
+        return b""
+
+
+async def _read_snapshot(store: ObjectStore, path: str) -> Snapshot:
+    return Snapshot.from_bytes(await _read_snapshot_bytes(store, path))
 
 
 class _Merger:
@@ -130,11 +136,8 @@ class _Merger:
             self.deltas_num = len(paths)
 
         delta_bufs = await asyncio.gather(*(self.store.get(p) for p in paths))
-        snapshot_buf = b""
-        try:
-            snapshot_buf = await self.store.get(self.snapshot_path)
-        except NotFoundError:
-            pass
+        snapshot_buf = await _read_snapshot_bytes(self.store,
+                                                  self.snapshot_path)
 
         def fold() -> bytes:
             # pure CPU (protowire decode + snapshot codec) — runs on the

@@ -197,12 +197,9 @@ class ParquetReader:
             # over the stream threshold merge window-by-window so the
             # host bound holds for Append tables too (chunked-data
             # tables are typically the largest).
-            streamed = {id(s) for s in plan.segments
-                        if self._stream_segment(s)}
-            bulk = [s for s in plan.segments if id(s) not in streamed]
-            read_iter = self._prefetch_tables(bulk, plan).__aiter__()
-            for seg in plan.segments:
-                if id(seg) in streamed:
+            async for seg, is_streamed, table, read_s in \
+                    self._segment_feed(plan, plan.segments):
+                if is_streamed:
                     spent = 0.0
                     async for batch in self._stream_window_batches(seg,
                                                                    plan):
@@ -217,8 +214,6 @@ class ParquetReader:
                     _SCAN_LATENCY.observe(spent)
                     yield seg.segment_start, None  # completion marker
                     continue
-                read_seg, table, read_s = await read_iter.__anext__()
-                assert read_seg is seg
                 t0 = time.perf_counter()
                 batch = await self._run_pool(
                     plan.pool, self._merge_segment_table, table, seg, plan)
@@ -366,19 +361,7 @@ class ParquetReader:
         from horaedb_tpu.parallel.scan import shard_leading_axis
 
         n_dev = self.mesh.devices.size
-        streamed = {id(s) for s in to_read if self._stream_segment(s)}
-        bulk = [s for s in to_read if id(s) not in streamed]
-        read_iter = self._prefetch_tables(bulk, plan).__aiter__()
-        # prime the prefetch pipeline so bulk reads overlap streamed work
-        primed: Optional[asyncio.Task] = (
-            asyncio.ensure_future(read_iter.__anext__()) if bulk else None)
-
-        async def next_bulk():
-            nonlocal primed
-            if primed is not None:
-                step, primed = primed, None
-                return await step
-            return await read_iter.__anext__()
+        feed = self._segment_feed(plan, to_read).__aiter__()
         # buffer entries: [seg, windows(list, filled in round order),
         #                  outstanding window count, read_s]
         buffer: list[list] = []
@@ -431,13 +414,15 @@ class ParquetReader:
                 await self._run_pool(plan.pool, run_round, pending[:n_dev])
                 del pending[:n_dev]
 
-        try:
-            for seg in plan.segments:
-                if id(seg) in cached:
-                    buffer.append([seg, cached[id(seg)], 0, 0.0])
-                elif id(seg) in streamed:
-                    # feed rounds window-by-window: at most a round's worth
-                    # of un-merged host windows is ever resident
+        for seg in plan.segments:
+            if id(seg) in cached:
+                buffer.append([seg, cached[id(seg)], 0, 0.0])
+            else:
+                fseg, is_streamed, table, read_s = await feed.__anext__()
+                assert fseg is seg
+                if is_streamed:
+                    # feed rounds window-by-window: at most a round's
+                    # worth of un-merged host windows is ever resident
                     t0 = time.perf_counter()
                     entry = [seg, [], 0, 0.0]
                     buffer.append(entry)
@@ -446,42 +431,64 @@ class ParquetReader:
                             plan.pool, self._prepare_merge_windows, batch))
                     entry[3] = time.perf_counter() - t0
                 else:
-                    read_seg, table, read_s = await next_bulk()
-                    assert read_seg is seg
                     descs = []
                     if table.num_rows:
                         def encode_windows(tbl=table):
                             batch = tbl.combine_chunks().to_batches()[0]
                             return self._prepare_merge_windows(batch)
 
-                        descs = await self._run_pool(plan.pool, encode_windows)
+                        descs = await self._run_pool(plan.pool,
+                                                     encode_windows)
                     entry = [seg, [], 0, read_s]
                     buffer.append(entry)
                     await enqueue(entry, descs)
-                while buffer and buffer[0][2] == 0:
-                    seg0, windows, _outstanding, read_s0 = buffer.pop(0)
-                    if plan.use_cache and id(seg0) not in cached:
-                        self.scan_cache.put(self._cache_key(seg0, plan), windows,
-                                            sum(w.capacity for w in windows))
-                    yield seg0, windows, read_s0
-            if pending:
-                # tail round: pad with empty windows bound to a discard
-                # entry so real segments' window lists stay exact
-                discard = [None, [], len(pending) - n_dev, 0.0]
-                _e, cols0, _n, wcap0, enc0 = pending[-1]
-                tail = list(pending)
-                while len(tail) < n_dev:
-                    tail.append((discard, cols0, 0, wcap0, enc0))
-                await self._run_pool(plan.pool, run_round, tail)
-                pending.clear()
-            while buffer:
-                seg0, windows, outstanding, read_s0 = buffer.pop(0)
-                assert outstanding == 0
+            while buffer and buffer[0][2] == 0:
+                seg0, windows, _outstanding, read_s0 = buffer.pop(0)
                 if plan.use_cache and id(seg0) not in cached:
                     self.scan_cache.put(self._cache_key(seg0, plan), windows,
                                         sum(w.capacity for w in windows))
                 yield seg0, windows, read_s0
+        if pending:
+            # tail round: pad with empty windows bound to a discard
+            # entry so real segments' window lists stay exact
+            discard = [None, [], len(pending) - n_dev, 0.0]
+            _e, cols0, _n, wcap0, enc0 = pending[-1]
+            tail = list(pending)
+            while len(tail) < n_dev:
+                tail.append((discard, cols0, 0, wcap0, enc0))
+            await self._run_pool(plan.pool, run_round, tail)
+            pending.clear()
+        while buffer:
+            seg0, windows, outstanding, read_s0 = buffer.pop(0)
+            assert outstanding == 0
+            if plan.use_cache and id(seg0) not in cached:
+                self.scan_cache.put(self._cache_key(seg0, plan), windows,
+                                    sum(w.capacity for w in windows))
+            yield seg0, windows, read_s0
 
+    async def _segment_feed(self, plan: ScanPlan,
+                            segments: list[SegmentPlan]):
+        """Shared streamed/bulk split: yields (seg, is_streamed,
+        table_or_None, read_s) in segment order.  The bulk prefetch
+        pipeline is primed immediately so object-store reads overlap any
+        streamed segment processed before them."""
+        streamed = {id(s) for s in segments if self._stream_segment(s)}
+        bulk = [s for s in segments if id(s) not in streamed]
+        read_iter = self._prefetch_tables(bulk, plan).__aiter__()
+        primed: Optional[asyncio.Task] = (
+            asyncio.ensure_future(read_iter.__anext__()) if bulk else None)
+        try:
+            for seg in segments:
+                if id(seg) in streamed:
+                    yield seg, True, None, 0.0
+                    continue
+                if primed is not None:
+                    step, primed = primed, None
+                    read_seg, table, read_s = await step
+                else:
+                    read_seg, table, read_s = await read_iter.__anext__()
+                assert read_seg is seg
+                yield seg, False, table, read_s
         finally:
             if primed is not None:
                 primed.cancel()
@@ -883,8 +890,13 @@ class ParquetReader:
         memo_key = ("window_groups", spec.group_col, spec.ts_col,
                     spec.range_start,
                     filter_ops.canonical_predicate_key(plan.predicate))
-        if memo_key in out_batch.memo:
-            return out_batch.memo[memo_key]
+        # single atomic .get(): this now runs on worker-pool threads, so
+        # a check-then-read against a concurrent clear() could KeyError;
+        # duplicate computation on a lost race is benign (same result)
+        miss = object()
+        cached_val = out_batch.memo.get(memo_key, miss)
+        if cached_val is not miss:
+            return cached_val
         result = self._window_groups_uncached(out_batch, spec, plan)
         # small bound: each entry holds a capacity-sized gid array that the
         # scan cache's row budget does not account for

@@ -37,7 +37,7 @@ def batch(rows):
                            schema=schema())
 
 
-async def scan_rows(s, lo=0, hi=10**10):
+async def scan_rows(s, lo=0, hi=2**62):
     out = []
     async for b in s.scan(ScanRequest(range=TimeRange.new(lo, hi))):
         out.extend(zip(b.column(0).to_pylist(), b.column(1).to_pylist(),
@@ -100,6 +100,198 @@ def test_concurrent_writers_and_scanners():
             await s.close()
 
     asyncio.run(go())
+
+
+class StressModel:
+    """Ground truth the checkers compare scans against."""
+
+    def __init__(self):
+        self.value_seq: dict[float, int] = {}   # value -> seq (values unique)
+        self.acked: dict[tuple, tuple] = {}     # (k, ts) -> (seq, value)
+        self.errors: list[str] = []
+
+    def ack(self, rows, seq):
+        for k, ts, v in rows:
+            self.value_seq[v] = seq
+            cur = self.acked.get((k, ts))
+            # >=: duplicate (k,ts) within ONE batch shares a seq and the
+            # engine keeps the later row (stable sort), so must the model
+            if cur is None or seq >= cur[0]:
+                self.acked[(k, ts)] = (seq, v)
+
+    def fail(self, msg):
+        self.errors.append(msg)
+
+
+async def run_stress(seed: int, duration_s: float, mutate=None,
+                     recent_t0: int = None) -> StressModel:
+    """Randomized interleaving: writers + scanners + aggregate scans +
+    compaction + manifest merges + TTL GC, invariants checked on every
+    scan.  Deterministic op mix per seed (interleaving is scheduler-
+    driven).  Raises AssertionError on any invariant violation."""
+    import random
+
+    from horaedb_tpu.common.time_ext import now_ms
+    from horaedb_tpu.storage.read import AggregateSpec
+
+    rng = random.Random(seed)
+    now = now_ms()
+    recent_t0 = recent_t0 or (now // SEGMENT_MS) * SEGMENT_MS
+    expired_t0 = recent_t0 - 4 * SEGMENT_MS  # older than the 2h TTL
+    cfg = from_dict(StorageConfig, {
+        "manifest": {"merge_interval": "20ms", "min_merge_threshold": 0},
+        "scheduler": {"schedule_interval": "40ms", "input_sst_min_num": 2,
+                      "ttl": "2h"},
+        "scan": {"max_window_rows": 256},
+    })
+    s = await CloudObjectStorage.open("db", SEGMENT_MS, MemoryObjectStore(),
+                                      schema(), 2, cfg)
+    if mutate is not None:
+        mutate(s)
+    model = StressModel()
+    loop = asyncio.get_running_loop()
+    stop_at = loop.time() + duration_s
+    write_counter = [0]
+
+    async def writer(wid: int):
+        while loop.time() < stop_at:
+            n = rng.randint(1, 4)
+            old = rng.random() < 0.1  # some rows land in the TTL'd region
+            t0 = expired_t0 if old else recent_t0
+            rows = []
+            for _ in range(n):
+                write_counter[0] += 1
+                rows.append((f"k{rng.randint(0, 9)}",
+                             t0 + rng.randint(0, 999),
+                             float(write_counter[0])))
+            lo = min(r[1] for r in rows)
+            hi = max(r[1] for r in rows) + 1
+            try:
+                res = await s.write(WriteRequest(batch(rows),
+                                                 TimeRange.new(lo, hi)))
+                model.ack(rows, res.seq)
+            except Exception as e:
+                if "too many delta files" not in str(e):
+                    model.fail(f"write error: {e!r}")
+            await asyncio.sleep(rng.random() * 0.01)
+
+    async def scanner(sid: int):
+        while loop.time() < stop_at:
+            # snapshot BEFORE the scan: everything acked by now must be
+            # visible (or superseded by a higher sequence)
+            snap = dict(model.acked)
+            try:
+                rows = await scan_rows(s)
+            except Exception as e:
+                model.fail(f"scan error: {e!r}")
+                break
+            seen = {}
+            for k, ts, v in rows:
+                if (k, ts) in seen:
+                    model.fail(f"duplicate ({k},{ts}) in one scan")
+                seen[(k, ts)] = v
+            for (k, ts), (seq, _v) in snap.items():
+                if ts < recent_t0:
+                    continue  # TTL region: whole SSTs may vanish
+                got = seen.get((k, ts))
+                if got is None:
+                    model.fail(f"acked row ({k},{ts}) seq={seq} missing")
+                    continue
+                got_seq = model.value_seq.get(got)
+                if got_seq is not None and got_seq < seq:
+                    model.fail(
+                        f"stale value for ({k},{ts}): saw seq {got_seq} "
+                        f"but {seq} was acked before the scan")
+            await asyncio.sleep(rng.random() * 0.01)
+
+    async def aggregator():
+        spec_range = TimeRange.new(recent_t0, recent_t0 + 1000)
+        while loop.time() < stop_at:
+            snap_pairs = {p for p in model.acked if p[1] >= recent_t0}
+            try:
+                _groups, grids = await s.scan_aggregate(
+                    ScanRequest(range=spec_range),
+                    AggregateSpec(group_col="k", ts_col="ts", value_col="v",
+                                  range_start=recent_t0, bucket_ms=1000,
+                                  num_buckets=1))
+            except Exception as e:
+                model.fail(f"aggregate error: {e!r}")
+                break
+            count = int(grids["count"].sum()) if len(_groups) else 0
+            if count < len(snap_pairs):
+                model.fail(f"aggregate count {count} < acked distinct "
+                           f"rows {len(snap_pairs)}")
+            await asyncio.sleep(rng.random() * 0.02)
+
+    async def churner():
+        while loop.time() < stop_at:
+            op = rng.random()
+            if op < 0.5:
+                await s.compact()
+            else:
+                try:
+                    await s.manifest.trigger_merge()
+                except Exception as e:
+                    model.fail(f"manifest merge error: {e!r}")
+            await asyncio.sleep(rng.random() * 0.03)
+
+    try:
+        await asyncio.gather(writer(0), writer(1), writer(2),
+                             scanner(0), scanner(1), aggregator(),
+                             churner())
+        assert not model.errors, model.errors[:5]
+
+        # quiesce: force compaction + merge, then final state == model
+        for _ in range(3):
+            task = await s.compact_scheduler.picker.pick_candidate()
+            if task is None:
+                break
+            await s.compact_scheduler.executor.execute(task)
+        await s.manifest.trigger_merge()
+        final = {(k, ts): v for k, ts, v in await scan_rows(s)}
+        for (k, ts), (seq, v) in model.acked.items():
+            if ts < recent_t0:
+                continue
+            assert final.get((k, ts)) == v, \
+                f"final state wrong for ({k},{ts}): {final.get((k, ts))} != {v}"
+    finally:
+        await s.close()
+
+    # recovery: reopen from the same store and re-check the final state
+    s2 = await CloudObjectStorage.open("db", SEGMENT_MS, s.store, schema(),
+                                       2, cfg)
+    try:
+        reread = {(k, ts): v for k, ts, v in await scan_rows(s2)}
+        for (k, ts), (seq, v) in model.acked.items():
+            if ts >= recent_t0:
+                assert reread.get((k, ts)) == v, \
+                    f"recovery lost ({k},{ts})"
+    finally:
+        await s2.close()
+    return model
+
+
+def test_randomized_stress_seeds():
+    for seed in (1, 7):
+        model = asyncio.run(run_stress(seed, duration_s=2.5))
+        assert len(model.acked) > 30, "stress too idle to mean anything"
+
+
+def test_stress_detects_injected_stale_cache_race():
+    """Sensitivity check: break scan-cache identity (drop the SST-set
+    component, so compactions/writes no longer invalidate) and the
+    harness must catch the resulting stale reads."""
+    import pytest
+
+    def drop_sst_identity(s):
+        def bad_key(seg, plan):
+            return (seg.segment_start, tuple(seg.columns))
+
+        s.reader._cache_key = bad_key
+
+    with pytest.raises(AssertionError):
+        asyncio.run(run_stress(3, duration_s=2.5,
+                               mutate=drop_sst_identity))
 
 
 def test_interleaved_overwrites_converge_to_last_ack():
