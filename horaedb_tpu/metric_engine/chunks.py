@@ -5,15 +5,41 @@ upper layer itself; data is batched — e.g. 30 minutes compressed into
 one row", with the engine's Append/BytesMerge path concatenating chunk
 payloads for the same primary key across files.
 
-Codec (numpy-vectorized, little-endian):
+Two codecs (numpy-vectorized, little-endian); decode dispatches on the
+per-chunk magic, so mixed payloads from different builds concatenate
+fine:
+
+v1 (raw, magic 0xC7 — still decoded, no longer written):
 
     chunk := magic u8 | count u32 | ts_base i64 | ts_delta i32[count]
              | values f64[count]
 
-Deltas are relative to ts_base (chunk windows are minutes to hours, so
-int32 always fits); parquet's Snappy over the binary column compresses
-the delta'd timestamps well.  A BytesMerge'd payload is a SEQUENCE of
-chunks — decode_chunks walks them and concatenates.
+v2 (compressed, magic 0xC8 — the default):
+
+    chunk := magic u8 | count u32 | ts_base i64 | d1 i32
+             | dod_w u8 | vmode u8 | vp1 u8 | vp2 u8 | v0 f64
+             | dod i{dod_w}[count-2] | value body
+
+Timestamps store delta-of-delta (Gorilla's model) with a PER-CHUNK byte
+width: a regular scrape interval makes every dod zero, so dod_w = 0 and
+the whole timestamp column costs 16 bytes regardless of count.
+
+Values pick the smaller of two bodies per chunk:
+  vmode 0 (XOR, vp1=shift vp2=width): XOR of consecutive f64 bit
+    patterns (Gorilla), shifted by the chunk-wide common trailing zero
+    bytes and truncated to the significant byte width —
+    u{vp2}[count-1].
+  vmode 1 (scaled-int delta, vp1=decimal exponent vp2=width): when
+    every value is exactly v = k / 10^e for integer k, consecutive
+    differences of k stored as i{vp2}[count-1].  Metrics are
+    overwhelmingly integers or few-decimal gauges, whose low mantissa
+    bits defeat XOR codecs; their scaled deltas fit 1-2 bytes.
+
+Byte-granular per-chunk widths keep encode/decode as pure numpy array
+ops — bit-granular Gorilla packing would force a per-value Python
+loop, the opposite of this engine's design — while beating raw f64 by
+>= 3x on realistic data (regular timestamps ~free, integer/decimal
+gauges 1-2 bytes per value).
 
 Duplicate policy: chunks arrive in sequence order (BytesMerge
 concatenates in (pk, __seq__) order), so for equal timestamps the LAST
@@ -28,29 +54,199 @@ import numpy as np
 
 from horaedb_tpu.common.error import Error, ensure
 
-_MAGIC = 0xC7
-_HEADER = struct.Struct("<BIq")  # magic u8 | count u32 | ts_base i64
+_MAGIC_V1 = 0xC7
+_HEADER_V1 = struct.Struct("<BIq")  # magic u8 | count u32 | ts_base i64
+_MAGIC_V2 = 0xC8
+# magic u8 | count u32 | ts_base i64 | d1 i32 | dod_w u8 | vmode u8
+# | vp1 u8 | vp2 u8 | v0 f64
+_HEADER_V2 = struct.Struct("<BIqiBBBBd")
+
+_INT_DTYPES = {1: np.int8, 2: np.int16, 4: np.int32, 8: np.int64}
+_VMODE_XOR = 0
+_VMODE_SCALED = 1
+
+
+def _int_width(m: int) -> int:
+    """Smallest signed byte width holding |values| <= m."""
+    return 1 if m < 2**7 else 2 if m < 2**15 else 4 if m < 2**31 else 8
+
+
+def _scaled_int_body(values: np.ndarray):
+    """(exponent, width, bytes) when every value is exactly k/10^e for
+    int k with |k| < 2^53, else None."""
+    for e in (0, 1, 2, 3, 4):
+        scaled = values * (10.0 ** e)
+        k = np.round(scaled)
+        if np.abs(k).max(initial=0) >= 2**53:
+            return None
+        if not (k / (10.0 ** e) == values).all():
+            continue
+        deltas = np.diff(k.astype(np.int64))
+        if not len(deltas):
+            return e, 0, b""
+        if not deltas.any():
+            return e, 0, b""
+        w = _int_width(int(np.abs(deltas).max()))
+        return e, w, deltas.astype(_INT_DTYPES[w]).tobytes()
+    return None
+
+
+def _pack_low_bytes(x: np.ndarray, width: int) -> bytes:
+    """Low `width` bytes of each uint64 (little-endian)."""
+    if width == 0 or not len(x):
+        return b""
+    return np.ascontiguousarray(x, dtype="<u8").view(np.uint8) \
+        .reshape(-1, 8)[:, :width].tobytes()
+
+
+def _unpack_low_bytes(buf: bytes, count: int, width: int) -> np.ndarray:
+    if width == 0 or count == 0:
+        return np.zeros(count, dtype=np.uint64)
+    raw = np.frombuffer(buf, dtype=np.uint8, count=count * width)
+    out = np.zeros((count, 8), dtype=np.uint8)
+    out[:, :width] = raw.reshape(count, width)
+    return out.reshape(-1).view("<u8").astype(np.uint64)
 
 
 def encode_chunk(ts: np.ndarray, values: np.ndarray) -> bytes:
-    """Encode one chunk; ts int64 ms (any order, will be sorted),
+    """Encode one chunk (v2); ts int64 ms (any order, will be sorted),
     values float64 aligned with ts."""
     ensure(len(ts) == len(values), "ts/values length mismatch")
     ensure(len(ts) > 0, "empty chunk")
     order = np.argsort(ts, kind="stable")
     ts = np.asarray(ts, dtype=np.int64)[order]
     values = np.asarray(values, dtype=np.float64)[order]
+    count = len(ts)
     base = int(ts[0])
-    deltas = ts - base
-    ensure(int(deltas.max()) < 2**31, "chunk time span exceeds int32 deltas")
-    return (_HEADER.pack(_MAGIC, len(ts), base)
-            + deltas.astype(np.int32).tobytes()
-            + values.tobytes())
+    ensure(int(ts[-1]) - base < 2**31, "chunk time span exceeds int32 deltas")
+
+    # timestamps: delta-of-delta with per-chunk byte width
+    deltas = np.diff(ts)
+    d1 = int(deltas[0]) if count > 1 else 0
+    dod = np.diff(deltas)  # (count-2,)
+    dod_w = 0
+    if len(dod) and (dod != 0).any():
+        dod_w = _int_width(int(np.abs(dod).max()))
+        if dod_w == 8:
+            raise Error("chunk interval jump exceeds int32")
+    dod_bytes = (dod.astype(_INT_DTYPES[dod_w]).tobytes() if dod_w else b"")
+
+    # value mode 0: consecutive XOR, shifted by common trailing-zero
+    # bytes, truncated to the significant byte width
+    bits = values.view(np.uint64)
+    xor = bits[1:] ^ bits[:-1]  # (count-1,)
+    xor_shift = 0
+    xor_w = 0
+    nz = xor[xor != 0]
+    if len(nz):
+        # trailing/leading zero BYTES common to every non-zero xor
+        as_bytes = np.ascontiguousarray(nz, dtype="<u8").view(np.uint8) \
+            .reshape(-1, 8)
+        nonzero_col = (as_bytes != 0).any(axis=0)
+        cols = np.flatnonzero(nonzero_col)
+        xor_shift = int(cols[0])
+        xor_w = int(cols[-1]) - xor_shift + 1
+
+    # value mode 1: exact decimal-scaled integer deltas; pick whichever
+    # body is smaller
+    scaled = _scaled_int_body(values)
+    if scaled is not None and scaled[1] < xor_w:
+        e, w, body = scaled
+        vmode, vp1, vp2 = _VMODE_SCALED, e, w
+    else:
+        vmode, vp1, vp2 = _VMODE_XOR, xor_shift, xor_w
+        body = _pack_low_bytes(xor >> np.uint64(8 * xor_shift), xor_w)
+
+    return (_HEADER_V2.pack(_MAGIC_V2, count, base, d1, dod_w, vmode,
+                            vp1, vp2, float(values[0]))
+            + dod_bytes + body)
+
+
+def _decode_v1(payload: bytes, off: int, n: int):
+    _magic, count, base = _HEADER_V1.unpack_from(payload, off)
+    off += _HEADER_V1.size
+    if off + count * 12 > n:
+        raise Error("truncated chunk body")
+    deltas = np.frombuffer(payload, dtype="<i4", count=count, offset=off)
+    off += count * 4
+    vals = np.frombuffer(payload, dtype="<f8", count=count, offset=off)
+    off += count * 8
+    return base + deltas.astype(np.int64), np.asarray(vals), off
+
+
+_MAX_CHUNK_POINTS = 1 << 27  # sanity bound; windows are minutes-hours
+
+
+def _decode_v2(payload: bytes, off: int, n: int):
+    if off + _HEADER_V2.size > n:
+        raise Error("truncated chunk header")
+    (_magic, count, base, d1, dod_w, vmode, vp1, vp2,
+     v0) = _HEADER_V2.unpack_from(payload, off)
+    off += _HEADER_V2.size
+    # header validation: zero-width bodies legitimately carry no
+    # per-point bytes (constant series at a regular interval), so a
+    # corrupt count cannot be caught by body length — bound it, and
+    # reject field values the encoder can never produce
+    ensure(1 <= count <= _MAX_CHUNK_POINTS,
+           f"implausible chunk point count {count}")
+    ensure(dod_w in (0, 1, 2, 4), f"bad chunk dod width {dod_w}")
+    if vmode == _VMODE_SCALED:
+        ensure(vp1 <= 4 and vp2 in (0, 1, 2, 4, 8),
+               f"bad scaled-int params e={vp1} w={vp2}")
+    else:
+        ensure(vp1 <= 7 and vp2 <= 8 and vp1 + vp2 <= 8,
+               f"bad xor params shift={vp1} w={vp2}")
+    n_dod = max(0, count - 2)
+    n_val = max(0, count - 1)
+    if off + n_dod * dod_w + n_val * vp2 > n:
+        raise Error("truncated chunk body")
+    if dod_w:
+        dod = np.frombuffer(payload, dtype=_INT_DTYPES[dod_w], count=n_dod,
+                            offset=off).astype(np.int64)
+        off += n_dod * dod_w
+    else:
+        dod = np.zeros(n_dod, dtype=np.int64)
+
+    ts = np.empty(count, dtype=np.int64)
+    ts[0] = base
+    if count > 1:
+        deltas = np.empty(count - 1, dtype=np.int64)
+        deltas[0] = d1
+        if count > 2:
+            deltas[1:] = d1 + np.cumsum(dod)
+        ts[1:] = base + np.cumsum(deltas)
+
+    if vmode == _VMODE_SCALED:
+        if vp2:
+            vdeltas = np.frombuffer(payload, dtype=_INT_DTYPES[vp2],
+                                    count=n_val, offset=off).astype(np.int64)
+            off += n_val * vp2
+        else:
+            vdeltas = np.zeros(n_val, dtype=np.int64)
+        scale = 10.0 ** vp1
+        k0 = int(np.round(v0 * scale))  # same rounding as the encoder
+        ks = np.empty(count, dtype=np.int64)
+        ks[0] = k0
+        if count > 1:
+            ks[1:] = k0 + np.cumsum(vdeltas)
+        return ts, ks.astype(np.float64) / scale, off
+    if vmode != _VMODE_XOR:
+        raise Error(f"unknown chunk value mode {vmode}")
+    xor = _unpack_low_bytes(payload[off:], n_val, vp2) \
+        << np.uint64(8 * vp1)
+    off += n_val * vp2
+    bits = np.empty(count, dtype=np.uint64)
+    bits[0] = np.array([v0], dtype="<f8").view("<u8")[0]
+    if count > 1:
+        bits[1:] = np.bitwise_xor.accumulate(
+            np.concatenate([bits[:1], xor]))[1:]
+    return ts, bits.view(np.float64), off
 
 
 def decode_chunks(payload: bytes) -> tuple[np.ndarray, np.ndarray]:
-    """Decode a (possibly concatenated) chunk payload into
-    (ts int64, values float64), sorted by ts with last-wins dedup."""
+    """Decode a (possibly concatenated, possibly mixed-version) chunk
+    payload into (ts int64, values float64), sorted by ts with
+    last-wins dedup."""
     if not payload:
         return (np.empty(0, dtype=np.int64), np.empty(0, dtype=np.float64))
     all_ts: list[np.ndarray] = []
@@ -58,20 +254,18 @@ def decode_chunks(payload: bytes) -> tuple[np.ndarray, np.ndarray]:
     off = 0
     n = len(payload)
     while off < n:
-        if off + _HEADER.size > n:
+        if off + 1 > n:
             raise Error("truncated chunk header")
-        magic, count, base = _HEADER.unpack_from(payload, off)
-        if magic != _MAGIC:
+        magic = payload[off]
+        if magic == _MAGIC_V1:
+            if off + _HEADER_V1.size > n:
+                raise Error("truncated chunk header")
+            ts, vals, off = _decode_v1(payload, off, n)
+        elif magic == _MAGIC_V2:
+            ts, vals, off = _decode_v2(payload, off, n)
+        else:
             raise Error(f"bad chunk magic 0x{magic:02x} at offset {off}")
-        off += _HEADER.size
-        need = count * (4 + 8)
-        if off + need > n:
-            raise Error("truncated chunk body")
-        deltas = np.frombuffer(payload, dtype="<i4", count=count, offset=off)
-        off += count * 4
-        vals = np.frombuffer(payload, dtype="<f8", count=count, offset=off)
-        off += count * 8
-        all_ts.append(base + deltas.astype(np.int64))
+        all_ts.append(ts)
         all_vals.append(vals)
     ts = np.concatenate(all_ts)
     vals = np.concatenate(all_vals)

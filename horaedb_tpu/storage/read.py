@@ -197,48 +197,67 @@ class ParquetReader:
             # over the stream threshold merge window-by-window so the
             # host bound holds for Append tables too (chunked-data
             # tables are typically the largest).
-            async for seg, is_streamed, table, read_s in \
-                    self._segment_feed(plan, plan.segments):
-                if is_streamed:
-                    spent = 0.0
-                    async for batch in self._stream_window_batches(seg,
-                                                                   plan):
-                        t0 = time.perf_counter()
-                        part = await self._run_pool(
-                            plan.pool, self._merge_segment_table,
-                            pa.Table.from_batches([batch]), seg, plan)
-                        spent += time.perf_counter() - t0
-                        if part is not None and part.num_rows:
-                            _ROWS_SCANNED.inc(part.num_rows)
-                            yield seg.segment_start, part
-                    _SCAN_LATENCY.observe(spent)
-                    yield seg.segment_start, None  # completion marker
-                    continue
-                t0 = time.perf_counter()
-                batch = await self._run_pool(
-                    plan.pool, self._merge_segment_table, table, seg, plan)
-                _SCAN_LATENCY.observe(read_s + (time.perf_counter() - t0))
-                if batch is not None and batch.num_rows:
-                    _ROWS_SCANNED.inc(batch.num_rows)
-                    yield seg.segment_start, batch
-                yield seg.segment_start, None  # completion marker
+            # aclose the feed DETERMINISTICALLY on any consumer
+            # exception/abandonment — otherwise its primed prefetch task
+            # only dies at GC time, possibly after the caller has
+            # already replanned and started a new scan
+            feed = self._segment_feed(plan, plan.segments)
+            try:
+                async for seg, is_streamed, table, read_s in feed:
+                    async for out in self._append_segment(
+                            seg, is_streamed, table, read_s, plan):
+                        yield out
+            finally:
+                await feed.aclose()
             return
-        async for seg, windows, read_s in self._cached_windows(plan):
-            elapsed = 0.0  # decode work only — yields suspend into the
-            for w in windows:  # consumer and must not count as scan time
+
+        windows_iter = self._cached_windows(plan)
+        try:
+            async for seg, windows, read_s in windows_iter:
+                elapsed = 0.0  # decode work only — yields suspend into
+                for w in windows:  # the consumer, not scan time
+                    t0 = time.perf_counter()
+                    part = await self._run_pool(
+                        plan.pool, self._window_to_arrow, w,
+                        list(seg.columns), plan)
+                    if part is not None and part.num_rows:
+                        part = self._strip_builtin(part, plan)
+                    elapsed += time.perf_counter() - t0
+                    if part is not None and part.num_rows:
+                        _ROWS_SCANNED.inc(part.num_rows)
+                        yield seg.segment_start, part
+                _SCAN_LATENCY.observe(read_s + elapsed)
+                # completion marker: consumers mark the segment done now
+                yield seg.segment_start, None
+        finally:
+            await windows_iter.aclose()
+
+    async def _append_segment(self, seg, is_streamed: bool, table,
+                              read_s: float, plan: ScanPlan):
+        """One Append-mode segment's host merge, streamed or bulk.
+        Yields (segment_start, batch) parts then the completion marker."""
+        if is_streamed:
+            spent = 0.0
+            async for batch in self._stream_window_batches(seg, plan):
                 t0 = time.perf_counter()
                 part = await self._run_pool(
-                    plan.pool, self._window_to_arrow, w, list(seg.columns),
-                    plan)
-                if part is not None and part.num_rows:
-                    part = self._strip_builtin(part, plan)
-                elapsed += time.perf_counter() - t0
+                    plan.pool, self._merge_segment_table,
+                    pa.Table.from_batches([batch]), seg, plan)
+                spent += time.perf_counter() - t0
                 if part is not None and part.num_rows:
                     _ROWS_SCANNED.inc(part.num_rows)
                     yield seg.segment_start, part
-            _SCAN_LATENCY.observe(read_s + elapsed)
-            # completion marker: consumers mark the segment done only now
-            yield seg.segment_start, None
+            _SCAN_LATENCY.observe(spent)
+            yield seg.segment_start, None  # completion marker
+            return
+        t0 = time.perf_counter()
+        batch = await self._run_pool(
+            plan.pool, self._merge_segment_table, table, seg, plan)
+        _SCAN_LATENCY.observe(read_s + (time.perf_counter() - t0))
+        if batch is not None and batch.num_rows:
+            _ROWS_SCANNED.inc(batch.num_rows)
+            yield seg.segment_start, batch
+        yield seg.segment_start, None  # completion marker
 
     def _cache_key(self, seg: SegmentPlan, plan: ScanPlan):
         from horaedb_tpu.storage.scan_cache import segment_cache_key
@@ -279,8 +298,12 @@ class ParquetReader:
             else:
                 cached[id(seg)] = windows
         if self.mesh is not None:
-            async for out in self._cached_windows_mesh(plan, cached, to_read):
-                yield out
+            mesh_iter = self._cached_windows_mesh(plan, cached, to_read)
+            try:
+                async for out in mesh_iter:
+                    yield out
+            finally:
+                await mesh_iter.aclose()
             return
 
         streamed = {id(s) for s in to_read if self._stream_segment(s)}
@@ -414,57 +437,62 @@ class ParquetReader:
                 await self._run_pool(plan.pool, run_round, pending[:n_dev])
                 del pending[:n_dev]
 
-        for seg in plan.segments:
-            if id(seg) in cached:
-                buffer.append([seg, cached[id(seg)], 0, 0.0])
-            else:
-                fseg, is_streamed, table, read_s = await feed.__anext__()
-                assert fseg is seg
-                if is_streamed:
-                    # feed rounds window-by-window: at most a round's
-                    # worth of un-merged host windows is ever resident
-                    t0 = time.perf_counter()
-                    entry = [seg, [], 0, 0.0]
-                    buffer.append(entry)
-                    async for batch in self._stream_window_batches(seg, plan):
-                        await enqueue(entry, await self._run_pool(
-                            plan.pool, self._prepare_merge_windows, batch))
-                    entry[3] = time.perf_counter() - t0
+        try:
+            for seg in plan.segments:
+                if id(seg) in cached:
+                    buffer.append([seg, cached[id(seg)], 0, 0.0])
                 else:
-                    descs = []
-                    if table.num_rows:
-                        def encode_windows(tbl=table):
-                            batch = tbl.combine_chunks().to_batches()[0]
-                            return self._prepare_merge_windows(batch)
+                    fseg, is_streamed, table, read_s = await feed.__anext__()
+                    assert fseg is seg
+                    if is_streamed:
+                        # feed rounds window-by-window: at most a round's
+                        # worth of un-merged host windows is ever resident
+                        t0 = time.perf_counter()
+                        entry = [seg, [], 0, 0.0]
+                        buffer.append(entry)
+                        async for batch in self._stream_window_batches(seg, plan):
+                            await enqueue(entry, await self._run_pool(
+                                plan.pool, self._prepare_merge_windows, batch))
+                        entry[3] = time.perf_counter() - t0
+                    else:
+                        descs = []
+                        if table.num_rows:
+                            def encode_windows(tbl=table):
+                                batch = tbl.combine_chunks().to_batches()[0]
+                                return self._prepare_merge_windows(batch)
 
-                        descs = await self._run_pool(plan.pool,
-                                                     encode_windows)
-                    entry = [seg, [], 0, read_s]
-                    buffer.append(entry)
-                    await enqueue(entry, descs)
-            while buffer and buffer[0][2] == 0:
-                seg0, windows, _outstanding, read_s0 = buffer.pop(0)
+                            descs = await self._run_pool(plan.pool,
+                                                         encode_windows)
+                        entry = [seg, [], 0, read_s]
+                        buffer.append(entry)
+                        await enqueue(entry, descs)
+                while buffer and buffer[0][2] == 0:
+                    seg0, windows, _outstanding, read_s0 = buffer.pop(0)
+                    if plan.use_cache and id(seg0) not in cached:
+                        self.scan_cache.put(self._cache_key(seg0, plan), windows,
+                                            sum(w.capacity for w in windows))
+                    yield seg0, windows, read_s0
+            if pending:
+                # tail round: pad with empty windows bound to a discard
+                # entry so real segments' window lists stay exact
+                discard = [None, [], len(pending) - n_dev, 0.0]
+                _e, cols0, _n, wcap0, enc0 = pending[-1]
+                tail = list(pending)
+                while len(tail) < n_dev:
+                    tail.append((discard, cols0, 0, wcap0, enc0))
+                await self._run_pool(plan.pool, run_round, tail)
+                pending.clear()
+            while buffer:
+                seg0, windows, outstanding, read_s0 = buffer.pop(0)
+                assert outstanding == 0
                 if plan.use_cache and id(seg0) not in cached:
                     self.scan_cache.put(self._cache_key(seg0, plan), windows,
                                         sum(w.capacity for w in windows))
                 yield seg0, windows, read_s0
-        if pending:
-            # tail round: pad with empty windows bound to a discard
-            # entry so real segments' window lists stay exact
-            discard = [None, [], len(pending) - n_dev, 0.0]
-            _e, cols0, _n, wcap0, enc0 = pending[-1]
-            tail = list(pending)
-            while len(tail) < n_dev:
-                tail.append((discard, cols0, 0, wcap0, enc0))
-            await self._run_pool(plan.pool, run_round, tail)
-            pending.clear()
-        while buffer:
-            seg0, windows, outstanding, read_s0 = buffer.pop(0)
-            assert outstanding == 0
-            if plan.use_cache and id(seg0) not in cached:
-                self.scan_cache.put(self._cache_key(seg0, plan), windows,
-                                    sum(w.capacity for w in windows))
-            yield seg0, windows, read_s0
+
+        finally:
+            # deterministic cleanup of the feed's primed prefetch task
+            await feed.aclose()
 
     async def _segment_feed(self, plan: ScanPlan,
                             segments: list[SegmentPlan]):
@@ -839,32 +867,36 @@ class ParquetReader:
                 pending[seg_start] -= 1
             del queue[:k]
 
-        async for seg, windows, read_s in self._cached_windows(plan):
-            t0 = time.perf_counter()
-            s = seg.segment_start
-            arrived.append(s)
-            parts[s] = []
-            pending[s] = 0
+        windows_iter = self._cached_windows(plan)
+        try:
+            async for seg, windows, read_s in windows_iter:
+                t0 = time.perf_counter()
+                s = seg.segment_start
+                arrived.append(s)
+                parts[s] = []
+                pending[s] = 0
 
-            def prep_windows(ws=windows):
-                out = []
-                for w in ws:
-                    # same semantics as the row path: post-dedup rows
-                    _ROWS_SCANNED.inc(w.n_valid)
-                    prep = self._window_groups(w, spec, plan)
-                    if prep is not None:
-                        out.append((w, prep))
-                return out
+                def prep_windows(ws=windows):
+                    out = []
+                    for w in ws:
+                        # same semantics as the row path: post-dedup rows
+                        _ROWS_SCANNED.inc(w.n_valid)
+                        prep = self._window_groups(w, spec, plan)
+                        if prep is not None:
+                            out.append((w, prep))
+                    return out
 
-            for w, prep in await self._run_pool(plan.pool, prep_windows):
-                queue.append((s, w, prep))
-                pending[s] += 1
-            while len(queue) >= batch_w:
-                await flush(batch_w)
-            _SCAN_LATENCY.observe(read_s + (time.perf_counter() - t0))
-            while arrived and pending[arrived[0]] == 0:
-                s0 = arrived.popleft()
-                yield s0, parts.pop(s0)
+                for w, prep in await self._run_pool(plan.pool, prep_windows):
+                    queue.append((s, w, prep))
+                    pending[s] += 1
+                while len(queue) >= batch_w:
+                    await flush(batch_w)
+                _SCAN_LATENCY.observe(read_s + (time.perf_counter() - t0))
+                while arrived and pending[arrived[0]] == 0:
+                    s0 = arrived.popleft()
+                    yield s0, parts.pop(s0)
+        finally:
+            await windows_iter.aclose()
         if queue:
             await flush(len(queue))
         while arrived:

@@ -77,6 +77,48 @@ class TestChunkCodec:
         assert got_ts.tolist() == sorted(expected)
         assert got_vals.tolist() == [expected[t] for t in sorted(expected)]
 
+    def test_v1_chunks_still_decode_and_mix_with_v2(self):
+        """Payloads written by the previous (raw) codec decode, including
+        concatenated mixed-version payloads (BytesMerge across builds)."""
+        import struct
+
+        ts1 = np.arange(5, dtype=np.int64) * 1000
+        v1 = np.arange(5, dtype=np.float64)
+        raw = (struct.pack("<BIq", 0xC7, 5, 0)
+               + (ts1 - 0).astype("<i4").tobytes() + v1.tobytes())
+        got_ts, got_vals = chunks.decode_chunks(raw)
+        np.testing.assert_array_equal(got_ts, ts1)
+        np.testing.assert_array_equal(got_vals, v1)
+
+        ts2 = ts1 + 250  # interleaves with, never equals, the v1 stamps
+        newer = chunks.encode_chunk(ts2, v1 + 100)
+        got_ts, got_vals = chunks.decode_chunks(raw + newer)
+        assert len(got_ts) == 10
+        np.testing.assert_array_equal(got_ts, np.sort(
+            np.concatenate([ts1, ts2])))
+
+    def test_compressed_sizes(self):
+        """Regular scrape intervals + limited-precision values — the
+        dominant real shape — must compress >= 3x vs the raw v1 layout
+        (12 bytes/point)."""
+        rng = np.random.default_rng(0)
+        n = 1800  # 30min at 1s
+        ts = np.arange(n, dtype=np.int64) * 1000
+        vals = np.round(50 + np.cumsum(rng.normal(0, 0.1, n)), 2)
+        buf = chunks.encode_chunk(ts, vals)
+        raw_size = 13 + 12 * n
+        assert len(buf) * 3 <= raw_size, (len(buf), raw_size)
+        got_ts, got_vals = chunks.decode_chunks(buf)
+        np.testing.assert_array_equal(got_ts, ts)
+        np.testing.assert_array_equal(got_vals, vals)
+
+        # worst case (full-entropy doubles, jittered stamps) stays close
+        # to raw, never pathological
+        ts_j = np.sort(rng.integers(0, 2**30, n)).astype(np.int64)
+        vals_j = rng.random(n)
+        buf_j = chunks.encode_chunk(ts_j, vals_j)
+        assert len(buf_j) <= raw_size * 1.05
+
 
 class TestMergeProperties:
     @_SETTINGS
