@@ -4,7 +4,17 @@ The reference defines a full S3 config but panics "S3 not support yet"
 (ref: src/server/src/main.rs:112, config.rs:82-160).  This client
 implements the five-verb contract against any S3-compatible endpoint
 (AWS, MinIO, GCS-interop): AWS Signature Version 4, path-style
-addressing, ListObjectsV2 with continuation, ranged reads.
+addressing, ListObjectsV2 with continuation, ranged reads — plus the
+production surface the reference's config models:
+
+- bounded retries with exponential backoff + jitter on connection
+  errors, timeouts, and retryable statuses (5xx/429), re-signing each
+  attempt (max_retries, ref: config.rs default_max_retries);
+- non-IO vs IO timeouts (timeout/io_timeout, ref: TimeoutOptions) and a
+  per-host connection pool cap (ref: HttpOptions);
+- multipart upload for objects over multipart_threshold (large SSTs),
+  parts uploaded concurrently, aborted on failure;
+- an optional key prefix (ref: S3LikeStorageConfig.prefix).
 
 Payloads are signed with their SHA-256 (no UNSIGNED-PAYLOAD), so a
 corrupted body is rejected by the server.  DELETE honors the
@@ -15,9 +25,11 @@ extra round trip is acceptable.
 
 from __future__ import annotations
 
+import asyncio
 import datetime
 import hashlib
 import hmac
+import random
 import urllib.parse
 import xml.etree.ElementTree as ET
 from dataclasses import dataclass
@@ -30,6 +42,7 @@ from horaedb_tpu.common.error import Error
 from horaedb_tpu.objstore.api import NotFoundError, ObjectMeta, ObjectStore
 
 _EMPTY_SHA256 = hashlib.sha256(b"").hexdigest()
+_RETRYABLE_STATUSES = {429, 500, 502, 503, 504}
 
 
 @dataclass
@@ -39,11 +52,27 @@ class S3Options:
     bucket: str
     access_key_id: str
     secret_access_key: str
+    # key prefix inside the bucket (ref: S3LikeStorageConfig.prefix)
+    prefix: str = ""
+    # bounded retry with backoff (ref: default_max_retries = 3)
+    max_retries: int = 3
+    retry_base_backoff_s: float = 0.1
+    # non-IO (head/delete/list) vs IO (get/put) deadlines, seconds
+    # (ref: TimeoutOptions)
+    timeout_s: float = 10.0
+    io_timeout_s: float = 10.0
+    # connection pool cap (ref: HttpOptions.pool_max_idle_per_host)
+    pool_max_per_host: int = 64
+    # objects at/above this upload via multipart in part_size chunks
+    multipart_threshold: int = 64 << 20
+    multipart_part_size: int = 16 << 20
+    multipart_concurrency: int = 4
 
     def __post_init__(self) -> None:
         # a trailing slash would double up in signed paths and fail every
         # signature check
         self.endpoint = self.endpoint.rstrip("/")
+        self.prefix = self.prefix.strip("/")
 
 
 def _hmac(key: bytes, msg: str) -> bytes:
@@ -53,6 +82,15 @@ def _hmac(key: bytes, msg: str) -> bytes:
 def _uri_encode(s: str, *, encode_slash: bool) -> str:
     safe = "-._~" + ("" if encode_slash else "/")
     return urllib.parse.quote(s, safe=safe)
+
+
+def _xml_text(body: bytes, tag: str) -> str:
+    """Text of the first `tag` element, namespace-agnostic."""
+    root = ET.fromstring(body)
+    for el in root.iter():
+        if el.tag == tag or el.tag.endswith("}" + tag):
+            return el.text or ""
+    return ""
 
 
 def _canonical_query(query: dict[str, str]) -> str:
@@ -128,7 +166,9 @@ class S3ObjectStore(ObjectStore):
 
     async def _ensure(self) -> aiohttp.ClientSession:
         if self._session is None:
-            self._session = aiohttp.ClientSession()
+            self._session = aiohttp.ClientSession(
+                connector=aiohttp.TCPConnector(
+                    limit_per_host=self.opts.pool_max_per_host))
         return self._session
 
     async def close(self) -> None:
@@ -137,60 +177,176 @@ class S3ObjectStore(ObjectStore):
             self._session = None
 
     def _path(self, key: str) -> str:
+        if self.opts.prefix:
+            return f"/{self.opts.bucket}/{self.opts.prefix}/{key.lstrip('/')}"
         return f"/{self.opts.bucket}/{key.lstrip('/')}"
 
     async def _request(self, method: str, key: str,
                        query: Optional[dict[str, str]] = None,
-                       data: bytes = b"",
+                       data=b"",
                        extra_headers: Optional[dict] = None,
-                       ok_status=(200,)) -> aiohttp.ClientResponse:
+                       ok_status=(200,), io: bool = True,
+                       collect: bool = False):
+        """One S3 request with bounded retries: each attempt is re-signed
+        (the date header changes) and backed off exponentially with
+        jitter.  Callers only pass verbs that are safe to retry (the
+        non-idempotent multipart complete handles its own lost-response
+        case).  IO requests use progress-based timeouts (connect +
+        socket read) rather than a total deadline, so a slow transfer
+        that IS making progress never fails.
+
+        With collect=True the body is read INSIDE the retry loop (a
+        connection dying mid-body is retried like any other transient
+        failure) and (response, body) is returned; otherwise the caller
+        owns the unread response."""
         query = query or {}
         path = self._path(key) if key is not None else f"/{self.opts.bucket}"
         payload_hash = (hashlib.sha256(data).hexdigest()
                         if data else _EMPTY_SHA256)
         cq = _canonical_query(query)
-        headers = self.signer.sign(method, path, cq, payload_hash)
-        if extra_headers:
-            headers.update(extra_headers)
-        session = await self._ensure()
         # send the EXACT bytes that were signed: canonical-encoded path +
         # canonical query, marked pre-encoded so yarl doesn't re-quote
         url = yarl.URL(
             self.opts.endpoint + _uri_encode(path, encode_slash=False)
             + (f"?{cq}" if cq else ""),
             encoded=True)
-        resp = await session.request(method, url, data=data,
-                                     headers=headers)
-        if resp.status == 404:
-            resp.release()
-            raise NotFoundError(f"object not found: {key}")
-        if resp.status not in ok_status:
-            text = (await resp.text())[:300]
-            raise Error(f"s3 {method} {path} failed "
-                        f"({resp.status}): {text}")
-        return resp
+        if io:
+            timeout = aiohttp.ClientTimeout(connect=self.opts.timeout_s,
+                                            sock_read=self.opts.io_timeout_s)
+        else:
+            timeout = aiohttp.ClientTimeout(total=self.opts.timeout_s)
+        session = await self._ensure()
+
+        last_err: Optional[str] = None
+        for attempt in range(self.opts.max_retries + 1):
+            if attempt:
+                backoff = (self.opts.retry_base_backoff_s * (2 ** (attempt - 1))
+                           * (1 + random.random()))
+                await asyncio.sleep(backoff)
+            headers = self.signer.sign(method, path, cq, payload_hash)
+            if extra_headers:
+                headers.update(extra_headers)
+            try:
+                resp = await session.request(method, url, data=data,
+                                             headers=headers,
+                                             timeout=timeout)
+                if resp.status in _RETRYABLE_STATUSES:
+                    try:
+                        detail = (await resp.text())[:200]
+                    finally:
+                        resp.release()
+                    last_err = f"status {resp.status}: {detail}"
+                    continue
+                if resp.status == 404:
+                    resp.release()
+                    raise NotFoundError(f"object not found: {key}")
+                if resp.status not in ok_status:
+                    text = (await resp.text())[:300]
+                    raise Error(f"s3 {method} {path} failed "
+                                f"({resp.status}): {text}")
+                if collect:
+                    body = await resp.read()
+                    resp.release()
+                    return resp, body
+                return resp
+            except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+                last_err = f"{type(e).__name__}: {e}"
+                continue
+        raise Error(f"s3 {method} {path} failed after "
+                    f"{self.opts.max_retries + 1} attempts: {last_err}")
 
     # ---- ObjectStore ------------------------------------------------------
 
     async def put(self, path: str, data: bytes) -> None:
+        if len(data) >= self.opts.multipart_threshold:
+            await self._put_multipart(path, data)
+            return
         resp = await self._request("PUT", path, data=data)
         resp.release()
 
-    async def get(self, path: str) -> bytes:
-        resp = await self._request("GET", path)
+    async def _put_multipart(self, path: str, data: bytes) -> None:
+        """Multipart upload: initiate, upload parts concurrently (each
+        part retried independently by _request), complete; abort on any
+        failure so no orphaned upload accrues storage."""
+        _resp, body = await self._request("POST", path,
+                                          query={"uploads": ""},
+                                          collect=True)
+        upload_id = _xml_text(body, "UploadId")
+        if not upload_id:
+            raise Error(f"s3 multipart initiate returned no UploadId "
+                        f"for {path}")
+
+        part_size = self.opts.multipart_part_size
+        view = memoryview(data)  # parts slice lazily — no payload copy
+        n_parts = -(-len(data) // part_size)
+        sem = asyncio.Semaphore(max(1, self.opts.multipart_concurrency))
+
+        async def upload(num: int) -> tuple[int, str]:
+            async with sem:
+                chunk = view[(num - 1) * part_size: num * part_size]
+                r = await self._request(
+                    "PUT", path,
+                    query={"partNumber": str(num), "uploadId": upload_id},
+                    data=chunk)
+                etag = r.headers.get("ETag", "")
+                r.release()
+                return num, etag
+
         try:
-            return await resp.read()
-        finally:
-            resp.release()
+            tasks = [asyncio.create_task(upload(i + 1))
+                     for i in range(n_parts)]
+            try:
+                etags = await asyncio.gather(*tasks)
+            except BaseException:
+                # stop in-flight siblings BEFORE aborting: parts racing
+                # the abort can still be stored as orphans
+                for t in tasks:
+                    t.cancel()
+                await asyncio.gather(*tasks, return_exceptions=True)
+                raise
+            complete = "".join(
+                f"<Part><PartNumber>{n}</PartNumber><ETag>{e}</ETag></Part>"
+                for n, e in etags)
+            xml = (f"<CompleteMultipartUpload>{complete}"
+                   f"</CompleteMultipartUpload>").encode()
+            await self._complete_multipart(path, upload_id, xml)
+        except BaseException:
+            try:
+                r = await self._request("DELETE", path,
+                                        query={"uploadId": upload_id},
+                                        ok_status=(200, 204), io=False)
+                r.release()
+            except Exception:
+                pass  # abort is best-effort; the error below matters more
+            raise
+
+    async def _complete_multipart(self, path: str, upload_id: str,
+                                  xml: bytes) -> None:
+        """CompleteMultipartUpload is NOT idempotent: a retry after a
+        lost success response gets 404 NoSuchUpload — confirm via HEAD
+        that the object landed before treating that as failure.  A 200
+        can also carry an error body (AWS documents InternalError-in-200
+        for this call), which must not pass as success."""
+        try:
+            _resp, body = await self._request(
+                "POST", path, query={"uploadId": upload_id}, data=xml,
+                collect=True)
+            if b"<Error" in body or not body:
+                raise Error(f"s3 multipart complete for {path} returned "
+                            f"an error body: {body[:200]!r}")
+        except NotFoundError:
+            # a previous attempt whose response was lost may have
+            # completed the upload; the object's existence decides
+            await self.head(path)
+
+    async def get(self, path: str) -> bytes:
+        _resp, body = await self._request("GET", path, collect=True)
+        return body
 
     async def get_range(self, path: str, start: int, end: int) -> bytes:
-        resp = await self._request(
+        resp, data = await self._request(
             "GET", path, extra_headers={"Range": f"bytes={start}-{end - 1}"},
-            ok_status=(200, 206))
-        try:
-            data = await resp.read()
-        finally:
-            resp.release()
+            ok_status=(200, 206), collect=True)
         if resp.status == 200:
             # endpoint (or a proxy) ignored the Range header: slice here
             # so callers always get exactly [start, end)
@@ -198,7 +354,7 @@ class S3ObjectStore(ObjectStore):
         return data
 
     async def head(self, path: str) -> ObjectMeta:
-        resp = await self._request("HEAD", path)
+        resp = await self._request("HEAD", path, io=False)
         try:
             return ObjectMeta(path=path,
                               size=int(resp.headers.get("Content-Length", 0)))
@@ -209,21 +365,26 @@ class S3ObjectStore(ObjectStore):
         # S3 DELETE is idempotent (204 for missing keys); the ObjectStore
         # contract wants NotFoundError, so probe first
         await self.head(path)
-        resp = await self._request("DELETE", path, ok_status=(200, 204))
+        resp = await self._request("DELETE", path, ok_status=(200, 204),
+                                   io=False)
         resp.release()
 
     async def list(self, prefix: str) -> list[ObjectMeta]:
         out: list[ObjectMeta] = []
         token: Optional[str] = None
+        # the configured bucket prefix is transparent to callers: it is
+        # prepended on the wire and stripped from returned keys
+        wire_prefix = prefix.lstrip("/")
+        strip = ""
+        if self.opts.prefix:
+            strip = self.opts.prefix + "/"
+            wire_prefix = strip + wire_prefix
         while True:
-            query = {"list-type": "2", "prefix": prefix.lstrip("/")}
+            query = {"list-type": "2", "prefix": wire_prefix}
             if token:
                 query["continuation-token"] = token
-            resp = await self._request("GET", None, query=query)
-            try:
-                body = await resp.read()
-            finally:
-                resp.release()
+            _resp, body = await self._request("GET", None, query=query,
+                                              io=False, collect=True)
             root = ET.fromstring(body)
             ns = ""
             if root.tag.startswith("{"):
@@ -231,6 +392,8 @@ class S3ObjectStore(ObjectStore):
             for contents in root.findall(f"{ns}Contents"):
                 key = contents.find(f"{ns}Key").text or ""
                 size = int(contents.find(f"{ns}Size").text or 0)
+                if strip and key.startswith(strip):
+                    key = key[len(strip):]
                 out.append(ObjectMeta(path=key, size=size))
             truncated = (root.findtext(f"{ns}IsTruncated") == "true")
             token = root.findtext(f"{ns}NextContinuationToken")

@@ -42,6 +42,8 @@ class S3Config:
     key_secret: str = ""
     endpoint: str = ""
     bucket: str = ""
+    prefix: str = ""
+    max_retries: int = 3
 
 
 @dataclass

@@ -292,7 +292,8 @@ def _build_store(config: ServerConfig):
         return S3ObjectStore(S3Options(
             endpoint=oc.s3.endpoint, region=oc.s3.region or "us-east-1",
             bucket=oc.s3.bucket, access_key_id=oc.s3.key_id,
-            secret_access_key=oc.s3.key_secret))
+            secret_access_key=oc.s3.key_secret, prefix=oc.s3.prefix,
+            max_retries=oc.s3.max_retries))
     return LocalObjectStore(oc.data_dir)
 
 

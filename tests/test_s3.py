@@ -1,13 +1,11 @@
-"""S3 ObjectStore tests against an in-process S3-compatible fake.
-
-The fake validates what a real endpoint would: SigV4 Authorization
-header shape and that x-amz-content-sha256 matches the actual body —
-so payload signing is exercised, not just assumed.  ListObjectsV2
-paginates with a small page size to cover continuation tokens.
-"""
+"""S3 ObjectStore tests against an in-process fake that RECOMPUTES the
+SigV4 signature from the raw request bytes — any divergence between
+signed and sent bytes fails every request — and supports fault
+injection (drops/5xx), multipart uploads, and ListObjectsV2 paging."""
 
 import asyncio
 import hashlib
+import hmac
 
 import pyarrow as pa
 import pytest
@@ -19,23 +17,122 @@ from horaedb_tpu.objstore import NotFoundError
 from horaedb_tpu.objstore.s3 import S3ObjectStore, S3Options
 
 PAGE = 3  # tiny ListObjectsV2 page size to force continuation
+SECRET = "secretsecret"
+REGION = "us-east-1"
+
+
+def _hmac(key: bytes, msg: str) -> bytes:
+    return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+
+def verify_signature(request: web.Request) -> None:
+    """Server-side SigV4 verification from the RAW request bytes."""
+    auth = request.headers["Authorization"]
+    assert auth.startswith("AWS4-HMAC-SHA256 "), auth
+    parts = dict(p.strip().split("=", 1)
+                 for p in auth.removeprefix("AWS4-HMAC-SHA256 ").split(","))
+    scope = parts["Credential"].split("/", 1)[1]
+    datestamp = scope.split("/")[0]
+    signed_headers = parts["SignedHeaders"]
+    sent_sig = parts["Signature"]
+
+    raw = request.raw_path  # exactly as sent on the wire
+    path, _, query = raw.partition("?")
+    payload_hash = request.headers["x-amz-content-sha256"]
+    canonical_headers = "".join(
+        f"{h}:{request.headers[h].strip()}\n"
+        for h in signed_headers.split(";"))
+    canonical_request = "\n".join([
+        request.method, path, query, canonical_headers, signed_headers,
+        payload_hash])
+    string_to_sign = "\n".join([
+        "AWS4-HMAC-SHA256", request.headers["x-amz-date"], scope,
+        hashlib.sha256(canonical_request.encode()).hexdigest()])
+    k = _hmac(("AWS4" + SECRET).encode(), datestamp)
+    for part in (REGION, "s3", "aws4_request"):
+        k = _hmac(k, part)
+    want = hmac.new(k, string_to_sign.encode(), hashlib.sha256).hexdigest()
+    assert want == sent_sig, (
+        f"SIGNATURE MISMATCH\n raw={raw}\n canonical:\n{canonical_request}")
+
+
+class Faults:
+    """Fault injection: fail the next N requests with `status`
+    (0 = drop the connection)."""
+
+    def __init__(self):
+        self.remaining = 0
+        self.status = 500
+        self.seen = 0
 
 
 def make_fake_s3(bucket: str):
     objects: dict[str, bytes] = {}
+    uploads: dict[str, list] = {}
+    faults = Faults()
 
-    def check_auth(request: web.Request, body: bytes):
-        auth = request.headers.get("Authorization", "")
-        assert auth.startswith("AWS4-HMAC-SHA256 Credential="), auth
-        assert "SignedHeaders=" in auth and "Signature=" in auth
-        declared = request.headers.get("x-amz-content-sha256", "")
+    async def handle(request: web.Request):
+        faults.seen += 1
+        if faults.remaining > 0:
+            faults.remaining -= 1
+            if faults.status == 0:
+                request.transport.close()
+                return web.Response(status=500)
+            return web.Response(status=faults.status)
+        body = await request.read()
+        verify_signature(request)
+        declared = request.headers["x-amz-content-sha256"]
         assert declared == hashlib.sha256(body).hexdigest(), \
             "payload hash mismatch"
 
-    async def handle_object(request: web.Request):
-        key = request.match_info["key"]
-        body = await request.read()
-        check_auth(request, body)
+        if request.path == f"/{bucket}":  # ListObjectsV2
+            assert request.query.get("list-type") == "2"
+            prefix = request.query.get("prefix", "")
+            start_after = request.query.get("continuation-token", "")
+            keys = sorted(k for k in objects if k.startswith(prefix)
+                          and k > start_after)
+            page, rest = keys[:PAGE], keys[PAGE:]
+            from xml.sax.saxutils import escape
+            contents = "".join(
+                f"<Contents><Key>{escape(k)}</Key>"
+                f"<Size>{len(objects[k])}</Size></Contents>" for k in page)
+            truncated = "true" if rest else "false"
+            token = (f"<NextContinuationToken>{escape(page[-1])}"
+                     f"</NextContinuationToken>" if rest else "")
+            xml = (f'<?xml version="1.0"?><ListBucketResult>'
+                   f"<IsTruncated>{truncated}</IsTruncated>{token}{contents}"
+                   f"</ListBucketResult>")
+            return web.Response(status=200, body=xml.encode(),
+                                content_type="application/xml")
+
+        key = request.path.removeprefix(f"/{bucket}/")
+        if request.method == "POST" and "uploads" in request.query:
+            uid = f"up-{len(uploads)}"
+            uploads[uid] = []
+            return web.Response(
+                status=200, content_type="application/xml",
+                body=(f"<InitiateMultipartUploadResult><UploadId>{uid}"
+                      f"</UploadId></InitiateMultipartUploadResult>"
+                      ).encode())
+        if request.method == "PUT" and "uploadId" in request.query:
+            uid = request.query["uploadId"]
+            num = int(request.query["partNumber"])
+            assert uid in uploads, uid
+            etag = hashlib.md5(body).hexdigest()
+            uploads[uid].append((num, etag, body))
+            return web.Response(status=200, headers={"ETag": f'"{etag}"'})
+        if request.method == "POST" and "uploadId" in request.query:
+            uid = request.query["uploadId"]
+            parts = sorted(uploads.pop(uid), key=lambda p: p[0])
+            assert [p[0] for p in parts] == list(range(1, len(parts) + 1))
+            objects[key] = b"".join(p[2] for p in parts)
+            return web.Response(
+                status=200, content_type="application/xml",
+                body=b"<CompleteMultipartUploadResult/>")
+        if request.method == "DELETE" and "uploadId" in request.query:
+            uploads.pop(request.query["uploadId"], None)
+            return web.Response(status=204)
+
         if request.method == "PUT":
             objects[key] = body
             return web.Response(status=200)
@@ -47,59 +144,41 @@ def make_fake_s3(bucket: str):
             if rng and request.method == "GET":
                 spec = rng.removeprefix("bytes=")
                 lo, hi = spec.split("-")
-                data = data[int(lo): int(hi) + 1]
-                return web.Response(status=206, body=data)
+                return web.Response(status=206,
+                                    body=data[int(lo): int(hi) + 1])
             if request.method == "HEAD":
-                return web.Response(status=200,
-                                    headers={"Content-Length": str(len(data))})
+                return web.Response(
+                    status=200,
+                    headers={"Content-Length": str(len(data))})
             return web.Response(status=200, body=data)
         if request.method == "DELETE":
             objects.pop(key, None)
             return web.Response(status=204)  # idempotent like real S3
         return web.Response(status=405)
 
-    async def handle_bucket(request: web.Request):
-        check_auth(request, b"")
-        assert request.query.get("list-type") == "2"
-        prefix = request.query.get("prefix", "")
-        start_after = request.query.get("continuation-token", "")
-        keys = sorted(k for k in objects if k.startswith(prefix)
-                      and k > start_after)
-        page, rest = keys[:PAGE], keys[PAGE:]
-        contents = "".join(
-            f"<Contents><Key>{k}</Key><Size>{len(objects[k])}</Size></Contents>"
-            for k in page)
-        truncated = "true" if rest else "false"
-        token = (f"<NextContinuationToken>{page[-1]}</NextContinuationToken>"
-                 if rest else "")
-        xml = (f'<?xml version="1.0"?><ListBucketResult>'
-               f"<IsTruncated>{truncated}</IsTruncated>{token}{contents}"
-               f"</ListBucketResult>")
-        return web.Response(status=200, body=xml.encode(),
-                            content_type="application/xml")
-
-    app = web.Application()
-    app.router.add_route("*", f"/{bucket}/{{key:.+}}", handle_object)
-    app.router.add_route("GET", f"/{bucket}", handle_bucket)
-    return app, objects
+    app = web.Application(client_max_size=256 << 20)
+    app.router.add_route("*", "/{tail:.*}", handle)
+    return app, objects, uploads, faults
 
 
-async def make_store():
-    app, objects = make_fake_s3("tsdb")
+async def make_store(**opt_overrides):
+    app, objects, uploads, faults = make_fake_s3("tsdb")
     server = TestServer(app)
     await server.start_server()
     opts = S3Options(endpoint=str(server.make_url("")).rstrip("/"),
-                     region="us-east-1", bucket="tsdb",
+                     region=REGION, bucket="tsdb",
                      access_key_id="AKIATEST",
-                     secret_access_key="secretsecret")
+                     secret_access_key=SECRET,
+                     retry_base_backoff_s=0.01,
+                     **opt_overrides)
     store = S3ObjectStore(opts)
-    return store, server, objects
+    return store, server, objects, uploads, faults
 
 
 class TestS3Store:
     def test_crud_roundtrip(self):
         async def go():
-            store, server, _ = await make_store()
+            store, server, _, _, _ = await make_store()
             try:
                 await store.put("db/data/1.sst", b"hello world")
                 assert await store.get("db/data/1.sst") == b"hello world"
@@ -116,9 +195,27 @@ class TestS3Store:
 
         asyncio.run(go())
 
+    def test_tricky_keys_sign_exactly(self):
+        """Keys/prefixes with characters yarl would re-encode differently
+        from AWS: the verifying fake rejects any signed!=sent byte."""
+        async def go():
+            store, server, _, _, _ = await make_store()
+            try:
+                tricky = "db/data dir/a+b=c&d/1~2.sst"
+                await store.put(tricky, b"payload-1")
+                assert await store.get(tricky) == b"payload-1"
+                listed = await store.list("db/data dir/a+b=c&d/")
+                assert [m.path for m in listed] == [tricky]
+                await store.delete(tricky)
+            finally:
+                await store.close()
+                await server.close()
+
+        asyncio.run(go())
+
     def test_list_with_continuation(self):
         async def go():
-            store, server, _ = await make_store()
+            store, server, _, _, _ = await make_store()
             try:
                 for i in range(8):  # > 2 pages of 3
                     await store.put(f"m/delta/{i:03d}", bytes(i))
@@ -127,6 +224,103 @@ class TestS3Store:
                 assert [m.path for m in metas] == \
                     [f"m/delta/{i:03d}" for i in range(8)]
                 assert [m.size for m in metas] == list(range(8))
+            finally:
+                await store.close()
+                await server.close()
+
+        asyncio.run(go())
+
+    def test_key_prefix_is_transparent(self):
+        async def go():
+            store, server, objects, _, _ = await make_store(
+                prefix="tenant-7/metrics")
+            try:
+                await store.put("db/data/9.sst", b"x" * 5)
+                assert "tenant-7/metrics/db/data/9.sst" in objects
+                assert await store.get("db/data/9.sst") == b"x" * 5
+                metas = await store.list("db/data/")
+                assert [m.path for m in metas] == ["db/data/9.sst"]
+                await store.delete("db/data/9.sst")
+                assert not objects
+            finally:
+                await store.close()
+                await server.close()
+
+        asyncio.run(go())
+
+    def test_multipart_upload_roundtrip(self):
+        async def go():
+            store, server, objects, uploads, _ = await make_store(
+                multipart_threshold=1 << 16, multipart_part_size=1 << 16)
+            try:
+                data = bytes(range(256)) * 1024  # 256 KiB -> 4 parts
+                await store.put("db/data/big.sst", data)
+                assert objects["db/data/big.sst"] == data
+                assert not uploads  # completed, nothing dangling
+                assert await store.get("db/data/big.sst") == data
+                assert await store.get_range(
+                    "db/data/big.sst", 70000, 70010) == data[70000:70010]
+            finally:
+                await store.close()
+                await server.close()
+
+        asyncio.run(go())
+
+    def test_retry_recovers_from_5xx_and_drops(self):
+        async def go():
+            store, server, objects, _, faults = await make_store()
+            try:
+                faults.remaining, faults.status = 2, 503
+                await store.put("a", b"1")  # succeeds on third attempt
+                assert objects["a"] == b"1"
+                faults.remaining, faults.status = 1, 500
+                assert await store.get("a") == b"1"
+                faults.remaining, faults.status = 1, 0  # connection drop
+                assert await store.get("a") == b"1"
+            finally:
+                await store.close()
+                await server.close()
+
+        asyncio.run(go())
+
+    def test_retry_exhaustion_raises(self):
+        async def go():
+            store, server, _, _, faults = await make_store(max_retries=2)
+            try:
+                faults.remaining, faults.status = 10, 503
+                with pytest.raises(Error, match="after 3 attempts"):
+                    await store.get("a")
+                # 4xx (non-retryable) errors surface immediately
+                faults.remaining = 0
+                with pytest.raises(NotFoundError):
+                    await store.get("never-written")
+            finally:
+                await store.close()
+                await server.close()
+
+        asyncio.run(go())
+
+    def test_multipart_failure_aborts_upload(self):
+        async def go():
+            store, server, objects, uploads, faults = await make_store(
+                multipart_threshold=1 << 16, multipart_part_size=1 << 16,
+                max_retries=1, multipart_concurrency=1)
+            try:
+                data = b"z" * (1 << 18)
+                # initiate succeeds; the first part's PUT then fails all
+                # its attempts (2 with max_retries=1), after which the
+                # abort DELETE goes through cleanly
+                async def fail_after_initiate():
+                    while faults.seen == 0:
+                        await asyncio.sleep(0.001)
+                    faults.remaining, faults.status = 2, 500
+
+                t = asyncio.ensure_future(fail_after_initiate())
+                with pytest.raises(Error):
+                    await store.put("db/data/doomed.sst", data)
+                t.cancel()
+                assert "db/data/doomed.sst" not in objects
+                assert not uploads  # aborted
             finally:
                 await store.close()
                 await server.close()
@@ -146,7 +340,7 @@ class TestS3Store:
             )
             from horaedb_tpu.storage.types import TimeRange
 
-            store, server, objects = await make_store()
+            store, server, objects, _, _ = await make_store()
             try:
                 schema = pa.schema([("k", pa.string()), ("ts", pa.int64()),
                                     ("v", pa.float64())])
