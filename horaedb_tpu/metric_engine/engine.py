@@ -499,7 +499,10 @@ class MetricEngine:
                "write_arrow timestamp/value columns contain nulls")
 
         # unique series via per-tag dictionary codes combined into one
-        # composite code (Arrow C++ encodes; numpy combines)
+        # composite code (Arrow C++ encodes; numpy combines); extreme
+        # tag-cardinality products that would overflow the composite
+        # fall back to exact row-wise unique over the code matrix
+        # instead of rejecting the batch
         tag_arrays = [batch.column(batch.schema.names.index(c))
                       for c in tag_columns]
         per_tag_codes = []
@@ -509,14 +512,18 @@ class MetricEngine:
             d = d.combine_chunks() if isinstance(d, pa.ChunkedArray) else d
             per_tag_codes.append(np.asarray(d.indices).astype(np.int64))
             code_space *= max(1, len(d.dictionary))
-        ensure(code_space < 2**62,
-               "tag cardinality product overflows the composite series "
-               "code; split the batch or reduce tag columns")
-        composite = np.zeros(n, dtype=np.int64)
-        for c in per_tag_codes:
-            card = int(c.max()) + 1 if len(c) else 1
-            composite = composite * card + c
-        uniq_codes, codes = np.unique(composite, return_inverse=True)
+        if code_space < 2**62:
+            composite = np.zeros(n, dtype=np.int64)
+            for c in per_tag_codes:
+                card = int(c.max()) + 1 if len(c) else 1
+                composite = composite * card + c
+            uniq_codes, codes = np.unique(composite, return_inverse=True)
+            num_series = len(uniq_codes)
+        else:
+            mat = np.stack(per_tag_codes, axis=1)
+            uniq_rows, codes = np.unique(mat, axis=0, return_inverse=True)
+            codes = codes.reshape(-1)
+            num_series = len(uniq_rows)
 
         ts_np = ts_col.to_numpy()
         # segment assignment must match Timestamp.truncate_by (truncation
@@ -529,10 +536,12 @@ class MetricEngine:
         # registration must happen per (segment, series) — the index is
         # Date-scoped (RFC:104), so a series spanning segments registers
         # in each one.  One Python trip per unique pair.
-        pair = np.stack([seg_ids, composite], axis=1)
+        # dense per-batch codes stand in for the series identity (they
+        # are bijective with the composite/tag-row within one batch)
+        pair = np.stack([seg_ids, codes], axis=1)
         _, pair_rows = np.unique(pair, axis=0, return_index=True)
         reg_samples = []
-        tsid_of_code = np.full(len(uniq_codes), 0, dtype=np.uint64)
+        tsid_of_code = np.full(num_series, 0, dtype=np.uint64)
         mid = metric_id_of(metric)
         for row in pair_rows:
             row = int(row)

@@ -106,8 +106,13 @@ class ScanConfig:
     max_window_rows: int = 1 << 20
     # HBM-resident post-merge cache budget in rows (0 disables); keyed by
     # (segment, SST set, columns) so writes/compaction invalidate
-    # structurally
+    # structurally.  The cache accounts BYTES (column widths + memo
+    # allowance); this row knob converts at _CACHE_BYTES_PER_ROW unless
+    # cache_max_bytes overrides it.
     cache_max_rows: int = 4 << 20
+    # explicit HBM budget in bytes for the scan cache (0 = derive from
+    # cache_max_rows)
+    cache_max_bytes: int = 0
     # devices for the multi-chip aggregate path (0 = single-device);
     # windows batch onto a 1-D segment mesh in rounds of this size with
     # partial grids combined via ICI psum/pmin/pmax

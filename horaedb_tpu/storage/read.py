@@ -59,6 +59,9 @@ _ROWS_SCANNED = registry.counter(
 # segment tables held in memory at once by _prefetch_tables (bounds BOTH
 # the row-scan and aggregate paths — including compaction's scan)
 _PREFETCH_SEGMENTS = 4
+# rows -> bytes conversion for the legacy cache_max_rows knob: a typical
+# engine window is ~4 int32/f32 columns (16B) plus the memo allowance
+_CACHE_BYTES_PER_ROW = 32
 
 
 @dataclass
@@ -133,7 +136,9 @@ class ParquetReader:
         self.runtimes = runtimes
         from horaedb_tpu.storage.scan_cache import ScanCache
 
-        self.scan_cache = ScanCache(config.scan.cache_max_rows)
+        cache_bytes = (config.scan.cache_max_bytes
+                       or config.scan.cache_max_rows * _CACHE_BYTES_PER_ROW)
+        self.scan_cache = ScanCache(cache_bytes)
         self.mesh = None
         self._mesh_agg_fns: dict = {}
         self._mesh_merge_fns: dict = {}
@@ -354,8 +359,7 @@ class ParquetReader:
                         plan.pool, self._finalize_windows, dispatched)
                     if plan.use_cache:
                         self.scan_cache.put(
-                            self._cache_key(seg, plan), windows,
-                            sum(w.capacity for w in windows))
+                            self._cache_key(seg, plan), windows)
                     yield seg, windows, time.perf_counter() - t0
                     continue
                 while len(pending) <= self._MERGE_LOOKAHEAD and not exhausted:
@@ -365,8 +369,8 @@ class ParquetReader:
                 windows = await self._run_pool(
                     plan.pool, self._finalize_windows, dispatched)
                 if plan.use_cache:
-                    self.scan_cache.put(self._cache_key(seg, plan), windows,
-                                        sum(w.capacity for w in windows))
+                    self.scan_cache.put(self._cache_key(seg, plan),
+                                        windows)
                 yield seg, windows, read_s
         finally:
             if primed is not None:
@@ -469,8 +473,8 @@ class ParquetReader:
                 while buffer and buffer[0][2] == 0:
                     seg0, windows, _outstanding, read_s0 = buffer.pop(0)
                     if plan.use_cache and id(seg0) not in cached:
-                        self.scan_cache.put(self._cache_key(seg0, plan), windows,
-                                            sum(w.capacity for w in windows))
+                        self.scan_cache.put(self._cache_key(seg0, plan),
+                                            windows)
                     yield seg0, windows, read_s0
             if pending:
                 # tail round: pad with empty windows bound to a discard
@@ -486,8 +490,8 @@ class ParquetReader:
                 seg0, windows, outstanding, read_s0 = buffer.pop(0)
                 assert outstanding == 0
                 if plan.use_cache and id(seg0) not in cached:
-                    self.scan_cache.put(self._cache_key(seg0, plan), windows,
-                                        sum(w.capacity for w in windows))
+                    self.scan_cache.put(self._cache_key(seg0, plan),
+                                        windows)
                 yield seg0, windows, read_s0
 
         finally:
@@ -930,9 +934,12 @@ class ParquetReader:
         if cached_val is not miss:
             return cached_val
         result = self._window_groups_uncached(out_batch, spec, plan)
-        # small bound: each entry holds a capacity-sized gid array that the
-        # scan cache's row budget does not account for
-        if len(out_batch.memo) >= 4:
+        # bound the memo at the slot count the scan cache CHARGES per
+        # window (scan_cache.windows_nbytes) — raising one without the
+        # other would let real HBM use exceed the cache budget
+        from horaedb_tpu.storage.scan_cache import MEMO_SLOTS
+
+        if len(out_batch.memo) >= MEMO_SLOTS:
             out_batch.memo.clear()
         out_batch.memo[memo_key] = result
         return result

@@ -13,8 +13,10 @@ invalidation hooks, no staleness).  Predicates and aggregation run AFTER
 the merge, so one cached entry serves every query shape over the same
 data.
 
-Eviction is LRU by total cached rows (a proxy for HBM bytes); dropping
-an entry releases its device buffers through JAX's reference counting.
+Eviction is LRU by total cached BYTES — column buffers across their
+real widths plus an allowance for the per-window aggregation memos
+(each memo slot can hold a capacity-sized gid array); dropping an entry
+releases its device buffers through JAX's reference counting.
 """
 
 from __future__ import annotations
@@ -31,16 +33,31 @@ _EVICTIONS = registry.counter("scan_cache_evictions_total",
 
 CacheKey = tuple
 
+# DeviceBatch.memo slots (see storage.read._window_groups) each hold up
+# to one capacity-sized int32 gid array
+MEMO_SLOTS = 4
+
 
 def segment_cache_key(segment_start: int, sst_ids, columns) -> CacheKey:
     return (segment_start, frozenset(sst_ids), tuple(columns))
 
 
+def windows_nbytes(windows: list) -> int:
+    """HBM cost of a cached entry: every column buffer at its real
+    width, plus the memo allowance per window."""
+    total = 0
+    for w in windows:
+        for col in w.columns.values():
+            total += int(col.dtype.itemsize) * w.capacity
+        total += MEMO_SLOTS * (w.capacity * 4 + 128)
+    return total
+
+
 class ScanCache:
-    def __init__(self, max_rows: int):
-        self.max_rows = max_rows
+    def __init__(self, max_bytes: int):
+        self.max_bytes = max_bytes
         self._entries: "OrderedDict[CacheKey, tuple[list, int]]" = OrderedDict()
-        self._total_rows = 0
+        self._total_bytes = 0
 
     def get(self, key: CacheKey) -> Optional[list]:
         entry = self._entries.get(key)
@@ -51,16 +68,17 @@ class ScanCache:
         _HITS.inc()
         return entry[0]
 
-    def put(self, key: CacheKey, windows: list, rows: int) -> None:
-        if self.max_rows <= 0 or rows > self.max_rows:
+    def put(self, key: CacheKey, windows: list) -> None:
+        nbytes = windows_nbytes(windows)
+        if self.max_bytes <= 0 or nbytes > self.max_bytes:
             return
         if key in self._entries:
-            self._total_rows -= self._entries.pop(key)[1]
-        self._entries[key] = (windows, rows)
-        self._total_rows += rows
-        while self._total_rows > self.max_rows and self._entries:
-            _, (_, evicted_rows) = self._entries.popitem(last=False)
-            self._total_rows -= evicted_rows
+            self._total_bytes -= self._entries.pop(key)[1]
+        self._entries[key] = (windows, nbytes)
+        self._total_bytes += nbytes
+        while self._total_bytes > self.max_bytes and self._entries:
+            _, (_, evicted) = self._entries.popitem(last=False)
+            self._total_bytes -= evicted
             _EVICTIONS.inc()
 
     def clear(self) -> None:
@@ -68,11 +86,11 @@ class ScanCache:
         Used by cold-path benchmarks and tests; production invalidation
         is structural (SST-set keys), never explicit."""
         self._entries.clear()
-        self._total_rows = 0
+        self._total_bytes = 0
 
     @property
-    def total_rows(self) -> int:
-        return self._total_rows
+    def total_bytes(self) -> int:
+        return self._total_bytes
 
     def __len__(self) -> int:
         return len(self._entries)

@@ -440,6 +440,51 @@ class TestBulkArrowIngest:
 
         asyncio.run(go())
 
+    def test_write_arrow_high_cardinality_fallback(self):
+        """A tag-cardinality product beyond the composite code space
+        must fall back to exact row-wise grouping, not reject the
+        batch — results identical to the scalar write path."""
+        async def go():
+            import pyarrow as pa
+            rng = np.random.default_rng(4)
+            n, tags = 120, 11  # 100-ish uniques ** 11 >> 2**62
+            cols = {f"t{j}": [f"v{int(x):03d}" for x in
+                              rng.integers(0, 100, n)]
+                    for j in range(tags)}
+            ts = (T0 + rng.integers(0, HOUR, n)).tolist()
+            vals = rng.random(n).round(4).tolist()
+            batch = pa.record_batch({
+                **{k: pa.array(v) for k, v in cols.items()},
+                "timestamp": pa.array(ts, type=pa.int64()),
+                "value": pa.array(vals, type=pa.float64()),
+            })
+            tag_names = list(cols)
+            e_bulk = await open_engine()
+            e_ref = await open_engine()
+            try:
+                await e_bulk.write_arrow("cpu", tag_names, batch)
+                await e_ref.write([
+                    sample("cpu",
+                           [(k, cols[k][i]) for k in tag_names], ts[i],
+                           vals[i])
+                    for i in range(n)
+                ])
+                rng_q = TimeRange.new(T0, T0 + 2 * HOUR)
+                a = await e_bulk.query("cpu", [], rng_q)
+                b = await e_ref.query("cpu", [], rng_q)
+                ka = sorted(zip(a.column("tsid").to_pylist(),
+                                a.column("timestamp").to_pylist(),
+                                a.column("value").to_pylist()))
+                kb = sorted(zip(b.column("tsid").to_pylist(),
+                                b.column("timestamp").to_pylist(),
+                                b.column("value").to_pylist()))
+                assert ka == kb and len(ka) > 0
+            finally:
+                await e_bulk.close()
+                await e_ref.close()
+
+        asyncio.run(go())
+
     def test_write_arrow_multi_segment(self):
         async def go():
             import pyarrow as pa

@@ -756,18 +756,45 @@ class TestScanCache:
         asyncio.run(go())
 
     def test_eviction_bound(self):
-        from horaedb_tpu.storage.scan_cache import ScanCache
-        c = ScanCache(max_rows=300)
-        c.put(("k1",), ["w"], 128)
-        c.put(("k2",), ["w"], 128)
-        assert c.total_rows == 256 and len(c) == 2
-        c.put(("k3",), ["w"], 128)  # evicts k1 (LRU)
-        assert c.total_rows == 256
+        import numpy as np
+
+        from horaedb_tpu.ops.encode import DeviceBatch
+        from horaedb_tpu.storage.scan_cache import ScanCache, windows_nbytes
+
+        def window(capacity):
+            return DeviceBatch(
+                columns={"a": np.zeros(capacity, np.int32)},
+                encodings={}, n_valid=capacity, capacity=capacity)
+
+        unit = windows_nbytes([window(8)])
+        c = ScanCache(max_bytes=int(unit * 2.5))
+        c.put(("k1",), [window(8)])
+        c.put(("k2",), [window(8)])
+        assert c.total_bytes == 2 * unit and len(c) == 2
+        c.put(("k3",), [window(8)])  # evicts k1 (LRU)
+        assert c.total_bytes == 2 * unit
         assert c.get(("k1",)) is None
         assert c.get(("k2",)) is not None
         # oversized entries are not cached
-        c.put(("big",), ["w"], 10_000)
+        c.put(("big",), [window(8192)])
         assert c.get(("big",)) is None
+
+    def test_byte_accounting_counts_columns_and_memos(self):
+        import numpy as np
+
+        from horaedb_tpu.ops.encode import DeviceBatch
+        from horaedb_tpu.storage.scan_cache import (
+            MEMO_SLOTS,
+            windows_nbytes,
+        )
+
+        w = DeviceBatch(
+            columns={"a": np.zeros(256, np.int32),
+                     "b": np.zeros(256, np.float32),
+                     "c": np.zeros(256, np.int32)},
+            encodings={}, n_valid=100, capacity=256)
+        got = windows_nbytes([w])
+        assert got == 3 * 4 * 256 + MEMO_SLOTS * (256 * 4 + 128)
 
     def test_disabled_cache(self):
         async def go():
