@@ -20,6 +20,7 @@ combine correctly under collectives, NaNs would not.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -158,16 +159,52 @@ def finalize_aggregate(partial: dict, which: tuple = ALL_AGGS) -> dict:
     return out
 
 
+_IMPLS = ("xla", "pallas")
+_impl = "xla"
+
+
+def set_downsample_impl(name: str) -> None:
+    """Select the fused downsample implementation: "xla" (segment ops,
+    the default) or "pallas" (ops.pallas_kernels compare-broadcast
+    kernel; interpret mode is used automatically off-TPU).  The default
+    flips only when the hardware benchmark says the kernel wins —
+    measured, not assumed."""
+    if name not in _IMPLS:
+        raise ValueError(f"unknown downsample impl {name!r}; "
+                         f"expected one of {_IMPLS}")
+    global _impl
+    _impl = name
+
+
+# route the env knob through the setter so typos fail at import instead
+# of silently running the XLA path
+set_downsample_impl(os.environ.get("HORAEDB_DOWNSAMPLE_IMPL", "xla"))
+
+
 def time_bucket_aggregate(ts_offset: jax.Array, group_ids: jax.Array,
                           values: jax.Array, n_valid, bucket_ms,
                           num_groups: int, num_buckets: int,
                           which: tuple = ALL_AGGS) -> dict:
     """See _time_bucket_aggregate_impl; this thin wrapper canonicalizes
-    `which` so permutations/duplicates share one compiled program."""
+    `which` so permutations/duplicates share one compiled program, and
+    dispatches to the Pallas kernel when selected."""
+    which = tuple(sorted(set(which)))
+    unknown = set(which) - set(ALL_AGGS)
+    if unknown:
+        raise ValueError(f"unknown aggregates {sorted(unknown)}; "
+                         f"supported: {ALL_AGGS}")
+    if _impl == "pallas":
+        from horaedb_tpu.ops.pallas_kernels import (
+            pallas_time_bucket_aggregate,
+        )
+
+        return pallas_time_bucket_aggregate(
+            ts_offset, group_ids, values, n_valid, bucket_ms,
+            num_groups=num_groups, num_buckets=num_buckets, which=which,
+            interpret=jax.devices()[0].platform != "tpu")
     return _time_bucket_aggregate_impl(
         ts_offset, group_ids, values, n_valid, bucket_ms,
-        num_groups=num_groups, num_buckets=num_buckets,
-        which=tuple(sorted(set(which))))
+        num_groups=num_groups, num_buckets=num_buckets, which=which)
 
 
 @functools.partial(jax.jit, static_argnames=("num_groups", "num_buckets",

@@ -35,9 +35,18 @@ BLOCK_ROWS = 1024
 CELL_TILE = 512
 
 
-def _agg_kernel(meta_ref, ts_ref, gid_ref, val_ref,
-                sum_ref, cnt_ref, min_ref, max_ref, *,
-                num_groups: int, num_buckets: int, cell_tile: int):
+_I32_MIN = -(2**31)
+# output field order; a `fields` subset (static per compile) selects
+# which accumulators exist at all
+_FIELDS = ("count", "sum", "min", "max", "last_ts", "last")
+_INIT = {"count": 0.0, "sum": 0.0, "min": _F32_MAX, "max": -_F32_MAX,
+         "last_ts": _I32_MIN, "last": 0.0}
+
+
+def _agg_kernel(meta_ref, ts_ref, gid_ref, val_ref, *out_refs,
+                num_groups: int, num_buckets: int, cell_tile: int,
+                fields: tuple):
+    refs = dict(zip(fields, out_refs))
     ri = pl.program_id(1)
     ci = pl.program_id(0)
     n_valid = meta_ref[0]
@@ -45,10 +54,8 @@ def _agg_kernel(meta_ref, ts_ref, gid_ref, val_ref,
 
     @pl.when(ri == 0)
     def _init():
-        sum_ref[...] = jnp.zeros_like(sum_ref)
-        cnt_ref[...] = jnp.zeros_like(cnt_ref)
-        min_ref[...] = jnp.full_like(min_ref, _F32_MAX)
-        max_ref[...] = jnp.full_like(max_ref, -_F32_MAX)
+        for name, ref in refs.items():
+            ref[...] = jnp.full_like(ref, _INIT[name])
 
     block_rows = ts_ref.shape[1]
     ts = ts_ref[0, :]
@@ -70,23 +77,65 @@ def _agg_kernel(meta_ref, ts_ref, gid_ref, val_ref,
     member = (cell[None, :] == tile_cells) & in_grid[None, :]
 
     vals2d = jnp.broadcast_to(val[None, :], (cell_tile, block_rows))
-    sum_ref[0, :] += jnp.sum(jnp.where(member, vals2d, 0.0), axis=1)
-    cnt_ref[0, :] += jnp.sum(member.astype(jnp.float32), axis=1)
-    min_ref[0, :] = jnp.minimum(
-        min_ref[0, :], jnp.min(jnp.where(member, vals2d, _F32_MAX), axis=1))
-    max_ref[0, :] = jnp.maximum(
-        max_ref[0, :], jnp.max(jnp.where(member, vals2d, -_F32_MAX), axis=1))
+    refs["count"][0, :] += jnp.sum(member.astype(jnp.float32), axis=1)
+    if "sum" in refs:
+        refs["sum"][0, :] += jnp.sum(jnp.where(member, vals2d, 0.0), axis=1)
+    if "min" in refs:
+        refs["min"][0, :] = jnp.minimum(
+            refs["min"][0, :],
+            jnp.min(jnp.where(member, vals2d, _F32_MAX), axis=1))
+    if "max" in refs:
+        refs["max"][0, :] = jnp.maximum(
+            refs["max"][0, :],
+            jnp.max(jnp.where(member, vals2d, -_F32_MAX), axis=1))
+    if "last" in refs:
+        # `last` = value at the max ts per cell, later row winning ties.
+        # Within the block: pick the member row with max (ts, row) — row
+        # ids are distinct, so a one-hot on max row-at-max-ts is exact.
+        ts2d = jnp.where(member, jnp.broadcast_to(ts[None, :],
+                                                  (cell_tile, block_rows)),
+                         _I32_MIN)
+        blk_ts = jnp.max(ts2d, axis=1)
+        at_max = member & (ts2d == blk_ts[:, None])
+        rows2d = jnp.broadcast_to(row_ids[None, :], (cell_tile, block_rows))
+        rank = jnp.where(at_max, rows2d, -1)
+        best = jnp.max(rank, axis=1)
+        one_hot = at_max & (rank == best[:, None])
+        blk_val = jnp.sum(jnp.where(one_hot, vals2d, 0.0), axis=1)
+        blk_has = jnp.any(member, axis=1)
+        # rows arrive in increasing row order across blocks, so a later
+        # block with an equal max ts must win — mirror the XLA tie-break
+        take = blk_has & (blk_ts >= refs["last_ts"][0, :])
+        refs["last_ts"][0, :] = jnp.where(take, blk_ts,
+                                          refs["last_ts"][0, :])
+        refs["last"][0, :] = jnp.where(take, blk_val, refs["last"][0, :])
 
 
 @functools.partial(jax.jit, static_argnames=("num_groups", "num_buckets",
-                                             "interpret"))
+                                             "which", "interpret"))
 def pallas_time_bucket_aggregate(ts_offset: jax.Array, group_ids: jax.Array,
                                  values: jax.Array, n_valid, bucket_ms,
                                  num_groups: int, num_buckets: int,
+                                 which: tuple = None,
                                  interpret: bool = False) -> dict:
-    """Pallas twin of ops.downsample.time_bucket_aggregate (sum/count/
-    min/max/avg; no `last`).  Same contract: int32 ts offsets and group
-    codes, capacity-padded, rows [0, n_valid) real."""
+    """Pallas twin of ops.downsample.time_bucket_aggregate, including
+    `last` (value at max ts per cell, later row winning ties).  Same
+    contract: int32 ts offsets and group codes, capacity-padded, rows
+    [0, n_valid) real.  `which` (static) limits the accumulators the
+    kernel materializes — cost scales with the requested aggregates,
+    like the XLA path."""
+    from horaedb_tpu.ops import downsample
+
+    which = tuple(sorted(set(which))) if which is not None \
+        else downsample.ALL_AGGS
+    want = set(which)
+    if "avg" in want:
+        want.add("sum")
+    if "last" in want:
+        want.add("last_ts")
+    want.add("count")
+    fields = tuple(f for f in _FIELDS if f in want)
+
     capacity = ts_offset.shape[0]
     num_cells = num_groups * num_buckets
     cells_padded = pl.cdiv(num_cells, CELL_TILE) * CELL_TILE
@@ -102,32 +151,33 @@ def pallas_time_bucket_aggregate(ts_offset: jax.Array, group_ids: jax.Array,
     grid = (cells_padded // CELL_TILE, rows_padded // BLOCK_ROWS)
     row_spec = pl.BlockSpec((1, BLOCK_ROWS), lambda ci, ri: (0, ri))
     out_spec = pl.BlockSpec((1, CELL_TILE), lambda ci, ri: (0, ci))
-    out_shape = jax.ShapeDtypeStruct((1, cells_padded), jnp.float32)
+    out_f32 = jax.ShapeDtypeStruct((1, cells_padded), jnp.float32)
+    out_i32 = jax.ShapeDtypeStruct((1, cells_padded), jnp.int32)
 
     kernel = functools.partial(_agg_kernel, num_groups=num_groups,
-                               num_buckets=num_buckets, cell_tile=CELL_TILE)
+                               num_buckets=num_buckets,
+                               cell_tile=CELL_TILE, fields=fields)
     meta_spec = pl.BlockSpec((2,), lambda ci, ri: (0,),
                              memory_space=pltpu.SMEM)
-    sums, counts, mins, maxs = pl.pallas_call(
+    outs = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[meta_spec, row_spec, row_spec, row_spec],
-        out_specs=[out_spec] * 4,
-        out_shape=[out_shape] * 4,
+        out_specs=[out_spec] * len(fields),
+        out_shape=[out_i32 if f == "last_ts" else out_f32
+                   for f in fields],
         interpret=interpret,
     )(meta, ts2, gid2, val2)
 
     grid_of = lambda a: a[0, :num_cells].reshape(num_groups, num_buckets)
-    count = grid_of(counts)
-    empty = count == 0
-    nan = jnp.float32(jnp.nan)
-    total = grid_of(sums)
-    inf = jnp.float32(jnp.inf)
-    # empty-cell identities match the XLA path (+inf/-inf, not +/-F32_MAX)
-    return {
-        "count": count,
-        "sum": total,
-        "min": jnp.where(empty, inf, grid_of(mins)),
-        "max": jnp.where(empty, -inf, grid_of(maxs)),
-        "avg": jnp.where(empty, nan, total / jnp.maximum(count, 1.0)),
-    }
+    # shaped exactly like a combined XLA partial so finalize_aggregate
+    # is the single emission rule; empty-cell min/max convert from the
+    # kernel's +/-F32_MAX accumulator identity to the segment-op
+    # identity (+/-inf) the XLA path produces
+    partial = {f: grid_of(a) for f, a in zip(fields, outs)}
+    empty = partial["count"] == 0
+    if "min" in partial:
+        partial["min"] = jnp.where(empty, jnp.inf, partial["min"])
+    if "max" in partial:
+        partial["max"] = jnp.where(empty, -jnp.inf, partial["max"])
+    return downsample.finalize_aggregate(partial, which=which)

@@ -44,6 +44,71 @@ def test_matches_xla_path(seed, n, G, B):
     np.testing.assert_allclose(np.asarray(got["avg"])[occ],
                                np.asarray(ref["avg"])[occ], rtol=1e-5)
     assert np.isnan(np.asarray(got["avg"])[~occ]).all()
+    # `last`: exact row selection must match the XLA path, including
+    # later-row tie-breaks on duplicate timestamps
+    np.testing.assert_array_equal(np.asarray(got["last"])[occ],
+                                  np.asarray(ref["last"])[occ])
+    assert np.isnan(np.asarray(got["last"])[~occ]).all()
+
+
+def test_impl_switch_dispatches_to_pallas():
+    """set_downsample_impl('pallas') routes the public op through the
+    kernel (interpret off-TPU) with identical results and the same
+    `which` key filtering as the XLA path."""
+    from horaedb_tpu.ops import downsample
+
+    rng = np.random.default_rng(5)
+    n, G, B = 700, 5, 9
+    cap = pad_capacity(n)
+    ts = np.pad(rng.integers(0, B * 60_000, n).astype(np.int32),
+                (0, cap - n))
+    gid = np.pad(rng.integers(0, G, n).astype(np.int32), (0, cap - n))
+    vals = np.pad((rng.random(n) * 10).astype(np.float32), (0, cap - n))
+    args = (jnp.asarray(ts), jnp.asarray(gid), jnp.asarray(vals), n, 60_000)
+
+    ref = time_bucket_aggregate(*args, num_groups=G, num_buckets=B,
+                                which=("avg", "last"))
+    downsample.set_downsample_impl("pallas")
+    try:
+        got = time_bucket_aggregate(*args, num_groups=G, num_buckets=B,
+                                    which=("avg", "last"))
+    finally:
+        downsample.set_downsample_impl("xla")
+    assert set(got) == set(ref) == {"count", "avg", "last"}
+    occ = np.asarray(ref["count"]) > 0
+    np.testing.assert_array_equal(np.asarray(got["count"]),
+                                  np.asarray(ref["count"]))
+    np.testing.assert_allclose(np.asarray(got["avg"])[occ],
+                               np.asarray(ref["avg"])[occ], rtol=1e-5)
+    np.testing.assert_array_equal(np.asarray(got["last"])[occ],
+                                  np.asarray(ref["last"])[occ])
+
+    import pytest
+
+    with pytest.raises(ValueError):
+        downsample.set_downsample_impl("tensorflow")
+
+
+def test_last_tie_breaks_to_later_row_across_blocks():
+    """Duplicate max-ts rows split across row blocks: the LATER row's
+    value must win (XLA semantics)."""
+    from horaedb_tpu.ops.pallas_kernels import BLOCK_ROWS
+
+    cap = 2 * BLOCK_ROWS
+    ts = np.zeros(cap, dtype=np.int32)
+    gid = np.zeros(cap, dtype=np.int32)
+    vals = np.arange(cap, dtype=np.float32)
+    # same (group, ts) for every row; the winner must be the last valid
+    # row, which lives in the SECOND block
+    n = BLOCK_ROWS + 5
+    got = pallas_time_bucket_aggregate(
+        jnp.asarray(ts), jnp.asarray(gid), jnp.asarray(vals), n, 100,
+        num_groups=1, num_buckets=1, interpret=True)
+    assert float(np.asarray(got["last"])[0, 0]) == float(n - 1)
+    ref = time_bucket_aggregate(jnp.asarray(ts), jnp.asarray(gid),
+                                jnp.asarray(vals), n, 100,
+                                num_groups=1, num_buckets=1)
+    assert float(np.asarray(ref["last"])[0, 0]) == float(n - 1)
 
 
 def test_oversized_gid_dropped_not_wrapped():
