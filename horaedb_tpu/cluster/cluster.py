@@ -44,7 +44,12 @@ class Cluster:
                    num_regions: int = 2,
                    segment_ms: int = 2 * 3600 * 1000,
                    config: Optional[StorageConfig] = None,
-                   routing: Optional[RoutingTable] = None) -> "Cluster":
+                   routing: Optional[RoutingTable] = None,
+                   serve: Optional[set] = None) -> "Cluster":
+        """`serve` limits which regions get LOCAL engines (default: all
+        in the routing table).  A node joining an existing cluster must
+        pass the set it owns — opening a region another node is serving
+        would race its manifest merger."""
         from horaedb_tpu.objstore import NotFoundError
 
         if routing is None:
@@ -57,6 +62,8 @@ class Cluster:
                 routing = RoutingTable.uniform(list(range(num_regions)))
         regions = {}
         for rid in routing.region_ids():
+            if serve is not None and rid not in serve:
+                continue
             regions[rid] = await MetricEngine.open(
                 f"{root_path}/region_{rid}", store, segment_ms=segment_ms,
                 config=config)
@@ -105,6 +112,58 @@ class Cluster:
         ensure(region_id not in self.regions, f"region {region_id} exists")
         self.regions[region_id] = backend
 
+    # ---- region movement --------------------------------------------------
+
+    async def detach_region(self, region_id: int) -> None:
+        """Stop serving a region locally so another node can adopt it.
+
+        The region's data lives in the SHARED object store, so moving a
+        region is an ownership handoff, not a data copy: the source
+        closes its engine (flushing manifests), the new owner opens one
+        over the same paths.  Routing is unchanged; operations routed
+        here fail loudly until a backend is re-attached
+        (add_remote_region pointing at the new owner, or adopt_region
+        to take it back)."""
+        ensure(region_id in self.regions, f"region {region_id} not served")
+        engine = self.regions.pop(region_id)
+        close = getattr(engine, "close", None)
+        if close is not None:
+            await close()
+
+    async def adopt_region(self, region_id: int) -> None:
+        """Take over serving a region from the shared object store —
+        the destination half of a region move.  Replaces a remote proxy
+        if one was attached (closing it); recovery (manifest snapshot +
+        delta fold) happens in MetricEngine.open, so an owner that
+        crashed without detaching cleanly is still adoptable."""
+        old = self.regions.get(region_id)
+        ensure(not isinstance(old, MetricEngine),
+               f"region {region_id} is already served locally")
+        # open FIRST: a failed open must leave any existing proxy
+        # attached rather than the region backend-less
+        self.regions.pop(region_id, None)
+        try:
+            await self.add_region(region_id)
+        except BaseException:
+            if old is not None:
+                self.regions[region_id] = old
+            raise
+        if old is not None:
+            close = getattr(old, "close", None)
+            if close is not None:
+                await close()
+
+    def region_loads(self) -> dict[int, int]:
+        """Rebalancing signal for THIS node: routing rules per region it
+        serves (proxies count too); detached regions are absent.
+        Operators move regions off nodes whose rule share is
+        disproportionate; data sizes come from the store's metrics."""
+        loads: dict[int, int] = {rid: 0 for rid in self.regions}
+        for rule in self.routing.rules:
+            if rule.region_id in loads:
+                loads[rule.region_id] += 1
+        return loads
+
     # ---- write ------------------------------------------------------------
 
     async def write(self, samples: list[Sample]) -> None:
@@ -131,16 +190,25 @@ class Cluster:
                        time_range: TimeRange) -> list[int]:
         # a query pins to one key only if the filters form a full series
         # key, which we can't know without the schema — so fan out to all
-        # rules alive for the window (RFC accepts全 Region scatter)
-        return self.routing.route_query(None, int(time_range.start),
+        # rules alive for the window (RFC accepts full-region scatter).
+        # Every routed region must have an attached backend: silently
+        # skipping one (e.g. detached mid-move) would return PARTIAL
+        # data with no indication.
+        rids = self.routing.route_query(None, int(time_range.start),
                                         int(time_range.end))
+        missing = [rid for rid in rids if rid not in self.regions]
+        ensure(not missing,
+               f"query routes to regions {missing} with no attached "
+               "backend (moved/detached?); attach via add_remote_region "
+               "or adopt_region")
+        return rids
 
     async def query(self, metric: str, filters: list[tuple[str, str]],
                     time_range: TimeRange, field: str = "value") -> pa.Table:
         rids = self._query_regions(metric, filters, time_range)
         tables = await asyncio.gather(*(
             self.regions[rid].query(metric, filters, time_range, field=field)
-            for rid in rids if rid in self.regions))
+            for rid in rids))
         # all regions share one result schema, so concat handles the
         # empty case too — no refetch needed
         return pa.concat_tables(tables)
@@ -157,7 +225,7 @@ class Cluster:
         results = await asyncio.gather(*(
             self.regions[rid].query_downsample(metric, filters, time_range,
                                                bucket_ms, field=field)
-            for rid in rids if rid in self.regions))
+            for rid in rids))
         results = [r for r in results if r["tsids"]]
         num_buckets = -(-(int(time_range.end) - int(time_range.start))
                         // bucket_ms)
@@ -207,7 +275,7 @@ class Cluster:
         rids = self._query_regions(metric, [], time_range)
         results = await asyncio.gather(*(
             self.regions[rid].label_values(metric, tag_key, time_range)
-            for rid in rids if rid in self.regions))
+            for rid in rids))
         out: set[str] = set()
         for r in results:
             out.update(r)

@@ -151,6 +151,65 @@ class TestCluster:
         asyncio.run(go())
 
 
+class TestRegionMove:
+    def test_detach_then_adopt_on_another_node(self):
+        """Region move = ownership handoff over the shared object store:
+        node A detaches, node B adopts, data continuity holds, and A
+        fails loudly while un-attached."""
+        async def go():
+            store = MemoryObjectStore()
+            a = await Cluster.open("cluster", store, num_regions=2,
+                                   segment_ms=2 * HOUR)
+            b = None
+            try:
+                samples = [
+                    sample("cpu", [("host", f"h{i:03d}")], T0 + 1000,
+                           float(i))
+                    for i in range(64)
+                ]
+                await a.write(samples)
+                rng = TimeRange.new(T0, T0 + HOUR)
+                before = sorted(
+                    (await a.query("cpu", [], rng)).column("value")
+                    .to_pylist())
+
+                moved = 1
+                await a.detach_region(moved)
+                # A can no longer serve writes routed to the moved region
+                with pytest.raises(Error, match="unprovisioned"):
+                    await a.write(samples)
+                # ...and reads fail LOUDLY instead of silently returning
+                # partial data
+                with pytest.raises(Error, match="no attached backend"):
+                    await a.query("cpu", [], rng)
+
+                # B (sharing the store, serving nothing yet) adopts and
+                # serves the region's full history
+                b = await Cluster.open("cluster", store, num_regions=2,
+                                       segment_ms=2 * HOUR, serve=set())
+                await b.adopt_region(moved)
+                r = await b.regions[moved].query("cpu", [], rng)
+                assert r.num_rows > 0
+                # adopting an already-local region is rejected
+                with pytest.raises(Error, match="already served"):
+                    await b.adopt_region(moved)
+
+                # A takes it back after B lets go: full round trip
+                await b.detach_region(moved)
+                await a.adopt_region(moved)
+                after = sorted(
+                    (await a.query("cpu", [], rng)).column("value")
+                    .to_pylist())
+                assert after == before
+                assert set(a.region_loads()) == {0, 1}
+            finally:
+                await a.close()
+                if b is not None:
+                    await b.close()
+
+        asyncio.run(go())
+
+
 class TestStrictTimeRouting:
     def test_strict_prunes_post_window_rules(self):
         rt = RoutingTable.uniform([1])
