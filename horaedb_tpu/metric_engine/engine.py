@@ -564,18 +564,36 @@ class MetricEngine:
             await self._write_arrow_chunked(mid, fid, codes, tsid_of_code,
                                             ts_np, val_np)
             return
-        for seg in np.unique(seg_ids):
-            m = seg_ids == seg
-            seg_ts = ts_np[m]
-            out = pa.record_batch(
-                [pa.array(np.full(int(m.sum()), mid, dtype=np.uint64)),
-                 pa.array(tsids[m]),
-                 pa.array(np.full(int(m.sum()), fid, dtype=np.uint64)),
-                 pa.array(seg_ts, type=pa.int64()),
-                 pa.array(val_np[m], type=pa.float64())],
-                schema=data.schema().user_schema)
-            await data.write(WriteRequest(
-                out, TimeRange.new(int(seg_ts.min()), int(seg_ts.max()) + 1)))
+        # per-segment SST writes are independent (one file + one
+        # manifest delta each): overlap them with bounded concurrency so
+        # a batch spanning many segments isn't serialized on parquet
+        # encode round trips.  The mask is built INSIDE the permit (at
+        # most 4 row masks live at once) and a TaskGroup settles every
+        # sibling before a failure propagates — no write may still be
+        # running after write_arrow raises.
+        sem = asyncio.Semaphore(4)
+
+        async def write_segment(seg: int) -> None:
+            async with sem:
+                m = seg_ids == seg
+                seg_ts = ts_np[m]
+                out = pa.record_batch(
+                    [pa.array(np.full(int(m.sum()), mid, dtype=np.uint64)),
+                     pa.array(tsids[m]),
+                     pa.array(np.full(int(m.sum()), fid, dtype=np.uint64)),
+                     pa.array(seg_ts, type=pa.int64()),
+                     pa.array(val_np[m], type=pa.float64())],
+                    schema=data.schema().user_schema)
+                await data.write(WriteRequest(
+                    out,
+                    TimeRange.new(int(seg_ts.min()), int(seg_ts.max()) + 1)))
+
+        try:
+            async with asyncio.TaskGroup() as tg:
+                for seg in np.unique(seg_ids):
+                    tg.create_task(write_segment(int(seg)))
+        except* Error as eg:
+            raise eg.exceptions[0]
 
     async def _write_arrow_chunked(self, mid, fid, codes, tsid_of_code,
                                    ts_np, val_np) -> None:
