@@ -139,6 +139,17 @@ class ParquetReader:
         cache_bytes = (config.scan.cache_max_bytes
                        or config.scan.cache_max_rows * _CACHE_BYTES_PER_ROW)
         self.scan_cache = ScanCache(cache_bytes)
+        # flush-stack LRU: stacked (B, cap) aggregation inputs reused by
+        # repeat queries over cached windows.  Separately byte-accounted
+        # (stacks are far larger than the per-window memo allowance) and
+        # LRU-evicted so a changed round composition can't pin dead HBM.
+        import threading
+        from collections import OrderedDict
+
+        self._stack_cache: "OrderedDict[tuple, tuple]" = OrderedDict()
+        self._stack_cache_bytes = 0
+        self._stack_cache_max = cache_bytes // 4
+        self._stack_cache_lock = threading.Lock()
         self.mesh = None
         self._mesh_agg_fns: dict = {}
         self._mesh_merge_fns: dict = {}
@@ -865,7 +876,7 @@ class ParquetReader:
 
         async def flush(k: int) -> None:
             flushed = await self._run_pool(
-                plan.pool, self._flush_window_batch, queue[:k], spec)
+                plan.pool, self._flush_window_batch, queue[:k], spec, plan)
             for seg_start, part in flushed:
                 parts[seg_start].append(part)
                 pending[seg_start] -= 1
@@ -977,6 +988,38 @@ class ParquetReader:
             uniq, out_batch.encodings[spec.group_col])
         return group_values, jnp.asarray(gid_full), shift
 
+    def _stack_cache_get(self, key: tuple, windows_now: tuple):
+        with self._stack_cache_lock:
+            entry = self._stack_cache.get(key)
+            if entry is None:
+                return None
+            stored_windows, arrays, nbytes = entry
+            if len(stored_windows) != len(windows_now) or not all(
+                    a is b for a, b in zip(stored_windows, windows_now)):
+                # same key, different round composition (windows were
+                # re-read): the stale stack is dead HBM — drop it now
+                del self._stack_cache[key]
+                self._stack_cache_bytes -= nbytes
+                return None
+            self._stack_cache.move_to_end(key)
+            return arrays
+
+    def _stack_cache_put(self, key: tuple, windows_now: tuple,
+                         arrays: tuple) -> None:
+        nbytes = sum(int(a.nbytes) for a in arrays)
+        with self._stack_cache_lock:
+            if nbytes > self._stack_cache_max:
+                return
+            old = self._stack_cache.pop(key, None)
+            if old is not None:
+                self._stack_cache_bytes -= old[2]
+            self._stack_cache[key] = (windows_now, arrays, nbytes)
+            self._stack_cache_bytes += nbytes
+            while (self._stack_cache_bytes > self._stack_cache_max
+                   and self._stack_cache):
+                _, (_, _, evicted) = self._stack_cache.popitem(last=False)
+                self._stack_cache_bytes -= evicted
+
     def _window_grid_width(self, spec: AggregateSpec) -> int:
         """Static per-window grid width: a window's rows span at most one
         segment, so its buckets span at most segment_ms/bucket_ms (+2
@@ -989,7 +1032,8 @@ class ParquetReader:
         return int(min(spec.num_buckets,
                        max(8, 1 << (need - 1).bit_length())))
 
-    def _flush_window_batch(self, items: list, spec: AggregateSpec) -> list:
+    def _flush_window_batch(self, items: list, spec: AggregateSpec,
+                            plan: ScanPlan) -> list:
         """Aggregate one round of windows (possibly from several
         segments) as a single compiled program, staying device-resident
         between merge and aggregate.
@@ -1019,36 +1063,58 @@ class ParquetReader:
         width = self._window_grid_width(spec) if local_ok \
             else spec.num_buckets
 
-        ts_rows, gid_rows, val_rows = [], [], []
-        remap = np.zeros((batch_w, g_pad), dtype=np.int32)
-        shift = np.zeros(batch_w, dtype=np.int32)
-        lo = np.zeros(batch_w, dtype=np.int32)
-        for d, (_seg_start, w, (values, gid_dev, sh)) in enumerate(items):
-            ts_d = w.columns[spec.ts_col]
-            val_d = w.columns[spec.value_col]
-            if w.capacity < cap:
-                pad_n = cap - w.capacity
-                ts_d = jnp.pad(ts_d, (0, pad_n))
-                gid_dev = jnp.pad(gid_dev, (0, pad_n), constant_values=-1)
-                val_d = jnp.pad(val_d, (0, pad_n))
-            ts_rows.append(ts_d)
-            gid_rows.append(gid_dev)
-            val_rows.append(val_d)
-            remap[d, : len(values)] = np.searchsorted(round_values, values)
-            shift[d] = sh
-            if local_ok:
-                lo[d] = max(0, sh // spec.bucket_ms)
-        if len(items) < batch_w:  # pad the round with no-op windows
-            empty_gid = jnp.full(cap, -1, dtype=jnp.int32)
-            zeros_i = jnp.zeros(cap, dtype=jnp.int32)
-            zeros_f = jnp.zeros(cap, dtype=jnp.float32)
-            for _ in range(batch_w - len(items)):
-                ts_rows.append(zeros_i)
-                gid_rows.append(empty_gid)
-                val_rows.append(zeros_f)
-        ts_s = jnp.stack(ts_rows)
-        gid_s = jnp.stack(gid_rows)
-        val_s = jnp.stack(val_rows)
+        # Stacked inputs are memoized in a reader-level LRU: for repeat
+        # queries over scan-cached windows the (B, cap) stacks, remap
+        # matrix, and shifts are identical, so rebuilding them (3 stack
+        # copies + pads per flush) is pure waste.  The entry carries the
+        # round's window OBJECTS: a hit requires the exact same
+        # DeviceBatches (object identity — stable while scan-cached),
+        # which both prevents id-reuse collisions and makes entries
+        # self-invalidating; byte accounting and eviction live in
+        # _stack_cache_put, independent of the per-window memo budget.
+        stack_key = (items[0][0], spec.group_col, spec.ts_col,
+                     spec.value_col, spec.bucket_ms, spec.range_start,
+                     batch_w, cap, g_pad, width,
+                     filter_ops.canonical_predicate_key(plan.predicate))
+        windows_now = tuple(it[1] for it in items)
+        cached_stack = self._stack_cache_get(stack_key, windows_now)
+        if cached_stack is not None:
+            ts_s, gid_s, val_s, remap, shift, lo = cached_stack
+        else:
+            ts_rows, gid_rows, val_rows = [], [], []
+            remap = np.zeros((batch_w, g_pad), dtype=np.int32)
+            shift = np.zeros(batch_w, dtype=np.int32)
+            lo = np.zeros(batch_w, dtype=np.int32)
+            for d, (_seg_start, w, (values, gid_dev, sh)) in enumerate(items):
+                ts_d = w.columns[spec.ts_col]
+                val_d = w.columns[spec.value_col]
+                if w.capacity < cap:
+                    pad_n = cap - w.capacity
+                    ts_d = jnp.pad(ts_d, (0, pad_n))
+                    gid_dev = jnp.pad(gid_dev, (0, pad_n),
+                                      constant_values=-1)
+                    val_d = jnp.pad(val_d, (0, pad_n))
+                ts_rows.append(ts_d)
+                gid_rows.append(gid_dev)
+                val_rows.append(val_d)
+                remap[d, : len(values)] = np.searchsorted(round_values,
+                                                          values)
+                shift[d] = sh
+                if local_ok:
+                    lo[d] = max(0, sh // spec.bucket_ms)
+            if len(items) < batch_w:  # pad the round with no-op windows
+                empty_gid = jnp.full(cap, -1, dtype=jnp.int32)
+                zeros_i = jnp.zeros(cap, dtype=jnp.int32)
+                zeros_f = jnp.zeros(cap, dtype=jnp.float32)
+                for _ in range(batch_w - len(items)):
+                    ts_rows.append(zeros_i)
+                    gid_rows.append(empty_gid)
+                    val_rows.append(zeros_f)
+            ts_s = jnp.stack(ts_rows)
+            gid_s = jnp.stack(gid_rows)
+            val_s = jnp.stack(val_rows)
+            self._stack_cache_put(stack_key, windows_now,
+                                  (ts_s, gid_s, val_s, remap, shift, lo))
         total = jnp.int32(spec.num_buckets)
 
         if self.mesh is not None:
