@@ -223,6 +223,9 @@ def run_engine_headline(rows: int, iters: int) -> dict:
         "cpu_baseline_p50_ms": round(cpu_p50 * 1e3, 3),
         "compile_first_s": round(compile_s, 2),
         "rows": n,
+        # the BASELINE metric is "rows scanned/sec/chip"
+        "rows_per_s_cached": round(n / cached_p50),
+        "rows_per_s_cold": round(n / cold_p50),
     }
 
 

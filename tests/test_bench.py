@@ -40,3 +40,17 @@ def test_suite_configs_run(config):
     assert result["unit"] == "ms"
     assert result["value"] > 0
     assert result["vs_baseline"] > 0
+
+
+def test_engine_headline_runs():
+    """The DRIVER's default config (end-to-end engine query) must run
+    and self-validate at tiny scale — a failure here is a failed
+    BENCH_r0N."""
+    import bench  # repo root is on sys.path via conftest
+
+    result = bench.run_engine_headline(rows=30_000, iters=2)
+    assert result["unit"] == "ms"
+    assert result["value"] > 0 and result["cold_p50_ms"] > 0
+    assert result["rows"] == 30_000
+    assert result["vs_baseline"] > 0 and result["cold_vs_baseline"] > 0
+    assert result["rows_per_s_cached"] > 0 and result["rows_per_s_cold"] > 0
