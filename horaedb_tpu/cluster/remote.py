@@ -71,30 +71,27 @@ class RemoteRegion:
     async def write_arrow(self, metric: str, tag_columns: list[str],
                           batch: pa.RecordBatch,
                           field: str = "value") -> None:
-        """Bulk ingest over the Arrow-IPC data plane."""
-        import io
+        """Bulk ingest over the Arrow-IPC data plane (zstd buffers for
+        the DCN hop; the server's pyarrow reader auto-detects)."""
+        from horaedb_tpu.common.ipc import serialize_stream
 
-        import pyarrow.ipc
-
-        sink = io.BytesIO()
-        with pyarrow.ipc.new_stream(sink, batch.schema) as writer:
-            writer.write_batch(batch)
         await self._post_raw(
             "/write_arrow",
             params={"metric": metric, "tags": ",".join(tag_columns),
                     "field": field},
-            data=sink.getvalue(),
+            data=serialize_stream(batch, "zstd"),
             headers={"Content-Type": "application/vnd.apache.arrow.stream"})
 
     async def query(self, metric: str, filters: list[tuple[str, str]],
                     time_range: TimeRange, field: str = "value") -> pa.Table:
-        """Row queries ride the Arrow-IPC plane (no per-row JSON)."""
+        """Row queries ride the Arrow-IPC plane (no per-row JSON); the
+        region-to-region hop opts into zstd buffers."""
         import pyarrow.ipc
 
         body = await self._post_raw("/query_arrow", json={
             "metric": metric, "filters": [list(f) for f in filters],
             "start": int(time_range.start), "end": int(time_range.end),
-            "field": field})
+            "field": field, "compression": "zstd"})
         return pyarrow.ipc.open_stream(body).read_all()
 
     async def query_downsample(self, metric: str,

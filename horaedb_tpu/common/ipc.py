@@ -1,0 +1,31 @@
+"""Arrow IPC stream serialization — the single home for the cluster's
+wire format (write plane and query plane must not drift)."""
+
+from __future__ import annotations
+
+import io
+from typing import Optional, Union
+
+import pyarrow as pa
+import pyarrow.ipc
+
+COMPRESSIONS = (None, "zstd", "lz4")
+
+
+def serialize_stream(data: Union[pa.Table, pa.RecordBatch],
+                     compression: Optional[str] = None) -> bytes:
+    """Serialize a Table/RecordBatch as an IPC stream, optionally with
+    compressed buffers.  Compression is OPT-IN per message: readers
+    auto-detect, but not every Arrow implementation ships every codec,
+    so public endpoints only compress when the client asked."""
+    if compression not in COMPRESSIONS:
+        raise ValueError(f"unsupported IPC compression {compression!r}; "
+                         f"expected one of {COMPRESSIONS}")
+    sink = io.BytesIO()
+    opts = pyarrow.ipc.IpcWriteOptions(compression=compression)
+    with pyarrow.ipc.new_stream(sink, data.schema, options=opts) as writer:
+        if isinstance(data, pa.RecordBatch):
+            writer.write_batch(data)
+        else:
+            writer.write_table(data)
+    return sink.getvalue()

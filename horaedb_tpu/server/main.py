@@ -220,23 +220,24 @@ def build_app(state: ServerState) -> web.Application:
     async def query_arrow(req: web.Request) -> web.Response:
         """Like POST /query (raw rows) but the response body is an Arrow
         IPC stream — the symmetric read side of the Arrow data plane."""
-        import io
-
-        import pyarrow.ipc
+        from horaedb_tpu.common.ipc import COMPRESSIONS, serialize_stream
 
         try:
             body = await req.json()
             metric, filters, rng, field = _parse_query_body(body)
+            # compressed IPC buffers are OPT-IN ("compression": "zstd"):
+            # time-series columns compress well across DCN, but not
+            # every Arrow implementation ships every codec
+            compression = body.get("compression")
+            if compression not in COMPRESSIONS:
+                raise ValueError(f"unsupported compression {compression!r}")
         except (KeyError, TypeError, ValueError) as e:
             return web.json_response({"error": f"bad request: {e}"}, status=400)
         try:
             tbl = await state.engine.query(metric, filters, rng, field=field)
         except Error as e:
             return web.json_response({"error": str(e)}, status=400)
-        sink = io.BytesIO()
-        with pyarrow.ipc.new_stream(sink, tbl.schema) as writer:
-            writer.write_table(tbl)
-        return web.Response(body=sink.getvalue(),
+        return web.Response(body=serialize_stream(tbl, compression),
                             content_type="application/vnd.apache.arrow.stream")
 
     @routes.get("/label_names")
