@@ -14,9 +14,7 @@ from horaedb_tpu.parallel.scan import (
     sharded_downsample_query,
     sharded_merge_dedup,
     sharded_remap_partials,
-    sharded_window_partials,
 )
 
 __all__ = ["segment_mesh", "sharded_downsample_query",
-           "sharded_merge_dedup", "sharded_remap_partials",
-           "sharded_window_partials"]
+           "sharded_merge_dedup", "sharded_remap_partials"]

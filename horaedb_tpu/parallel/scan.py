@@ -108,32 +108,6 @@ _ROW_SPECS = (P(SEGMENT_AXIS, None), P(SEGMENT_AXIS, None),
               P(SEGMENT_AXIS, None), P(SEGMENT_AXIS), P())
 
 
-def sharded_window_partials(mesh, *, num_groups: int, num_buckets: int):
-    """Build the compiled multi-chip PARTIAL aggregation used by the
-    engine: every chip aggregates its window into a (groups, buckets)
-    grid; the per-shard grids come back stacked (n_devices, G, B) so the
-    host folds them in float64 — BIT-EQUAL to the single-device path
-    (an on-device f32 psum would drift; see sharded_downsample_query for
-    the collective variant used by all-on-device queries).
-
-    fn(ts, gid, vals, n_valid, bucket_ms): (n_devices, capacity) arrays
-    sharded on the leading axis; n_valid (n_devices,); bucket_ms (1,).
-    """
-
-    def shard_fn(ts, gid, vals, n_valid, bucket_ms):
-        p = _shard_partial(ts, gid, vals, n_valid, bucket_ms,
-                           num_groups=num_groups, num_buckets=num_buckets)
-        return {k: v[None] for k, v in p.items()}
-
-    mapped = shard_map(
-        shard_fn, mesh=mesh,
-        in_specs=_ROW_SPECS,
-        out_specs=P(SEGMENT_AXIS),
-        check_vma=False,
-    )
-    return jax.jit(mapped)
-
-
 def sharded_remap_partials(mesh, *, num_groups: int, num_buckets: int,
                            which: tuple = downsample.ALL_AGGS):
     """Batched multi-chip partial aggregation with the per-window group

@@ -150,6 +150,50 @@ class TestGuards:
                jnp.asarray([1000], dtype=jnp.int32))
 
 
+class TestShardedRemapPartials:
+    def test_window_local_grids_match_host(self, mesh):
+        """Each shard remaps its local gids into the union space, shifts
+        into query offsets, and aggregates a window-LOCAL grid starting
+        at its `lo` bucket; rows at/past `total` buckets drop."""
+        from horaedb_tpu.parallel import sharded_remap_partials
+
+        rng = np.random.default_rng(2)
+        W = 4  # local grid width
+        total = 20
+        ts = rng.integers(0, W * BUCKET, (NDEV, CAP)).astype(np.int32)
+        gid = rng.integers(-1, 3, (NDEV, CAP)).astype(np.int32)  # -1 drops
+        vals = (rng.random((NDEV, CAP)) * 10).astype(np.float32)
+        # each shard owns buckets [lo_d, lo_d + W) of the global range
+        lo = (np.arange(NDEV, dtype=np.int32) * 3) % (total + 2)
+        shift = (lo * BUCKET).astype(np.int32)
+        remap = np.tile(np.asarray([2, 0, 1], dtype=np.int32), (NDEV, 1))
+        remap = np.pad(remap, ((0, 0), (0, 5)))  # pad to g_pad=8
+
+        fn = sharded_remap_partials(mesh, num_groups=8, num_buckets=W)
+        out = fn(shard_leading_axis(mesh, ts),
+                 shard_leading_axis(mesh, gid),
+                 shard_leading_axis(mesh, vals),
+                 shard_leading_axis(mesh, remap),
+                 shard_leading_axis(mesh, shift),
+                 shard_leading_axis(mesh, lo),
+                 jnp.int32(total),
+                 jnp.asarray([BUCKET], dtype=jnp.int32))
+        counts = np.asarray(out["count"])
+        sums = np.asarray(out["sum"])
+        assert counts.shape == (NDEV, 8, W)
+        for d in range(NDEV):
+            b_local = ts[d] // BUCKET
+            b_global = b_local + lo[d]
+            ok = (gid[d] >= 0) & (b_global < total)
+            for u in range(3):
+                sel = ok & (remap[d][np.clip(gid[d], 0, 7)] == u)
+                for b in range(W):
+                    m = sel & (b_local == b)
+                    assert counts[d, u, b] == m.sum()
+                    np.testing.assert_allclose(
+                        sums[d, u, b], vals[d][m].sum(), rtol=1e-5)
+
+
 class TestEngineMeshAggregation:
     """The engine's multi-chip aggregate path folds per-shard partials on
     host in f64.  With identical windowing it matches the single-device
