@@ -741,7 +741,7 @@ class MetricEngine:
             # and aggregate the decoded columns on device
             tbl = await self.query(metric, filters, time_range, field=field)
             return self._downsample_rows(tbl, time_range, bucket_ms,
-                                         num_buckets)
+                                         num_buckets, which=tuple(aggs))
         pred = await self._resolve_data_predicate(metric, filters,
                                                   time_range, field)
         if pred is None:
@@ -758,7 +758,8 @@ class MetricEngine:
                 "aggs": aggs if len(group_values) else {}}
 
     def _downsample_rows(self, tbl: pa.Table, time_range: TimeRange,
-                         bucket_ms: int, num_buckets: int) -> dict:
+                         bucket_ms: int, num_buckets: int,
+                         which: tuple = ALL_AGGS) -> dict:
         import numpy as np
 
         from horaedb_tpu.ops.downsample import time_bucket_aggregate
@@ -775,16 +776,19 @@ class MetricEngine:
         pad = lambda a, d: np.pad(a.astype(d), (0, cap - n))
         aggs = time_bucket_aggregate(
             pad(ts_np, np.int32), pad(gid, np.int32), pad(val_np, np.float32),
-            n, bucket_ms, num_groups=len(uniq), num_buckets=num_buckets)
+            n, bucket_ms, num_groups=len(uniq), num_buckets=num_buckets,
+            which=which)
         host = {k: np.asarray(v) for k, v in aggs.items()}
-        # match the pushdown path's grid keys: per-cell max sample time
-        # (absolute ms as float, NaN for empty cells)
-        cell = gid.astype(np.int64) * num_buckets + ts_np // bucket_ms
-        last_ts = np.full(len(uniq) * num_buckets, -np.inf)
-        np.maximum.at(last_ts, cell, ts_np.astype(np.float64))
-        last_ts = last_ts.reshape(len(uniq), num_buckets)
-        host["last_ts"] = np.where(np.isinf(last_ts), np.nan,
-                                   last_ts + int(time_range.start))
+        if "last" in which:
+            # match the pushdown path's grid keys (it emits last_ts only
+            # alongside last): per-cell max sample time (absolute ms as
+            # float, NaN for empty cells)
+            cell = gid.astype(np.int64) * num_buckets + ts_np // bucket_ms
+            last_ts = np.full(len(uniq) * num_buckets, -np.inf)
+            np.maximum.at(last_ts, cell, ts_np.astype(np.float64))
+            last_ts = last_ts.reshape(len(uniq), num_buckets)
+            host["last_ts"] = np.where(np.isinf(last_ts), np.nan,
+                                       last_ts + int(time_range.start))
         return {"tsids": [int(t) for t in uniq],
                 "num_buckets": num_buckets, "aggs": host}
 

@@ -694,6 +694,12 @@ class TestChunkedDataMode:
                 assert out["aggs"]["count"].tolist() == [[5.0, 5.0]]
                 assert out["aggs"]["sum"].tolist() == [[10.0, 35.0]]
                 assert out["aggs"]["last"].tolist() == [[4.0, 9.0]]
+                # aggregate restriction applies on the chunked path too
+                sub = await e.query_downsample(
+                    "cpu", [], TimeRange.new(T0, T0 + 600_000),
+                    bucket_ms=300_000, aggs=("avg",))
+                assert "min" not in sub["aggs"] and "sum" not in sub["aggs"]
+                assert sub["aggs"]["avg"].tolist() == [[2.0, 7.0]]
             finally:
                 await e.close()
 
