@@ -33,7 +33,7 @@ from horaedb_tpu.common.time_ext import now_ms
 from horaedb_tpu.storage import parquet_io
 from horaedb_tpu.storage.manifest import ManifestUpdate
 from horaedb_tpu.storage.read import ScanRequest
-from horaedb_tpu.storage.sst import FileMeta, SstFile, sst_path
+from horaedb_tpu.storage.sst import FileMeta, SstFile, sst_path, segment_of
 from horaedb_tpu.storage.types import (
     RESERVED_COLUMN_NAME,
     Timestamp,
@@ -84,7 +84,7 @@ class TimeWindowCompactionStrategy:
 
         by_segment: dict[int, list[SstFile]] = {}
         for f in uncompacted:
-            seg = int(f.meta.time_range.start.truncate_by(self.segment_duration_ms))
+            seg = segment_of(f, self.segment_duration_ms)
             by_segment.setdefault(seg, []).append(f)
 
         inputs = self._pick_files(by_segment)

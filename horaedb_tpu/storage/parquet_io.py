@@ -15,7 +15,7 @@ from typing import Optional
 import pyarrow as pa
 import pyarrow.parquet as pq
 
-from horaedb_tpu.objstore import ObjectStore
+from horaedb_tpu.objstore import NotFoundError, ObjectStore
 from horaedb_tpu.storage.config import WriteConfig
 from horaedb_tpu.storage.types import StorageSchema
 
@@ -186,17 +186,26 @@ class SstSource:
 
     def read(self, columns: Optional[list[str]] = None,
              filters=None) -> pa.Table:
-        return pq.read_table(self._source(), columns=columns,
-                             memory_map=self._path is not None,
-                             filters=filters)
+        try:
+            return pq.read_table(self._source(), columns=columns,
+                                 memory_map=self._path is not None,
+                                 filters=filters)
+        except FileNotFoundError as e:
+            # local-path sources re-open per call; a compaction may have
+            # deleted the file — surface the store contract's error so
+            # callers can re-resolve/retry
+            raise NotFoundError(f"object not found: {self._path}") from e
 
     def value_counts(self, column: str) -> tuple:
         """(values, counts) of one column, streamed row-group-wise so
         host memory is bounded by row-group size + distinct values."""
         import numpy as np
 
-        pf = pq.ParquetFile(self._source(),
-                            memory_map=self._path is not None)
+        try:
+            pf = pq.ParquetFile(self._source(),
+                                memory_map=self._path is not None)
+        except FileNotFoundError as e:
+            raise NotFoundError(f"object not found: {self._path}") from e
         acc = (np.asarray([]), np.asarray([], dtype=np.int64))
         try:
             for batch in pf.iter_batches(columns=[column]):
@@ -228,8 +237,15 @@ async def read_sst(store: ObjectStore, path: str,
     """
     local_path = getattr(store, "local_path", None)
     if local_path is not None:
-        return await _run(runtimes, pool, pq.read_table, local_path(path),
-                          columns=columns, memory_map=True, filters=filters)
+        try:
+            return await _run(runtimes, pool, pq.read_table,
+                              local_path(path), columns=columns,
+                              memory_map=True, filters=filters)
+        except FileNotFoundError as e:
+            # a compaction deleted the SST between plan and read: map to
+            # the store contract's error so scan retries replan (the
+            # non-local branch gets this from store.get)
+            raise NotFoundError(f"object not found: {path}") from e
     data = await store.get(path)
     return await _run(runtimes, pool, pq.read_table, pa.BufferReader(data),
                       columns=columns, filters=filters)

@@ -37,12 +37,12 @@ import jax
 import jax.numpy as jnp
 
 from horaedb_tpu.common.error import ensure
-from horaedb_tpu.objstore import ObjectStore
+from horaedb_tpu.objstore import NotFoundError, ObjectStore
 from horaedb_tpu.ops import downsample as downsample_ops
 from horaedb_tpu.ops import encode, filter as filter_ops, merge as merge_ops
 from horaedb_tpu.storage.config import StorageConfig, UpdateMode
 from horaedb_tpu.storage.operator import build_operator
-from horaedb_tpu.storage.sst import SstFile, sst_path
+from horaedb_tpu.storage.sst import SstFile, segment_of, sst_path
 from horaedb_tpu.storage.types import (
     RESERVED_COLUMN_NAME,
     SEQ_COLUMN_NAME,
@@ -119,6 +119,10 @@ class ScanPlan:
     # compaction plans use "compact" so rewrites queue behind each other
     # instead of in front of serving scans (ref: storage.rs:91-104)
     pool: str = "sst"
+    # the request's time range (race re-resolution must honor it: a
+    # fresh SST in the same segment but outside the requested range
+    # must not leak rows into the results)
+    range: Optional[TimeRange] = None
 
 
 class ParquetReader:
@@ -134,6 +138,12 @@ class ParquetReader:
         self.config = config
         self.segment_duration_ms = segment_duration_ms
         self.runtimes = runtimes
+        # optional async callback (segment_start) -> current SstFiles:
+        # set by CloudObjectStorage so a STREAMED segment can survive a
+        # compaction race mid-segment (see _stream_window_batches) —
+        # bulk segments read everything before yielding, so the outer
+        # replan covers them
+        self.resolve_segment_ssts = None
         from horaedb_tpu.storage.scan_cache import ScanCache
 
         cache_bytes = (config.scan.cache_max_bytes
@@ -176,8 +186,8 @@ class ParquetReader:
 
         by_segment: dict[int, list[SstFile]] = {}
         for f in ssts:
-            seg = int(f.meta.time_range.start.truncate_by(self.segment_duration_ms))
-            by_segment.setdefault(seg, []).append(f)
+            by_segment.setdefault(
+                segment_of(f, self.segment_duration_ms), []).append(f)
         segments = [
             SegmentPlan(segment_start=seg, ssts=sorted(files, key=lambda f: f.id),
                         columns=columns)
@@ -191,7 +201,7 @@ class ParquetReader:
         return ScanPlan(segments=segments, mode=self.schema.update_mode,
                         predicate=request.predicate, keep_builtin=keep_builtin,
                         pushdown=pushdown, pushdown_key=pushdown_key,
-                        use_cache=use_cache, pool=pool)
+                        use_cache=use_cache, pool=pool, range=request.range)
 
     # ---- execution ---------------------------------------------------------
 
@@ -690,10 +700,40 @@ class ParquetReader:
                 & (pc.field(part_col) <= pyval(hi))
             if plan.pushdown is not None:
                 expr = expr & plan.pushdown
-            tables = await asyncio.gather(*(
-                self._run_pool(plan.pool, src.read, columns=seg.columns,
-                               filters=expr)
-                for src in sources))
+            refresh = False
+            for attempt in range(3):
+                try:
+                    if refresh:
+                        # re-resolution/re-open can themselves race a
+                        # second deletion — they live INSIDE the try so
+                        # that also consumes an attempt, never escapes
+                        fresh = await self.resolve_segment_ssts(
+                            seg.segment_start, plan.range)
+                        sources = await asyncio.gather(*(
+                            parquet_io.open_sst_source(
+                                self.store, sst_path(self.root_path, f.id))
+                            for f in fresh))
+                        refresh = False
+                    if not sources:
+                        # the whole segment vanished (TTL GC): nothing
+                        # left to stream
+                        return
+                    tables = await asyncio.gather(*(
+                        self._run_pool(plan.pool, src.read,
+                                       columns=seg.columns, filters=expr)
+                        for src in sources))
+                    break
+                except NotFoundError:
+                    # a compaction deleted an input SST mid-segment.
+                    # Windows already yielded can't be retracted, so the
+                    # OUTER replan would duplicate them — instead
+                    # re-resolve this segment's CURRENT SSTs (the
+                    # compacted output holds the same rows) and continue
+                    # with the remaining value ranges, which partition
+                    # rows independently of file boundaries.
+                    if self.resolve_segment_ssts is None or attempt == 2:
+                        raise
+                    refresh = True
             tbl = pa.concat_tables(tables)
             if tbl.num_rows:
                 yield tbl.combine_chunks().to_batches()[0]

@@ -102,3 +102,11 @@ class SstFile:
 def sst_path(prefix: str, file_id: FileId) -> str:
     """Object-store key for an SST (ref: sst.rs:202-204: `{prefix}/data/{id}.sst`)."""
     return f"{prefix}/{DATA_PREFIX}/{file_id}.sst"
+
+
+def segment_of(f: "SstFile", segment_duration_ms: int) -> int:
+    """The time segment an SST belongs to — THE segment-assignment rule
+    (keyed by range START truncation, ref: storage.rs:342-350), shared
+    by the scan planner, compaction picker, and race re-resolution so
+    they can never disagree."""
+    return int(f.meta.time_range.start.truncate_by(segment_duration_ms))

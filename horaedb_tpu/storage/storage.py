@@ -111,8 +111,24 @@ class CloudObjectStorage(TimeMergeStorage):
         self.manifest = await Manifest.open(self.root_path, self.store,
                                             self.config.manifest,
                                             runtimes=self.runtimes)
+        self.reader.resolve_segment_ssts = self._segment_ssts_now
         await self._start_compaction()
         return self
+
+    async def _segment_ssts_now(self, segment_start: int,
+                                scan_range: Optional[TimeRange]):
+        """CURRENT SSTs of one segment that overlap the scan's requested
+        range — a streamed segment uses this to survive a compaction
+        race mid-segment (read.py).  The range filter mirrors
+        build_scan_plan's manifest.find_ssts so recovery cannot leak
+        rows from SSTs the original plan excluded."""
+        from horaedb_tpu.storage.sst import segment_of
+
+        ssts = await self.manifest.all_ssts()
+        return [f for f in ssts
+                if segment_of(f, self.segment_duration_ms) == segment_start
+                and (scan_range is None
+                     or f.meta.time_range.overlaps(scan_range))]
 
     async def _start_compaction(self) -> None:
         from horaedb_tpu.storage.compaction import Scheduler

@@ -546,6 +546,75 @@ class TestStreamedRead:
                           "max_window_rows": 1 << 20})
         assert streamed == bulk
 
+    def test_streamed_scan_survives_mid_segment_compaction(self):
+        """Append-mode streamed segments yield one batch per window
+        WHILE later windows are still being read: an SST vanishing in
+        between (compaction race) must neither fail the scan nor
+        duplicate already-yielded windows — the segment re-resolves its
+        CURRENT SSTs and continues with the remaining value ranges.
+        Local store: deleted files raise FileNotFoundError, which must
+        map to the retryable NotFoundError."""
+        import tempfile
+
+        import numpy as np
+
+        from horaedb_tpu.objstore import LocalObjectStore
+
+        schema = pa.schema([pa.field("host", pa.string()),
+                            pa.field("ts", pa.int64()),
+                            pa.field("payload", pa.binary())])
+
+        def batches():
+            rng = np.random.default_rng(3)
+            out = []
+            for _ in range(4):
+                h = rng.integers(0, 40, 1500)
+                out.append(pa.record_batch(
+                    [pa.array([f"host_{int(i):02d}" for i in h]),
+                     pa.array(rng.integers(0, SEGMENT_MS, 1500),
+                              type=pa.int64()),
+                     pa.array([b"%d" % v for v in
+                               rng.integers(0, 100, 1500)],
+                              type=pa.binary())],
+                    schema=schema))
+            return out
+
+        async def go():
+            with tempfile.TemporaryDirectory() as root:
+                cfg = from_dict(StorageConfig, {
+                    "scan": {"stream_read_min_rows": 2000,
+                             "max_window_rows": 1024},
+                    "scheduler": {"schedule_interval": "1h",
+                                  "input_sst_min_num": 2}})
+                cfg.update_mode = UpdateMode.APPEND
+                s = await CloudObjectStorage.open(
+                    "db", SEGMENT_MS, LocalObjectStore(root), schema,
+                    num_primary_keys=2, config=cfg)
+                try:
+                    for b in batches():
+                        await s.write(WriteRequest(
+                            b, TimeRange.new(0, SEGMENT_MS)))
+                    expected = sorted(rows_of(await collect(s.scan(
+                        ScanRequest(range=TimeRange.new(0, SEGMENT_MS))))))
+
+                    got = []
+                    stream = s.scan(
+                        ScanRequest(range=TimeRange.new(0, SEGMENT_MS)))
+                    first = await stream.__anext__()
+                    got.extend(rows_of([first]))
+                    # compaction deletes every input SST while the
+                    # stream still has windows to read
+                    task = await s.compact_scheduler.picker.pick_candidate()
+                    assert task is not None
+                    await s.compact_scheduler.executor.execute(task)
+                    async for b in stream:
+                        got.extend(rows_of([b]))
+                    assert sorted(got) == expected
+                finally:
+                    await s.close()
+
+        asyncio.run(go())
+
     def test_streamed_append_mode_equals_bulk(self):
         """Append (host BytesMerge) tables stream too."""
         import numpy as np
