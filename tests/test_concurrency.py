@@ -124,7 +124,8 @@ class StressModel:
 
 
 async def run_stress(seed: int, duration_s: float, mutate=None,
-                     recent_t0: int = None) -> StressModel:
+                     recent_t0: int = None,
+                     scan_overrides: dict = None) -> StressModel:
     """Randomized interleaving: writers + scanners + aggregate scans +
     compaction + manifest merges + TTL GC, invariants checked on every
     scan.  Deterministic op mix per seed (interleaving is scheduler-
@@ -142,7 +143,7 @@ async def run_stress(seed: int, duration_s: float, mutate=None,
         "manifest": {"merge_interval": "20ms", "min_merge_threshold": 0},
         "scheduler": {"schedule_interval": "40ms", "input_sst_min_num": 2,
                       "ttl": "2h"},
-        "scan": {"max_window_rows": 256},
+        "scan": {"max_window_rows": 256, **(scan_overrides or {})},
     })
     s = await CloudObjectStorage.open("db", SEGMENT_MS, MemoryObjectStore(),
                                       schema(), 2, cfg)
@@ -275,6 +276,16 @@ def test_randomized_stress_seeds():
     for seed in (1, 7):
         model = asyncio.run(run_stress(seed, duration_s=2.5))
         assert len(model.acked) > 30, "stress too idle to mean anything"
+
+
+def test_randomized_stress_streamed_reads():
+    """Same invariants with segments forced through the STREAMED read
+    path (tiny threshold), exercising the mid-segment compaction-race
+    recovery under randomized interleaving."""
+    model = asyncio.run(run_stress(11, duration_s=2.5,
+                                   scan_overrides={
+                                       "stream_read_min_rows": 300}))
+    assert len(model.acked) > 30
 
 
 def test_stress_detects_injected_stale_cache_race():
