@@ -30,6 +30,7 @@ import datetime
 import hashlib
 import hmac
 import random
+import re
 import urllib.parse
 import xml.etree.ElementTree as ET
 from dataclasses import dataclass
@@ -309,7 +310,22 @@ class S3ObjectStore(ObjectStore):
                 for n, e in etags)
             xml = (f"<CompleteMultipartUpload>{complete}"
                    f"</CompleteMultipartUpload>").encode()
-            await self._complete_multipart(path, upload_id, xml)
+            # the S3 multipart ETag is md5(concat(part md5s))-N and the
+            # part PUT responses already carry each part's md5 — build
+            # the expected object ETag from them (no client-side
+            # hashing) so a lost complete response can be verified.
+            # SSE-KMS/SSE-C buckets return non-md5 part ETags; skip the
+            # ETag check there (size fallback still applies).
+            expected_etag = None
+            try:
+                part_digests = b"".join(
+                    bytes.fromhex(e.strip('"')) for _n, e in etags)
+                expected_etag = (f"{hashlib.md5(part_digests).hexdigest()}"
+                                 f"-{n_parts}")
+            except ValueError:
+                pass
+            await self._complete_multipart(path, upload_id, xml,
+                                           expected_etag, len(data))
         except BaseException:
             try:
                 r = await self._request("DELETE", path,
@@ -321,11 +337,13 @@ class S3ObjectStore(ObjectStore):
             raise
 
     async def _complete_multipart(self, path: str, upload_id: str,
-                                  xml: bytes) -> None:
+                                  xml: bytes, expected_etag: str,
+                                  expected_size: int) -> None:
         """CompleteMultipartUpload is NOT idempotent: a retry after a
         lost success response gets 404 NoSuchUpload — confirm via HEAD
-        that the object landed before treating that as failure.  A 200
-        can also carry an error body (AWS documents InternalError-in-200
+        that OUR object landed (not a stale previous object at the same
+        overwritten key) before treating that as success.  A 200 can
+        also carry an error body (AWS documents InternalError-in-200
         for this call), which must not pass as success."""
         try:
             _resp, body = await self._request(
@@ -336,8 +354,27 @@ class S3ObjectStore(ObjectStore):
                             f"an error body: {body[:200]!r}")
         except NotFoundError:
             # a previous attempt whose response was lost may have
-            # completed the upload; the object's existence decides
-            await self.head(path)
+            # completed the upload; verify the object at the key is OURS
+            resp = await self._request("HEAD", path, io=False)
+            etag = resp.headers.get("ETag", "").strip('"')
+            size = int(resp.headers.get("Content-Length", -1))
+            resp.release()
+            # only an md5-shaped multipart ETag ("<32 hex>-N") is
+            # comparable; encrypted buckets produce opaque ETags — fall
+            # back to the size check there
+            comparable = (expected_etag is not None and etag
+                          and re.fullmatch(r"[0-9a-f]{32}-\d+", etag))
+            if comparable:
+                if etag != expected_etag:
+                    raise Error(
+                        f"s3 multipart complete for {path} lost its "
+                        f"upload and the object present has ETag {etag} "
+                        f"!= expected {expected_etag} (stale object)")
+            elif size != expected_size:
+                raise Error(
+                    f"s3 multipart complete for {path} lost its upload "
+                    f"and the object present has size {size} != "
+                    f"expected {expected_size}")
 
     async def get(self, path: str) -> bytes:
         _resp, body = await self._request("GET", path, collect=True)

@@ -27,6 +27,7 @@ from __future__ import annotations
 import asyncio
 import functools
 import time
+import weakref
 from dataclasses import dataclass
 from typing import AsyncIterator, Optional
 
@@ -36,7 +37,7 @@ import pyarrow as pa
 import jax
 import jax.numpy as jnp
 
-from horaedb_tpu.common.error import ensure
+from horaedb_tpu.common.error import Error, ensure
 from horaedb_tpu.objstore import NotFoundError, ObjectStore
 from horaedb_tpu.ops import downsample as downsample_ops
 from horaedb_tpu.ops import encode, filter as filter_ops, merge as merge_ops
@@ -264,7 +265,8 @@ class ParquetReader:
         Yields (segment_start, batch) parts then the completion marker."""
         if is_streamed:
             spent = 0.0
-            async for batch in self._stream_window_batches(seg, plan):
+            async for batch in self._stream_window_batches(
+                    seg, plan, strict_no_replay=True):
                 t0 = time.perf_counter()
                 part = await self._run_pool(
                     plan.pool, self._merge_segment_table,
@@ -332,28 +334,25 @@ class ParquetReader:
                 await mesh_iter.aclose()
             return
 
-        streamed = {id(s) for s in to_read if self._stream_segment(s)}
-        to_read = [s for s in to_read if id(s) not in streamed]
-        read_iter = self._prefetch_tables(to_read, plan).__aiter__()
-        pending: "deque[tuple[SegmentPlan, list, float]]" = deque()
+        # the shared _segment_feed owns the streamed/bulk split and the
+        # prefetch priming; pump() adds the merge-dispatch LOOKAHEAD on
+        # top (bulk merges dispatch ahead of the yield position so the
+        # device pipeline never drains)
+        feed = self._segment_feed(plan, to_read).__aiter__()
+        pending: "deque[tuple[SegmentPlan, str, list, float]]" = deque()
         exhausted = False
-        # prime the prefetch pipeline NOW: driving the generator's first
-        # step creates all its read tasks, so bulk segments' object-store
-        # reads overlap any streamed segment processed before them
-        primed: Optional[asyncio.Task] = (
-            asyncio.ensure_future(read_iter.__anext__()) if to_read
-            else None)
 
         async def pump() -> None:
-            nonlocal exhausted, primed
+            nonlocal exhausted
             try:
-                if primed is not None:
-                    step, primed = primed, None
-                    read_seg, table, read_s = await step
-                else:
-                    read_seg, table, read_s = await read_iter.__anext__()
+                fseg, is_streamed, table, read_s = await feed.__anext__()
             except StopAsyncIteration:
                 exhausted = True
+                return
+            if is_streamed:
+                # a marker only: the actual streaming happens when this
+                # segment reaches the yield position
+                pending.append((fseg, "stream", [], 0.0))
                 return
             dispatched: list = []
             if table.num_rows:
@@ -363,30 +362,25 @@ class ParquetReader:
 
                 dispatched = await self._run_pool(plan.pool,
                                                   encode_and_dispatch)
-            pending.append((read_seg, dispatched, read_s))
+            pending.append((fseg, "bulk", dispatched, read_s))
 
         try:
             for seg in plan.segments:
                 if id(seg) in cached:
                     yield seg, cached[id(seg)], 0.0
                     continue
-                if id(seg) in streamed:
-                    t0 = time.perf_counter()
-                    dispatched = []
-                    async for batch in self._stream_window_batches(seg, plan):
-                        dispatched.extend(await self._run_pool(
-                            plan.pool, self._dispatch_merged_windows, batch))
-                    windows = await self._run_pool(
-                        plan.pool, self._finalize_windows, dispatched)
-                    if plan.use_cache:
-                        self.scan_cache.put(
-                            self._cache_key(seg, plan), windows)
-                    yield seg, windows, time.perf_counter() - t0
-                    continue
                 while len(pending) <= self._MERGE_LOOKAHEAD and not exhausted:
                     await pump()
-                read_seg, dispatched, read_s = pending.popleft()
+                read_seg, kind, dispatched, read_s = pending.popleft()
                 assert read_seg is seg
+                if kind == "stream":
+                    t0 = time.perf_counter()
+                    async for batch in self._stream_window_batches(seg,
+                                                                   plan):
+                        dispatched.extend(await self._run_pool(
+                            plan.pool, self._dispatch_merged_windows,
+                            batch))
+                    read_s = time.perf_counter() - t0
                 windows = await self._run_pool(
                     plan.pool, self._finalize_windows, dispatched)
                 if plan.use_cache:
@@ -394,8 +388,7 @@ class ParquetReader:
                                         windows)
                 yield seg, windows, read_s
         finally:
-            if primed is not None:
-                primed.cancel()
+            await feed.aclose()
 
     async def _cached_windows_mesh(self, plan: ScanPlan, cached: dict,
                                    to_read: list):
@@ -652,7 +645,8 @@ class ParquetReader:
         return sum(f.meta.num_rows for f in seg.ssts) > max(
             threshold, self.config.scan.max_window_rows)
 
-    async def _stream_window_batches(self, seg: SegmentPlan, plan: ScanPlan):
+    async def _stream_window_batches(self, seg: SegmentPlan, plan: ScanPlan,
+                                     strict_no_replay: bool = False):
         """Streamed segment read (the reference's pull-based batch
         streaming, read.rs:346-385, re-shaped for device windows): pass 1
         streams ONE PK column's row groups to plan value-range windows of
@@ -695,6 +689,7 @@ class ParquetReader:
         if acc:
             ranges.append((values[start], values[-1]))
         pyval = lambda x: x.item() if hasattr(x, "item") else x
+        yielded_any = False
         for lo, hi in ranges:
             expr = (pc.field(part_col) >= pyval(lo)) \
                 & (pc.field(part_col) <= pyval(hi))
@@ -732,10 +727,24 @@ class ParquetReader:
                     # with the remaining value ranges, which partition
                     # rows independently of file boundaries.
                     if self.resolve_segment_ssts is None or attempt == 2:
+                        if strict_no_replay and yielded_any:
+                            # the CONSUMER already emitted these batches
+                            # downstream (Append path): an outer replan
+                            # would DUPLICATE them — fail loudly as a
+                            # non-retryable error instead.  Buffering
+                            # consumers (OVERWRITE/aggregate) pass
+                            # strict_no_replay=False and let the replan
+                            # recover duplicate-free.
+                            raise Error(
+                                f"streamed segment {seg.segment_start} "
+                                "lost its SSTs mid-stream after retries; "
+                                "failing rather than duplicating "
+                                "already-emitted rows")
                         raise
                     refresh = True
             tbl = pa.concat_tables(tables)
             if tbl.num_rows:
+                yielded_any = True
                 yield tbl.combine_chunks().to_batches()[0]
 
     def _prepare_merge_windows(self, batch: pa.RecordBatch) -> list:
@@ -1033,11 +1042,12 @@ class ParquetReader:
             entry = self._stack_cache.get(key)
             if entry is None:
                 return None
-            stored_windows, arrays, nbytes = entry
-            if len(stored_windows) != len(windows_now) or not all(
-                    a is b for a, b in zip(stored_windows, windows_now)):
-                # same key, different round composition (windows were
-                # re-read): the stale stack is dead HBM — drop it now
+            stored_refs, arrays, nbytes = entry
+            # WEAK references: the entry must not pin evicted windows'
+            # column buffers in HBM; a dead ref or changed composition
+            # means the round was re-read — drop the stale stack
+            if len(stored_refs) != len(windows_now) or not all(
+                    ref() is w for ref, w in zip(stored_refs, windows_now)):
                 del self._stack_cache[key]
                 self._stack_cache_bytes -= nbytes
                 return None
@@ -1047,13 +1057,14 @@ class ParquetReader:
     def _stack_cache_put(self, key: tuple, windows_now: tuple,
                          arrays: tuple) -> None:
         nbytes = sum(int(a.nbytes) for a in arrays)
+        refs = tuple(weakref.ref(w) for w in windows_now)
         with self._stack_cache_lock:
             if nbytes > self._stack_cache_max:
                 return
             old = self._stack_cache.pop(key, None)
             if old is not None:
                 self._stack_cache_bytes -= old[2]
-            self._stack_cache[key] = (windows_now, arrays, nbytes)
+            self._stack_cache[key] = (refs, arrays, nbytes)
             self._stack_cache_bytes += nbytes
             while (self._stack_cache_bytes > self._stack_cache_max
                    and self._stack_cache):

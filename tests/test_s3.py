@@ -58,17 +58,27 @@ def verify_signature(request: web.Request) -> None:
 
 class Faults:
     """Fault injection: fail the next N requests with `status`
-    (0 = drop the connection)."""
+    (0 = drop the connection).  complete_lost modes simulate a
+    CompleteMultipartUpload whose response is lost: "stored" performs
+    the completion then answers 404; "dropped" answers 404 WITHOUT
+    completing."""
 
     def __init__(self):
         self.remaining = 0
         self.status = 500
         self.seen = 0
+        self.complete_lost = None  # None | "stored" | "dropped"
+
+
+def multipart_etag(parts: list[bytes]) -> str:
+    md5s = b"".join(hashlib.md5(p).digest() for p in parts)
+    return f"{hashlib.md5(md5s).hexdigest()}-{len(parts)}"
 
 
 def make_fake_s3(bucket: str):
     objects: dict[str, bytes] = {}
     uploads: dict[str, list] = {}
+    etags: dict[str, str] = {}
     faults = Faults()
 
     async def handle(request: web.Request):
@@ -123,9 +133,17 @@ def make_fake_s3(bucket: str):
             return web.Response(status=200, headers={"ETag": f'"{etag}"'})
         if request.method == "POST" and "uploadId" in request.query:
             uid = request.query["uploadId"]
+            if faults.complete_lost == "dropped":
+                faults.complete_lost = None
+                uploads.pop(uid, None)  # upload gone, nothing stored
+                return web.Response(status=404)
             parts = sorted(uploads.pop(uid), key=lambda p: p[0])
             assert [p[0] for p in parts] == list(range(1, len(parts) + 1))
             objects[key] = b"".join(p[2] for p in parts)
+            etags[key] = multipart_etag([p[2] for p in parts])
+            if faults.complete_lost == "stored":
+                faults.complete_lost = None
+                return web.Response(status=404)  # success response lost
             return web.Response(
                 status=200, content_type="application/xml",
                 body=b"<CompleteMultipartUploadResult/>")
@@ -135,6 +153,7 @@ def make_fake_s3(bucket: str):
 
         if request.method == "PUT":
             objects[key] = body
+            etags[key] = hashlib.md5(body).hexdigest()
             return web.Response(status=200)
         if request.method in ("GET", "HEAD"):
             if key not in objects:
@@ -149,7 +168,8 @@ def make_fake_s3(bucket: str):
             if request.method == "HEAD":
                 return web.Response(
                     status=200,
-                    headers={"Content-Length": str(len(data))})
+                    headers={"Content-Length": str(len(data)),
+                             "ETag": f'"{etags.get(key, "")}"'})
             return web.Response(status=200, body=data)
         if request.method == "DELETE":
             objects.pop(key, None)
@@ -294,6 +314,32 @@ class TestS3Store:
                 faults.remaining = 0
                 with pytest.raises(NotFoundError):
                     await store.get("never-written")
+            finally:
+                await store.close()
+                await server.close()
+
+        asyncio.run(go())
+
+    def test_multipart_lost_complete_response(self):
+        """Complete succeeds server-side but the response is lost (404
+        on our side): the client verifies via HEAD ETag that OUR object
+        landed and reports success.  If nothing was stored (stale or
+        missing object), it must fail, never silently pass."""
+        async def go():
+            store, server, objects, _, faults = await make_store(
+                multipart_threshold=1 << 16, multipart_part_size=1 << 16)
+            try:
+                data = b"q" * (1 << 17)
+                faults.complete_lost = "stored"
+                await store.put("db/data/lost.sst", data)  # verified OK
+                assert objects["db/data/lost.sst"] == data
+
+                # stale object at the key + upload actually dropped:
+                # verification must reject it
+                faults.complete_lost = "dropped"
+                with pytest.raises(Error, match="stale|size"):
+                    await store.put("db/data/lost.sst", b"z" * (1 << 17))
+                assert objects["db/data/lost.sst"] == data  # unchanged
             finally:
                 await store.close()
                 await server.close()
