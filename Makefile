@@ -1,7 +1,13 @@
-.PHONY: test native bench clean
+.PHONY: test native bench clean verify
 
 test:
 	python -m pytest tests/ -q
+
+# the driver-facing deliverables, end to end: full suite + the
+# multi-chip dryrun on the virtual CPU mesh + a small engine bench
+verify: test
+	python -c "import __graft_entry__; __graft_entry__.dryrun_multichip(8); print('dryrun OK')"
+	BENCH_ROWS=200000 BENCH_ITERS=3 python bench.py
 
 native:
 	$(MAKE) -C native
