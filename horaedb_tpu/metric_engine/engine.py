@@ -403,6 +403,11 @@ class MetricEngine:
                    and segment_ms % chunk_window_ms == 0,
                    "chunk window must evenly divide the segment duration")
         from horaedb_tpu.common import runtimes as runtimes_mod
+        from horaedb_tpu.utils.compile_cache import enable_compile_cache
+
+        # second process on the same machine reuses every compiled scan
+        # program (the reference pays zero compile cost; we amortize ours)
+        enable_compile_cache()
 
         tables = {}
         schemas = dict(_TABLE_SCHEMAS)
@@ -592,7 +597,12 @@ class MetricEngine:
             async with asyncio.TaskGroup() as tg:
                 for seg in np.unique(seg_ids):
                     tg.create_task(write_segment(int(seg)))
-        except* Error as eg:
+        except ExceptionGroup as eg:
+            # preserve the pre-TaskGroup error surface: callers catching
+            # concrete types (Error, pa.ArrowInvalid, OSError, ...) must
+            # not be handed an ExceptionGroup.  A plain `except` (not
+            # except*) so mixed-type failures still collapse to ONE
+            # exception instead of re-combining into a group.
             raise eg.exceptions[0]
 
     async def _write_arrow_chunked(self, mid, fid, codes, tsid_of_code,

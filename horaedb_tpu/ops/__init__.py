@@ -26,7 +26,9 @@ from horaedb_tpu.ops.encode import (
     encode_batch,
     pad_capacity,
 )
-from horaedb_tpu.ops.merge import merge_dedup_last, sorted_run_starts
+from horaedb_tpu.ops.merge import (dedup_sorted_last, merge_dedup_last,
+                                   merge_impl, set_merge_impl,
+                                   sorted_run_starts)
 from horaedb_tpu.ops.downsample import time_bucket_aggregate
 from horaedb_tpu.ops.filter import (
     And,
@@ -47,6 +49,7 @@ from horaedb_tpu.ops.topk import top_k_groups
 __all__ = [
     "And", "ColumnEncoding", "DeviceBatch", "Eq", "Ge", "Gt", "In", "Le",
     "Lt", "Ne", "Not", "Or", "TimeRangePred", "decode_to_arrow",
-    "encode_batch", "eval_predicate", "merge_dedup_last", "pad_capacity",
+    "dedup_sorted_last", "encode_batch", "eval_predicate", "merge_dedup_last",
+    "merge_impl", "set_merge_impl", "pad_capacity",
     "sorted_run_starts", "time_bucket_aggregate", "top_k_groups",
 ]

@@ -21,11 +21,36 @@ too (first `num_runs` rows valid), so downstream ops stay compiled.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
 
 _PAD_SENTINEL = jnp.int32(2**31 - 1)
+
+# Which merge strategy the scan uses (see storage/read.py):
+#   host_perm   — exploit pre-sorted SST runs: host plans a permutation
+#                 (or proves none is needed), device pays one gather +
+#                 dedup (`dedup_sorted_last`). The default.
+#   device_sort — the original full `lax.sort` program
+#                 (`merge_dedup_last`); kept for A/B runs.
+_MERGE_IMPLS = ("host_perm", "device_sort")
+_merge_impl = "host_perm"
+
+
+def set_merge_impl(name: str) -> None:
+    global _merge_impl
+    if name not in _MERGE_IMPLS:
+        raise ValueError(f"unknown merge impl {name!r}; "
+                         f"expected one of {_MERGE_IMPLS}")
+    _merge_impl = name
+
+
+def merge_impl() -> str:
+    return _merge_impl
+
+
+set_merge_impl(os.environ.get("HORAEDB_MERGE_IMPL", "host_perm"))
 
 
 def sorted_run_starts(pk_cols: tuple, valid: jax.Array) -> jax.Array:
@@ -76,6 +101,68 @@ def _merge_dedup_impl(cols: tuple, n_valid: jax.Array, num_pks: int, num_keys: i
     out_cols = tuple(c[src_rows] for c in cols)
     out_valid = iota < num_runs
     return out_cols, out_valid, num_runs
+
+
+@functools.partial(jax.jit, static_argnames=("num_pks", "has_perm"))
+def _dedup_presorted_impl(cols: tuple, perm, n_valid: jax.Array,
+                          num_pks: int, has_perm: bool):
+    capacity = cols[0].shape[0]
+    iota = jnp.arange(capacity, dtype=jnp.int32)
+    if has_perm:
+        # one fused gather applies the host-computed merge permutation;
+        # padding rows map to themselves (perm[n:] is identity)
+        cols = tuple(c[perm] for c in cols)
+    valid = iota < n_valid
+    run_starts = sorted_run_starts(cols[:num_pks], valid)
+    run_ids = jnp.cumsum(run_starts.astype(jnp.int32)) - 1
+    num_runs = jnp.sum(run_starts.astype(jnp.int32))
+    # within a run, the LAST row wins (rows arrive in seq-preference
+    # order); segment_max over masked row indices finds it
+    masked_iota = jnp.where(valid, iota, jnp.int32(-1))
+    safe_run_ids = jnp.where(valid, run_ids, capacity - 1)
+    last_idx = jax.ops.segment_max(masked_iota, safe_run_ids,
+                                   num_segments=capacity)
+    gather_idx = jnp.clip(last_idx, 0, capacity - 1)
+    out_cols = tuple(c[gather_idx] for c in cols)
+    out_valid = iota < num_runs
+    return out_cols, out_valid, num_runs
+
+
+def dedup_sorted_last(pk_cols: tuple, seq: jax.Array, value_cols: tuple,
+                      n_valid, perm=None
+                      ) -> tuple[tuple, jax.Array, tuple, jax.Array, jax.Array]:
+    """Dedup WITHOUT a device sort: the k-way-merge replacement for
+    `merge_dedup_last` when the caller already knows the row order.
+
+    The reference merges already-sorted per-SST streams
+    (SortPreservingMergeExec, ref: src/storage/src/read.rs:455-480)
+    instead of re-sorting; our equivalent exploits that SSTs are written
+    PK-sorted (storage.py write path): the host either verifies the
+    concatenation is globally sorted (single-SST segments — the
+    post-compaction steady state — and time-partitioned writes) or
+    computes a merge permutation with an O(n) radix argsort over packed
+    int64 keys, while the device only pays one fused gather plus the
+    run-mask/segmented-last-select — the O(n log n) variadic
+    `lax.sort` drops out of the scan entirely.
+
+    Contract: after applying `perm` (or as given when `perm is None`),
+    rows must be sorted by `pk_cols` lexicographically, with rows of
+    equal PK ordered so the preferred (highest-seq) row comes LAST.
+
+    Returns the same tuple shape as merge_dedup_last.
+    """
+    cols = tuple(pk_cols) + (seq,) + tuple(value_cols)
+    has_perm = perm is not None
+    if not has_perm:
+        # jit requires consistent pytree arity; a scalar stands in
+        perm = jnp.int32(0)
+    out_cols, out_valid, num_runs = _dedup_presorted_impl(
+        cols, perm, jnp.asarray(n_valid, dtype=jnp.int32),
+        num_pks=len(pk_cols), has_perm=has_perm)
+    out_pks = out_cols[: len(pk_cols)]
+    out_seq = out_cols[len(pk_cols)]
+    out_values = out_cols[len(pk_cols) + 1:]
+    return out_pks, out_seq, out_values, out_valid, num_runs
 
 
 def merge_dedup_last(pk_cols: tuple, seq: jax.Array, value_cols: tuple,

@@ -151,25 +151,16 @@ def sharded_remap_partials(mesh, *, num_groups: int, num_buckets: int,
     return jax.jit(mapped)
 
 
-def sharded_merge_dedup(mesh, *, num_pks: int):
-    """Build the compiled multi-chip merge-dedup.
-
-    Segments are the shard axis and dedup is segment-scoped, so this is
-    shard-local compute with NO collectives — the mesh exists so the same
-    program scales from 1 to N chips and composes with the downsample
-    collectives in one jit.
-
-    Returns fn(pks, seq, values, n_valid) over (n_devices, capacity)
-    arrays; outputs keep the same sharded layout plus a per-shard
-    (n_devices,) run count.
-    """
+def _build_sharded_merge(mesh, merge_fn):
+    """Shared shard_map plumbing for the two merge kernels: unwrap the
+    (1, capacity) blocks, run `merge_fn` shard-locally (dedup is
+    segment-scoped, so NO collectives), re-expand the leading axis."""
 
     def shard_fn(pks, seq, values, n_valid):
         _check_block_is_one(seq)
-        out_pks, out_seq, out_vals, out_valid, num_runs = \
-            merge_ops.merge_dedup_last(
-                tuple(c[0] for c in pks), seq[0],
-                tuple(v[0] for v in values), n_valid[0])
+        out_pks, out_seq, out_vals, out_valid, num_runs = merge_fn(
+            tuple(c[0] for c in pks), seq[0],
+            tuple(v[0] for v in values), n_valid[0])
         expand = lambda a: a[None, :]
         return (tuple(expand(c) for c in out_pks), expand(out_seq),
                 tuple(expand(v) for v in out_vals), expand(out_valid),
@@ -185,6 +176,37 @@ def sharded_merge_dedup(mesh, *, num_pks: int):
         check_vma=False,
     )
     return jax.jit(mapped)
+
+
+def sharded_merge_dedup(mesh, *, num_pks: int):
+    """Build the compiled multi-chip merge-dedup.
+
+    Segments are the shard axis and dedup is segment-scoped, so this is
+    shard-local compute with NO collectives — the mesh exists so the same
+    program scales from 1 to N chips and composes with the downsample
+    collectives in one jit.
+
+    Returns fn(pks, seq, values, n_valid) over (n_devices, capacity)
+    arrays; outputs keep the same sharded layout plus a per-shard
+    (n_devices,) run count.
+    """
+    del num_pks  # shape-polymorphic: the tuple arity fixes it at trace
+    return _build_sharded_merge(mesh, merge_ops.merge_dedup_last)
+
+
+def sharded_dedup_presorted(mesh, *, num_pks: int):
+    """Shard-local dedup of PRE-SORTED rows — the mesh twin of
+    `ops.merge.dedup_sorted_last`.
+
+    The host normalizes every window to PK-sorted order before stacking
+    (read.py _prepare_merge_windows plans a k-way-merge permutation over
+    the pre-sorted SST runs and composes it into the window gather), so
+    the shard program skips the variadic sort entirely: run-boundary
+    mask + segmented last-select only.  Same signature and layout as
+    sharded_merge_dedup.
+    """
+    del num_pks
+    return _build_sharded_merge(mesh, merge_ops.dedup_sorted_last)
 
 
 def shard_leading_axis(mesh, arr):

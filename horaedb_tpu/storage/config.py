@@ -111,7 +111,10 @@ class ScanConfig:
     # cache_max_bytes overrides it.
     cache_max_rows: int = 4 << 20
     # explicit HBM budget in bytes for the scan cache (0 = derive from
-    # cache_max_rows)
+    # cache_max_rows).  NOTE: the flush-stack cache (stacked aggregation
+    # inputs memoized per flush group) reserves an ADDITIONAL
+    # cache_bytes // 4 on top of this budget — worst-case HBM held by
+    # the two caches together is 1.25x the configured value.
     cache_max_bytes: int = 0
     # devices for the multi-chip aggregate path (0 = single-device);
     # windows batch onto a 1-D segment mesh in rounds of this size with

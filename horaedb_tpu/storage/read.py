@@ -402,6 +402,10 @@ class ParquetReader:
         from horaedb_tpu.parallel.scan import shard_leading_axis
 
         n_dev = self.mesh.devices.size
+        # pinned for the whole scan: window prep (sort normalization)
+        # and the round kernel must use the SAME impl even if
+        # set_merge_impl flips mid-scan
+        scan_host_perm = merge_ops.merge_impl() == "host_perm"
         feed = self._segment_feed(plan, to_read).__aiter__()
         # buffer entries: [seg, windows(list, filled in round order),
         #                  outstanding window count, read_s]
@@ -424,12 +428,15 @@ class ParquetReader:
             pk_names = self._pk_names_in(names)
             value_names = [nm for nm in names
                            if nm not in pk_names and nm != SEQ_COLUMN_NAME]
-            fn = self._mesh_merge_fns.get(len(pk_names))
+            fn = self._mesh_merge_fns.get((scan_host_perm, len(pk_names)))
             if fn is None:
-                from horaedb_tpu.parallel.scan import sharded_merge_dedup
+                from horaedb_tpu.parallel.scan import (
+                    sharded_dedup_presorted, sharded_merge_dedup)
 
-                fn = sharded_merge_dedup(self.mesh, num_pks=len(pk_names))
-                self._mesh_merge_fns[len(pk_names)] = fn
+                build = (sharded_dedup_presorted if scan_host_perm
+                         else sharded_merge_dedup)
+                fn = build(self.mesh, num_pks=len(pk_names))
+                self._mesh_merge_fns[(scan_host_perm, len(pk_names))] = fn
             out_pks, out_seq, out_vals, _valid, num_runs = fn(
                 tuple(stacks[nm] for nm in pk_names),
                 stacks[SEQ_COLUMN_NAME],
@@ -470,14 +477,16 @@ class ParquetReader:
                         buffer.append(entry)
                         async for batch in self._stream_window_batches(seg, plan):
                             await enqueue(entry, await self._run_pool(
-                                plan.pool, self._prepare_merge_windows, batch))
+                                plan.pool, self._prepare_merge_windows, batch,
+                                scan_host_perm))
                         entry[3] = time.perf_counter() - t0
                     else:
                         descs = []
                         if table.num_rows:
                             def encode_windows(tbl=table):
                                 batch = tbl.combine_chunks().to_batches()[0]
-                                return self._prepare_merge_windows(batch)
+                                return self._prepare_merge_windows(
+                                    batch, scan_host_perm)
 
                             descs = await self._run_pool(plan.pool,
                                                          encode_windows)
@@ -538,6 +547,14 @@ class ParquetReader:
         finally:
             if primed is not None:
                 primed.cancel()
+                try:
+                    await primed
+                except (asyncio.CancelledError, Exception):
+                    pass
+            # deterministic teardown of the prefetch generator: its
+            # eagerly-created SST read tasks must be cancelled NOW, not
+            # at GC-time finalization
+            await read_iter.aclose()
 
     async def _prefetch_tables(self, segments: list[SegmentPlan],
                                plan: ScanPlan):
@@ -747,11 +764,17 @@ class ParquetReader:
                 yielded_any = True
                 yield tbl.combine_chunks().to_batches()[0]
 
-    def _prepare_merge_windows(self, batch: pa.RecordBatch) -> list:
+    def _prepare_merge_windows(self, batch: pa.RecordBatch,
+                               host_perm: Optional[bool] = None) -> list:
         """Host half of the merge: encode + PK-window planning + padding,
         WITHOUT dispatching any device program.  Returns
         [(padded host cols, n_win, capacity, encodings)] — the mesh
-        round scheduler stacks these onto the shard axis."""
+        round scheduler stacks these onto the shard axis.
+
+        `host_perm` pins the merge-impl decision for a whole scan (the
+        caller captures merge_impl() once): window prep and the round
+        kernel must agree, or an impl flip mid-scan would hand unsorted
+        windows to the sort-free kernel."""
         dev = encode.encode_batch(batch)
         pk_names = self._pk_names_in(batch.schema.names)
         ensure(len(pk_names) == self.schema.num_primary_keys,
@@ -760,8 +783,21 @@ class ParquetReader:
         window = self.config.scan.max_window_rows
         if n == 0:
             return []
+        if host_perm is None:
+            host_perm = merge_ops.merge_impl() == "host_perm"
+        if host_perm:
+            seq_h = np.asarray(dev.columns[SEQ_COLUMN_NAME])[:n]
+            seq_ordered = bool(np.all(seq_h[1:] >= seq_h[:-1]))
         if n <= window:
             cols = {k: np.asarray(v) for k, v in dev.columns.items()}
+            if host_perm:
+                # normalize to PK-sorted here so the shard kernel is
+                # dedup-only (no lax.sort): see _plan_merge_perm
+                perm = _batch_merge_perm([cols[nm] for nm in pk_names],
+                                         seq_h, seq_ordered, n)
+                if perm is not None:
+                    cols = {k: np.concatenate([v[perm], v[n:]])
+                            for k, v in cols.items()}
             return [(cols, n, dev.capacity, dev.encodings)]
         host_cols = {name: np.asarray(c)[:n]
                      for name, c in dev.columns.items()}
@@ -777,6 +813,11 @@ class ParquetReader:
         for sel in _plan_pk_windows(host_cols[part_name], window):
             if not len(sel):
                 continue
+            if host_perm:
+                # compose: the window gather below applies the merge
+                # order for free
+                sel = _window_merge_sel([host_cols[nm] for nm in pk_names],
+                                        seq_h, seq_ordered, sel)
             n_win = len(sel)
             cap = encode.pad_capacity(n_win)
             padded = {k: np.pad(v[sel], (0, cap - n_win))
@@ -834,12 +875,30 @@ class ParquetReader:
             # meaningfully bounded even when pk 0 is constant
             selections = _plan_pk_windows(host_cols[sort_pk_names[0]], window)
 
+        host_perm = merge_ops.merge_impl() == "host_perm"
         dispatched = []
         for sel in selections:
+            dev_perm = None
             if sel is None:
                 # single-window fast path: encode_batch already padded
                 padded, n_win, cap = dev.columns, n, dev.capacity
+                if host_perm and n_win:
+                    perm = _batch_merge_perm(
+                        [host_cols[nm] for nm in sort_pk_names],
+                        seq_h, seq_ordered, n_win)
+                    if perm is not None:
+                        # identity over padding rows: the device gather
+                        # must map [n, cap) onto itself
+                        dev_perm = np.arange(cap, dtype=np.int32)
+                        dev_perm[:n_win] = perm
             else:
+                if host_perm and len(sel):
+                    # composing the window selection with the merge
+                    # permutation makes the merge FREE: the window
+                    # gather below was being paid anyway
+                    sel = _window_merge_sel(
+                        [host_cols[nm] for nm in sort_pk_names],
+                        seq_h, seq_ordered, sel)
                 sub = {k: v[sel] for k, v in host_cols.items()}
                 n_win = len(sel)
                 cap = encode.pad_capacity(n_win)
@@ -851,9 +910,16 @@ class ParquetReader:
             pks = tuple(dev_cols[name] for name in sort_pk_names)
             seq = dev_cols[SEQ_COLUMN_NAME]
             values = tuple(dev_cols[name] for name in carry_names)
-            out_pks, out_seq, out_values, _out_valid, num_runs = \
-                merge_ops.merge_dedup_last(pks, seq, values, n_win,
-                                           seq_in_row_order=seq_ordered)
+            if host_perm:
+                out_pks, out_seq, out_values, _out_valid, num_runs = \
+                    merge_ops.dedup_sorted_last(
+                        pks, seq, values, n_win,
+                        perm=None if dev_perm is None
+                        else jax.device_put(dev_perm))
+            else:
+                out_pks, out_seq, out_values, _out_valid, num_runs = \
+                    merge_ops.merge_dedup_last(pks, seq, values, n_win,
+                                               seq_in_row_order=seq_ordered)
             columns = {**{name: a for name, a in zip(sort_pk_names, out_pks)},
                        SEQ_COLUMN_NAME: out_seq,
                        **{name: a for name, a in zip(carry_names, out_values)}}
@@ -1334,6 +1400,88 @@ def combine_aggregate_parts(parts: list[tuple[np.ndarray, int, dict]],
         out["last_ts"] = np.where(empty, np.nan,
                                   acc["last_ts"].astype(np.float64))
     return all_values, out
+
+
+def _is_lex_sorted(keys: list[np.ndarray]) -> bool:
+    """True iff rows are non-decreasing under lexicographic key order."""
+    n = len(keys[0])
+    if n <= 1:
+        return True
+    still_equal = np.ones(n - 1, dtype=bool)
+    for c in keys:
+        if bool(np.any(still_equal & (c[:-1] > c[1:]))):
+            return False
+        still_equal &= c[:-1] == c[1:]
+        if not still_equal.any():
+            return True
+    return True
+
+
+def _plan_merge_perm(sort_cols: list[np.ndarray],
+                     seq: Optional[np.ndarray]) -> Optional[np.ndarray]:
+    """Host half of the k-way merge of pre-sorted SST runs.
+
+    The reference never re-sorts SST data: its per-file streams are
+    already PK-ordered and SortPreservingMergeExec merges them
+    (ref: src/storage/src/read.rs:455-480).  Our SSTs are written
+    PK-sorted too (storage.py), so the scan's device program does not
+    need an O(n log n) `lax.sort` — it needs, at most, a permutation
+    that interleaves the pre-sorted runs.  That permutation is planned
+    here, on the host, where the decoded parquet columns already live:
+
+    - verify sortedness first (O(n) compares): single-SST segments and
+      non-overlapping time-partitioned writes need NO work at all;
+    - otherwise pack the lexicographic key into one int64 and use
+      numpy's stable (radix, O(n)) argsort — effectively a k-way merge
+      whose cost is independent of comparator depth;
+    - keys whose combined range exceeds int64 fall back to np.lexsort.
+
+    `seq` must be passed ONLY when rows are not already in ascending
+    sequence order (stability preserves row order within equal keys,
+    which is what last-wins dedup needs).  Returns None when rows are
+    already sorted, else an int32 permutation over the input rows.
+    """
+    keys = list(sort_cols) + ([] if seq is None else [seq])
+    n = len(keys[0])
+    if n <= 1:
+        return None
+    packed = None
+    span_prod = 1
+    for c in keys:  # most-significant first
+        c64 = c.astype(np.int64, copy=False)
+        lo = int(c64.min())
+        span = int(c64.max()) - lo + 1
+        if span_prod * span >= 2**63:
+            packed = None
+            break
+        span_prod *= span
+        part = c64 - lo
+        packed = part if packed is None else packed * span + part
+    if packed is not None:
+        if bool(np.all(packed[:-1] <= packed[1:])):
+            return None
+        return np.argsort(packed, kind="stable").astype(np.int32)
+    if _is_lex_sorted(keys):
+        return None
+    return np.lexsort(tuple(reversed(keys))).astype(np.int32)
+
+
+def _window_merge_sel(sort_cols: list[np.ndarray], seq_h: np.ndarray,
+                      seq_ordered: bool, sel: np.ndarray) -> np.ndarray:
+    """Compose a window selection with its planned merge permutation —
+    the ONE place the (sort cols, seq-ordering) contract is applied to a
+    window, so every path orders rows identically."""
+    perm = _plan_merge_perm([c[sel] for c in sort_cols],
+                            None if seq_ordered else seq_h[sel])
+    return sel if perm is None else sel[perm]
+
+
+def _batch_merge_perm(sort_cols: list[np.ndarray], seq_h: np.ndarray,
+                      seq_ordered: bool, n: int) -> Optional[np.ndarray]:
+    """Whole-batch twin of _window_merge_sel: perm over rows [0, n) or
+    None when already sorted."""
+    return _plan_merge_perm([c[:n] for c in sort_cols],
+                            None if seq_ordered else seq_h[:n])
 
 
 def _plan_pk_windows(pk1_codes: np.ndarray, window: int) -> list[np.ndarray]:

@@ -23,6 +23,7 @@ from horaedb_tpu.ops import (
     Or,
     TimeRangePred,
     decode_to_arrow,
+    dedup_sorted_last,
     encode_batch,
     eval_predicate,
     merge_dedup_last,
@@ -171,6 +172,139 @@ class TestMergeDedup:
         valid = jnp.asarray(np.array([1, 1, 1, 1, 1, 1, 0, 0], dtype=bool))
         starts = np.asarray(sorted_run_starts((col,), valid))
         assert starts.tolist() == [True, False, True, False, False, True, False, False]
+
+
+class TestDedupSorted:
+    """dedup_sorted_last + the host merge planner must reproduce the
+    device-sort kernel's output exactly on any input."""
+
+    def _plan(self, pks, seq, n):
+        from horaedb_tpu.storage.read import _plan_merge_perm
+
+        return _plan_merge_perm([c[:n] for c in pks], seq[:n])
+
+    @pytest.mark.parametrize("seed", range(5))
+    def test_random_matches_device_sort(self, seed):
+        rng = np.random.default_rng(seed)
+        n = int(rng.integers(1, 150))
+        cap = pad_capacity(n)
+        pks = tuple(
+            np.pad(rng.integers(0, 6, n).astype(np.int32), (0, cap - n))
+            for _ in range(2))
+        seq = np.pad(rng.permutation(n).astype(np.int32), (0, cap - n))
+        vals = (np.pad(rng.random(n).astype(np.float32), (0, cap - n)),)
+
+        perm = self._plan(pks, seq, n)
+        if perm is not None:
+            full = np.arange(cap, dtype=np.int32)
+            full[:n] = perm
+            perm = jnp.asarray(full)
+        got = dedup_sorted_last(
+            tuple(jnp.asarray(c) for c in pks), jnp.asarray(seq),
+            tuple(jnp.asarray(v) for v in vals), n, perm=perm)
+        want = merge_dedup_last(
+            tuple(jnp.asarray(c) for c in pks), jnp.asarray(seq),
+            tuple(jnp.asarray(v) for v in vals), n)
+        k = int(want[4])
+        assert int(got[4]) == k
+        for g, w in zip(got[0] + (got[1],) + got[2],
+                        want[0] + (want[1],) + want[2]):
+            np.testing.assert_array_equal(np.asarray(g)[:k],
+                                          np.asarray(w)[:k])
+
+    def test_presorted_input_needs_no_perm(self):
+        """Single-SST case: rows arrive PK-sorted; the planner proves it
+        and the kernel runs gather-free."""
+        n, cap = 6, 128
+        pk = np.zeros(cap, dtype=np.int32)
+        pk[:n] = [1, 1, 2, 3, 3, 3]
+        seq = np.zeros(cap, dtype=np.int32)
+        seq[:n] = [0, 1, 0, 0, 1, 2]
+        val = np.zeros(cap, dtype=np.float32)
+        val[:n] = [1, 2, 3, 4, 5, 6]
+        assert self._plan((pk,), seq, n) is None
+        out_pks, _, out_vals, _, nr = dedup_sorted_last(
+            (jnp.asarray(pk),), jnp.asarray(seq), (jnp.asarray(val),), n)
+        assert int(nr) == 3
+        assert np.asarray(out_pks[0])[:3].tolist() == [1, 2, 3]
+        assert np.asarray(out_vals[0])[:3].tolist() == [2.0, 3.0, 6.0]
+
+    def test_planner_merges_presorted_runs(self):
+        """Two PK-sorted runs concatenated (two SSTs): the planned
+        permutation interleaves them; equal PKs keep run order (stable),
+        so the later file's row wins."""
+        from horaedb_tpu.storage.read import _plan_merge_perm
+
+        run_a = np.array([1, 3, 5], dtype=np.int32)
+        run_b = np.array([2, 3, 4], dtype=np.int32)
+        pk = np.concatenate([run_a, run_b])
+        perm = _plan_merge_perm([pk], None)
+        assert perm is not None
+        merged = pk[perm]
+        assert merged.tolist() == [1, 2, 3, 3, 4, 5]
+        # stable: the 3 from run_a (index 1) precedes run_b's (index 4)
+        assert perm.tolist().index(1) < perm.tolist().index(4)
+
+    def test_planner_int64_overflow_falls_back_to_lexsort(self):
+        from horaedb_tpu.storage.read import _plan_merge_perm
+
+        rng = np.random.default_rng(0)
+        wide = (rng.integers(0, 2**31 - 2, 64)).astype(np.int64)
+        cols = [wide, wide[::-1].copy(), rng.integers(0, 2**31 - 2, 64)]
+        perm = _plan_merge_perm(cols, None)
+        want = np.lexsort(tuple(reversed(cols)))
+        np.testing.assert_array_equal(perm, want)
+
+    def test_scan_output_identical_across_impls(self):
+        """End-to-end: the same multi-SST overwrite workload scanned
+        under host_perm and device_sort yields identical batches."""
+        import asyncio
+
+        from horaedb_tpu.objstore import MemoryObjectStore
+        from horaedb_tpu.ops import merge as merge_mod
+        from horaedb_tpu.storage.read import ScanRequest
+        from horaedb_tpu.storage.storage import CloudObjectStorage, WriteRequest
+        from horaedb_tpu.storage.types import TimeRange
+
+        schema = pa.schema([("tag", pa.int32()), ("ts", pa.int64()),
+                            ("v", pa.float64())])
+
+        async def build_and_scan():
+            rng = np.random.default_rng(3)  # identical data per impl
+            s = await CloudObjectStorage.open(
+                "t", 3600_000, MemoryObjectStore(), schema, 2)
+            try:
+                for _ in range(4):  # 4 overlapping SSTs in one segment
+                    n = 200
+                    tags = rng.integers(0, 5, n).astype(np.int32)
+                    ts = rng.integers(0, 3600_000, n).astype(np.int64)
+                    batch = pa.record_batch({
+                        "tag": pa.array(tags),
+                        "ts": pa.array(ts, type=pa.int64()),
+                        "v": pa.array(rng.random(n)),
+                    })
+                    await s.write(WriteRequest(
+                        batch, TimeRange.new(int(ts.min()),
+                                             int(ts.max()) + 1)))
+                out = []
+                async for b in s.scan(ScanRequest(
+                        range=TimeRange.new(0, 3600_000),
+                        predicate=None, projections=None)):
+                    out.append(b)
+                return pa.Table.from_batches(out)
+            finally:
+                await s.close()
+
+        results = {}
+        prev = merge_mod.merge_impl()
+        for impl in ("host_perm", "device_sort"):
+            merge_mod.set_merge_impl(impl)
+            try:
+                results[impl] = asyncio.run(build_and_scan())
+            finally:
+                merge_mod.set_merge_impl(prev)
+        assert results["host_perm"].equals(results["device_sort"])
+        assert results["host_perm"].num_rows > 0
 
 
 class TestDownsample:
