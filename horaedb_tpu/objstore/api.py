@@ -40,7 +40,14 @@ class ObjectStore(abc.ABC):
 
     @abc.abstractmethod
     async def delete(self, path: str) -> None:
-        """Delete; raises NotFoundError if absent."""
+        """Delete the object.
+
+        Memory/local backends raise NotFoundError for a missing key.
+        S3 is idempotent by default (missing keys succeed — deletes are
+        best-effort background fan-outs in the engine); opt into the
+        probing NotFoundError contract with S3Options.strict_delete.
+        Callers must not rely on NotFoundError from delete() for
+        correctness."""
 
     @abc.abstractmethod
     async def list(self, prefix: str) -> list[ObjectMeta]:

@@ -17,10 +17,13 @@ production surface the reference's config models:
 - an optional key prefix (ref: S3LikeStorageConfig.prefix).
 
 Payloads are signed with their SHA-256 (no UNSIGNED-PAYLOAD), so a
-corrupted body is rejected by the server.  DELETE honors the
-ObjectStore contract (NotFoundError for missing keys) via a HEAD
-pre-flight — deletes are background/best-effort in the engine, so the
-extra round trip is acceptable.
+corrupted body is rejected by the server.  DELETE is S3-native
+idempotent (one round trip; missing keys succeed) — the engine's
+deletes are background/best-effort fan-outs.  Set
+S3Options.strict_delete for the strict ObjectStore contract
+(NotFoundError via a HEAD probe).  A retried multipart initiate sweeps
+stray upload ids it may have created (ListMultipartUploads + abort),
+so orphaned uploads don't silently accrue storage.
 """
 
 from __future__ import annotations
@@ -68,6 +71,13 @@ class S3Options:
     multipart_threshold: int = 64 << 20
     multipart_part_size: int = 16 << 20
     multipart_concurrency: int = 4
+    # When True, DELETE probes with HEAD first so missing keys raise
+    # NotFoundError (the strict ObjectStore contract).  Default False:
+    # the engine's deletes are best-effort background fan-outs
+    # (compaction inputs, manifest deltas) and the extra HEAD doubles
+    # round trips on exactly that hot path — S3's native idempotent
+    # DELETE (204 for missing keys) is the right trade.
+    strict_delete: bool = False
 
     def __post_init__(self) -> None:
         # a trailing slash would double up in signed paths and fail every
@@ -187,7 +197,8 @@ class S3ObjectStore(ObjectStore):
                        data=b"",
                        extra_headers: Optional[dict] = None,
                        ok_status=(200,), io: bool = True,
-                       collect: bool = False):
+                       collect: bool = False,
+                       attempts_out: Optional[list] = None):
         """One S3 request with bounded retries: each attempt is re-signed
         (the date header changes) and backed off exponentially with
         jitter.  Callers only pass verbs that are safe to retry (the
@@ -199,7 +210,12 @@ class S3ObjectStore(ObjectStore):
         With collect=True the body is read INSIDE the retry loop (a
         connection dying mid-body is retried like any other transient
         failure) and (response, body) is returned; otherwise the caller
-        owns the unread response."""
+        owns the unread response.
+
+        `attempts_out`, when given, receives the number of attempts
+        actually sent — callers with non-idempotent verbs (multipart
+        initiate) use it to detect that a retry may have left server-side
+        state behind."""
         query = query or {}
         path = self._path(key) if key is not None else f"/{self.opts.bucket}"
         payload_hash = (hashlib.sha256(data).hexdigest()
@@ -220,6 +236,8 @@ class S3ObjectStore(ObjectStore):
 
         last_err: Optional[str] = None
         for attempt in range(self.opts.max_retries + 1):
+            if attempts_out is not None:
+                attempts_out.append(attempt + 1)
             if attempt:
                 backoff = (self.opts.retry_base_backoff_s * (2 ** (attempt - 1))
                            * (1 + random.random()))
@@ -269,13 +287,22 @@ class S3ObjectStore(ObjectStore):
         """Multipart upload: initiate, upload parts concurrently (each
         part retried independently by _request), complete; abort on any
         failure so no orphaned upload accrues storage."""
+        attempts: list = []
         _resp, body = await self._request("POST", path,
                                           query={"uploads": ""},
-                                          collect=True)
+                                          collect=True,
+                                          attempts_out=attempts)
         upload_id = _xml_text(body, "UploadId")
         if not upload_id:
             raise Error(f"s3 multipart initiate returned no UploadId "
                         f"for {path}")
+        if len(attempts) > 1:
+            # a retried initiate may have created an upload whose
+            # response was lost — that orphan would accrue storage until
+            # a bucket lifecycle rule fires.  SST keys have exactly one
+            # writer, so any OTHER in-progress upload for this key is a
+            # stray from our own retries: abort them (best-effort).
+            await self._abort_stray_uploads(path, keep=upload_id)
 
         part_size = self.opts.multipart_part_size
         view = memoryview(data)  # parts slice lazily — no payload copy
@@ -335,6 +362,43 @@ class S3ObjectStore(ObjectStore):
             except Exception:
                 pass  # abort is best-effort; the error below matters more
             raise
+
+    async def _abort_stray_uploads(self, key: str, keep: str) -> None:
+        """Abort in-progress multipart uploads for `key` other than
+        `keep` (our live upload id).  Best-effort: listing may not be
+        supported by every S3-alike, and a failure here must not fail
+        the actual upload."""
+        full_key = (f"{self.opts.prefix}/{key.lstrip('/')}" if self.opts.prefix
+                    else key.lstrip("/"))
+        try:
+            _resp, body = await self._request(
+                "GET", None, query={"uploads": "", "prefix": full_key},
+                collect=True, io=False)
+            root = ET.fromstring(body)
+            strays = []
+            for el in root.iter():
+                if el.tag == "Upload" or el.tag.endswith("}Upload"):
+                    k = uid = None
+                    for child in el:
+                        if child.tag == "Key" or child.tag.endswith("}Key"):
+                            k = child.text
+                        elif (child.tag == "UploadId"
+                              or child.tag.endswith("}UploadId")):
+                            uid = child.text
+                    if k == full_key and uid and uid != keep:
+                        strays.append(uid)
+        except Exception:
+            return  # listing failed; lifecycle rules are the backstop
+        for uid in strays:
+            try:
+                r = await self._request("DELETE", key,
+                                        query={"uploadId": uid},
+                                        ok_status=(200, 204), io=False)
+                r.release()
+            except Exception:
+                # one already-reaped (404) or failing abort must not
+                # stop the sweep of the remaining strays
+                continue
 
     async def _complete_multipart(self, path: str, upload_id: str,
                                   xml: bytes, expected_etag: str,
@@ -399,9 +463,12 @@ class S3ObjectStore(ObjectStore):
             resp.release()
 
     async def delete(self, path: str) -> None:
-        # S3 DELETE is idempotent (204 for missing keys); the ObjectStore
-        # contract wants NotFoundError, so probe first
-        await self.head(path)
+        # S3 DELETE is idempotent (204 for missing keys).  Only
+        # strict_delete pays a HEAD probe to honor the ObjectStore
+        # contract's NotFoundError; the default single round trip is
+        # what the engine's best-effort background deletes want.
+        if self.opts.strict_delete:
+            await self.head(path)
         resp = await self._request("DELETE", path, ok_status=(200, 204),
                                    io=False)
         resp.release()

@@ -207,8 +207,14 @@ class TestS3Store:
                 await store.delete("db/data/1.sst")
                 with pytest.raises(NotFoundError):
                     await store.get("db/data/1.sst")
+                # default delete is S3-native idempotent: one round
+                # trip, missing keys succeed
+                await store.delete("db/data/1.sst")
+                # strict_delete restores the probing contract
+                store.opts.strict_delete = True
                 with pytest.raises(NotFoundError):
                     await store.delete("db/data/1.sst")
+                store.opts.strict_delete = False
             finally:
                 await store.close()
                 await server.close()
