@@ -82,8 +82,9 @@ _CHUNKED_DATA_SCHEMA = (pa.schema([
 ]), 4)
 
 FIELD_TYPE_FLOAT = 0
-# keep per-(segment) registration dedup state for this many newest segments;
-# older entries can never be useful again and would grow without bound
+# keep per-segment registration dedup state for this many most-recently-
+# USED segments (LRU): live ingest and steady backfill each keep their
+# working set warm without unbounded growth
 _SEEN_SEGMENTS_KEPT = 4
 
 
@@ -100,21 +101,33 @@ def _empty_result() -> pa.Table:
 class _SegmentSeen:
     """Bounded (segment -> seen keys) registration cache.  Keys are added
     only AFTER the registration write succeeds, so a failed write is
-    retried on the next ingest instead of being skipped forever."""
+    retried on the next ingest instead of being skipped forever.
+
+    Eviction is RECENCY-based (LRU on read AND write), not
+    newest-segment-by-key: a steady backfill stream into old segments
+    keeps those segments' entries alive, instead of missing the cache on
+    every batch and rewriting metrics/series/index rows each time."""
 
     def __init__(self, keep: int = _SEEN_SEGMENTS_KEPT):
-        self._by_segment: dict[int, set] = {}
+        from collections import OrderedDict
+
+        self._by_segment: "OrderedDict[int, set]" = OrderedDict()
         self._keep = keep
 
     def __contains__(self, seg_key: tuple) -> bool:
         seg, key = seg_key
-        return key in self._by_segment.get(seg, ())
+        entry = self._by_segment.get(seg)
+        if entry is None:
+            return False
+        self._by_segment.move_to_end(seg)
+        return key in entry
 
     def add(self, seg: int, key) -> None:
+        if seg in self._by_segment:
+            self._by_segment.move_to_end(seg)
         self._by_segment.setdefault(seg, set()).add(key)
-        if len(self._by_segment) > self._keep:
-            for old in sorted(self._by_segment)[: len(self._by_segment) - self._keep]:
-                del self._by_segment[old]
+        while len(self._by_segment) > self._keep:
+            self._by_segment.popitem(last=False)
 
 
 class MetricManager:

@@ -272,14 +272,20 @@ def to_arrow_expression_with_key(pred: Predicate, allowed: set[str]):
 
 def eval_predicate(pred: Predicate, batch: DeviceBatch) -> jnp.ndarray:
     """Evaluate to a (capacity,) bool mask (padding rows unconstrained —
-    callers AND this with the batch validity mask)."""
+    callers AND this with the batch validity mask).
+
+    Residency-polymorphic: device-resident columns produce a fused
+    device mask; host (numpy) windows — the default scan layout — stay
+    entirely on host, so predicates never force a tunnel round trip."""
+    xp = (np if isinstance(next(iter(batch.columns.values()), None),
+                           np.ndarray) else jnp)
     if isinstance(pred, And):
-        mask = jnp.ones(batch.capacity, dtype=bool)
+        mask = xp.ones(batch.capacity, dtype=bool)
         for c in pred.children:
             mask = mask & eval_predicate(c, batch)
         return mask
     if isinstance(pred, Or):
-        mask = jnp.zeros(batch.capacity, dtype=bool)
+        mask = xp.zeros(batch.capacity, dtype=bool)
         for c in pred.children:
             mask = mask | eval_predicate(c, batch)
         return mask
@@ -292,15 +298,15 @@ def eval_predicate(pred: Predicate, batch: DeviceBatch) -> jnp.ndarray:
     if isinstance(pred, Eq):
         code = _const_code_exact(enc, pred.value)
         if code is None:
-            return jnp.zeros(batch.capacity, dtype=bool)
+            return xp.zeros(batch.capacity, dtype=bool)
         return col == code
     if isinstance(pred, Ne):
         code = _const_code_exact(enc, pred.value)
         if code is None:
-            return jnp.ones(batch.capacity, dtype=bool)
+            return xp.ones(batch.capacity, dtype=bool)
         return col != code
     if isinstance(pred, In):
-        mask = jnp.zeros(batch.capacity, dtype=bool)
+        mask = xp.zeros(batch.capacity, dtype=bool)
         for v in pred.values:
             code = _const_code_exact(enc, v)
             if code is not None:

@@ -12,11 +12,9 @@ over ICI — never row data.
 from horaedb_tpu.parallel.mesh import segment_mesh
 from horaedb_tpu.parallel.scan import (
     sharded_downsample_query,
-    sharded_dedup_presorted,
     sharded_merge_dedup,
     sharded_remap_partials,
 )
 
 __all__ = ["segment_mesh", "sharded_downsample_query",
-           "sharded_dedup_presorted", "sharded_merge_dedup",
-           "sharded_remap_partials"]
+           "sharded_merge_dedup", "sharded_remap_partials"]

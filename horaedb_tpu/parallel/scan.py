@@ -194,21 +194,6 @@ def sharded_merge_dedup(mesh, *, num_pks: int):
     return _build_sharded_merge(mesh, merge_ops.merge_dedup_last)
 
 
-def sharded_dedup_presorted(mesh, *, num_pks: int):
-    """Shard-local dedup of PRE-SORTED rows — the mesh twin of
-    `ops.merge.dedup_sorted_last`.
-
-    The host normalizes every window to PK-sorted order before stacking
-    (read.py _prepare_merge_windows plans a k-way-merge permutation over
-    the pre-sorted SST runs and composes it into the window gather), so
-    the shard program skips the variadic sort entirely: run-boundary
-    mask + segmented last-select only.  Same signature and layout as
-    sharded_merge_dedup.
-    """
-    del num_pks
-    return _build_sharded_merge(mesh, merge_ops.dedup_sorted_last)
-
-
 def shard_leading_axis(mesh, arr):
     """Place an (n_devices, ...) host array sharded over the segment axis."""
     return jax.device_put(arr, NamedSharding(mesh, P(SEGMENT_AXIS)))

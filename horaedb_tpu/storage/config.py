@@ -110,11 +110,12 @@ class ScanConfig:
     # allowance); this row knob converts at _CACHE_BYTES_PER_ROW unless
     # cache_max_bytes overrides it.
     cache_max_rows: int = 4 << 20
-    # explicit HBM budget in bytes for the scan cache (0 = derive from
-    # cache_max_rows).  NOTE: the flush-stack cache (stacked aggregation
-    # inputs memoized per flush group) reserves an ADDITIONAL
-    # cache_bytes // 4 on top of this budget — worst-case HBM held by
-    # the two caches together is 1.25x the configured value.
+    # explicit budget in bytes for the scan cache (0 = derive from
+    # cache_max_rows).  Under the default host_perm merge, cached scan
+    # windows are HOST-resident (RAM) and the flush-stack cache — the
+    # stacked aggregation inputs actually living in HBM — gets the same
+    # budget; worst-case HBM is 1x this value (2x in the device_sort
+    # A/B mode, where windows also occupy HBM).
     cache_max_bytes: int = 0
     # devices for the multi-chip aggregate path (0 = single-device);
     # windows batch onto a 1-D segment mesh in rounds of this size with

@@ -149,6 +149,7 @@ class ParquetReader:
 
         cache_bytes = (config.scan.cache_max_bytes
                        or config.scan.cache_max_rows * _CACHE_BYTES_PER_ROW)
+        self._cache_bytes = cache_bytes
         self.scan_cache = ScanCache(cache_bytes)
         # flush-stack LRU: stacked (B, cap) aggregation inputs reused by
         # repeat queries over cached windows.  Separately byte-accounted
@@ -159,7 +160,11 @@ class ParquetReader:
 
         self._stack_cache: "OrderedDict[tuple, tuple]" = OrderedDict()
         self._stack_cache_bytes = 0
-        self._stack_cache_max = cache_bytes // 4
+        # Under the default host_perm merge, windows live in HOST RAM and
+        # the stacks ARE the HBM working set — they get the full budget.
+        # (In device_sort A/B mode windows also occupy HBM, so worst
+        # case there is 2x the configured budget; see ScanConfig.)
+        self._stack_cache_max = cache_bytes
         self._stack_cache_lock = threading.Lock()
         self.mesh = None
         self._mesh_agg_fns: dict = {}
@@ -428,15 +433,14 @@ class ParquetReader:
             pk_names = self._pk_names_in(names)
             value_names = [nm for nm in names
                            if nm not in pk_names and nm != SEQ_COLUMN_NAME]
-            fn = self._mesh_merge_fns.get((scan_host_perm, len(pk_names)))
+            # only the device_sort A/B mode reaches here (host_perm
+            # windows arrive pre-merged and skip the rounds entirely)
+            fn = self._mesh_merge_fns.get(len(pk_names))
             if fn is None:
-                from horaedb_tpu.parallel.scan import (
-                    sharded_dedup_presorted, sharded_merge_dedup)
+                from horaedb_tpu.parallel.scan import sharded_merge_dedup
 
-                build = (sharded_dedup_presorted if scan_host_perm
-                         else sharded_merge_dedup)
-                fn = build(self.mesh, num_pks=len(pk_names))
-                self._mesh_merge_fns[(scan_host_perm, len(pk_names))] = fn
+                fn = sharded_merge_dedup(self.mesh, num_pks=len(pk_names))
+                self._mesh_merge_fns[len(pk_names)] = fn
             out_pks, out_seq, out_vals, _valid, num_runs = fn(
                 tuple(stacks[nm] for nm in pk_names),
                 stacks[SEQ_COLUMN_NAME],
@@ -455,6 +459,16 @@ class ParquetReader:
                 entry[2] -= 1
 
         async def enqueue(entry: list, descs: list) -> None:
+            if scan_host_perm:
+                # windows arrive merged+deduped on host (_prepare does
+                # the k-way merge): no shard merge rounds to run — the
+                # mesh engages at the AGGREGATE stage, where stacked
+                # windows shard over chips with psum combines
+                for cols, n_win, wcap, enc in descs:
+                    entry[1].append(encode.DeviceBatch(
+                        columns=cols, encodings=enc, n_valid=n_win,
+                        capacity=wcap))
+                return
             entry[2] += len(descs)
             for cols, n_win, wcap, enc in descs:
                 pending.append((entry, cols, n_win, wcap, enc))
@@ -788,54 +802,56 @@ class ParquetReader:
         if host_perm:
             seq_h = np.asarray(dev.columns[SEQ_COLUMN_NAME])[:n]
             seq_ordered = bool(np.all(seq_h[1:] >= seq_h[:-1]))
-        if n <= window:
-            cols = {k: np.asarray(v) for k, v in dev.columns.items()}
-            if host_perm:
-                # normalize to PK-sorted here so the shard kernel is
-                # dedup-only (no lax.sort): see _plan_merge_perm
-                perm = _batch_merge_perm([cols[nm] for nm in pk_names],
-                                         seq_h, seq_ordered, n)
-                if perm is not None:
-                    cols = {k: np.concatenate([v[perm], v[n:]])
-                            for k, v in cols.items()}
-            return [(cols, n, dev.capacity, dev.encodings)]
         host_cols = {name: np.asarray(c)[:n]
                      for name, c in dev.columns.items()}
-        # partition on the first NON-constant pk (same as the non-mesh
-        # path): windowing on a constant column would produce one
-        # unbounded window and defeat the HBM budget
-        part_name = next(
-            (nm for nm in pk_names
-             if host_cols[nm][0] != host_cols[nm][-1]
-             or not bool((host_cols[nm] == host_cols[nm][0]).all())),
-            pk_names[0])
+        if n <= window:
+            selections: list[Optional[np.ndarray]] = [None]
+        else:
+            # partition on the first NON-constant pk (same as the
+            # non-mesh path): windowing on a constant column would
+            # produce one unbounded window and defeat the HBM budget
+            part_name = next(
+                (nm for nm in pk_names
+                 if host_cols[nm][0] != host_cols[nm][-1]
+                 or not bool((host_cols[nm] == host_cols[nm][0]).all())),
+                pk_names[0])
+            selections = _plan_pk_windows(host_cols[part_name], window)
+        if host_perm:
+            # same host merge+dedup as _dispatch_merged_windows: the
+            # shard round then needs NO merge kernel at all
+            return _host_merge_window_descs(dev, host_cols, pk_names,
+                                            seq_h, seq_ordered, selections,
+                                            n)
         descs = []
-        for sel in _plan_pk_windows(host_cols[part_name], window):
-            if not len(sel):
+        for sel in selections:
+            if sel is not None and not len(sel):
                 continue
-            if host_perm:
-                # compose: the window gather below applies the merge
-                # order for free
-                sel = _window_merge_sel([host_cols[nm] for nm in pk_names],
-                                        seq_h, seq_ordered, sel)
+            if sel is None:
+                descs.append(({kk: np.asarray(v) for kk, v
+                               in dev.columns.items()},
+                              n, dev.capacity, dev.encodings))
+                continue
             n_win = len(sel)
             cap = encode.pad_capacity(n_win)
-            padded = {k: np.pad(v[sel], (0, cap - n_win))
-                      for k, v in host_cols.items()}
+            padded = {kk: np.pad(v[sel], (0, cap - n_win))
+                      for kk, v in host_cols.items()}
             descs.append((padded, n_win, cap, dev.encodings))
         return descs
 
     def _dispatch_merged_windows(self, batch: pa.RecordBatch) -> list:
-        """Device merge with bounded HBM: segments above
+        """Merge one segment with bounded memory: segments above
         scan.max_window_rows are split into PK-code-range windows, each a
         complete set of PK groups, merged independently in key order
         (windows are PK-ascending, so global order is preserved).  The
         streaming analogue of the reference's pull-based MergeStream
-        (SURVEY.md hard part #5).  Dispatches every window's merge
-        program WITHOUT syncing; _finalize_windows turns the results
-        into post-dedup DeviceBatches — consumers decode to Arrow (row
-        scan) or aggregate in place (pushdown path) without leaving the
-        device.
+        (SURVEY.md hard part #5).
+
+        Under the default host_perm impl the merge is a host
+        permutation-plan + run-keep over the pre-sorted SST runs and the
+        windows stay HOST-resident (rows cross to the device only as
+        batched stacks in the aggregate path).  Under device_sort the
+        original per-window lax.sort programs dispatch WITHOUT syncing;
+        _finalize_windows syncs the run counts either way.
         """
         dev = encode.encode_batch(batch)  # host-resident numpy columns
         pk_names = self._pk_names_in(batch.schema.names)
@@ -875,30 +891,27 @@ class ParquetReader:
             # meaningfully bounded even when pk 0 is constant
             selections = _plan_pk_windows(host_cols[sort_pk_names[0]], window)
 
-        host_perm = merge_ops.merge_impl() == "host_perm"
+        if merge_ops.merge_impl() == "host_perm":
+            # The merge runs ENTIRELY on host: plan the k-way-merge
+            # permutation over the pre-sorted SST runs, keep the last
+            # row per PK run, and hand out HOST-resident windows.  No
+            # per-window device round trips — the device sees rows only
+            # as large stacked uploads in the aggregate path, and row
+            # scans decode without a device->host fetch (the tunnel's
+            # scarce direction).
+            return [
+                (cols, enc, k, cap)
+                for cols, k, cap, enc in _host_merge_window_descs(
+                    dev, host_cols, sort_pk_names, seq_h, seq_ordered,
+                    selections, n)
+            ]
+
         dispatched = []
         for sel in selections:
-            dev_perm = None
             if sel is None:
                 # single-window fast path: encode_batch already padded
                 padded, n_win, cap = dev.columns, n, dev.capacity
-                if host_perm and n_win:
-                    perm = _batch_merge_perm(
-                        [host_cols[nm] for nm in sort_pk_names],
-                        seq_h, seq_ordered, n_win)
-                    if perm is not None:
-                        # identity over padding rows: the device gather
-                        # must map [n, cap) onto itself
-                        dev_perm = np.arange(cap, dtype=np.int32)
-                        dev_perm[:n_win] = perm
             else:
-                if host_perm and len(sel):
-                    # composing the window selection with the merge
-                    # permutation makes the merge FREE: the window
-                    # gather below was being paid anyway
-                    sel = _window_merge_sel(
-                        [host_cols[nm] for nm in sort_pk_names],
-                        seq_h, seq_ordered, sel)
                 sub = {k: v[sel] for k, v in host_cols.items()}
                 n_win = len(sel)
                 cap = encode.pad_capacity(n_win)
@@ -910,16 +923,9 @@ class ParquetReader:
             pks = tuple(dev_cols[name] for name in sort_pk_names)
             seq = dev_cols[SEQ_COLUMN_NAME]
             values = tuple(dev_cols[name] for name in carry_names)
-            if host_perm:
-                out_pks, out_seq, out_values, _out_valid, num_runs = \
-                    merge_ops.dedup_sorted_last(
-                        pks, seq, values, n_win,
-                        perm=None if dev_perm is None
-                        else jax.device_put(dev_perm))
-            else:
-                out_pks, out_seq, out_values, _out_valid, num_runs = \
-                    merge_ops.merge_dedup_last(pks, seq, values, n_win,
-                                               seq_in_row_order=seq_ordered)
+            out_pks, out_seq, out_values, _out_valid, num_runs = \
+                merge_ops.merge_dedup_last(pks, seq, values, n_win,
+                                           seq_in_row_order=seq_ordered)
             columns = {**{name: a for name, a in zip(sort_pk_names, out_pks)},
                        SEQ_COLUMN_NAME: out_seq,
                        **{name: a for name, a in zip(carry_names, out_values)}}
@@ -959,10 +965,140 @@ class ParquetReader:
         (group_values, finalized grids) combined across all segments and
         windows.  group_values are decoded host values (e.g. tsids) in
         sorted order; each grid is (len(group_values), num_buckets)."""
+        if self.fused_aggregate_ok(plan):
+            return await self.execute_aggregate_fused(plan, spec)
         parts: list[tuple[np.ndarray, dict]] = []
         async for _seg_start, seg_parts in self.aggregate_segments(plan, spec):
             parts.extend(seg_parts)
         return self.finalize_aggregate(parts, spec)
+
+    def fused_aggregate_ok(self, plan: Optional[ScanPlan] = None) -> bool:
+        """Whether the fused device-accumulated aggregate serves this
+        scan.  It requires single-device host_perm mode, and by default
+        engages only on ACCELERATOR backends: there, device->host is the
+        scarce resource (the per-flush partial downloads dominate) and
+        scatters are fast; on XLA-CPU the trade inverts — downloads are
+        free and scatter is the slow op, so the per-flush host f64 fold
+        wins.  HORAEDB_FUSED_AGG=1/0 forces it on/off (tests force it on
+        to cover the fused path on the CPU backend).  The mesh path
+        keeps per-round psum combines either way.
+
+        When `plan` is given, queries whose estimated row volume exceeds
+        the scan-cache budget fall back to the parts path: fused is
+        two-phase (all windows collected before the union group space is
+        known), so unlike the parts pipeline it pins every window in
+        host RAM for the query's duration — the budget is the bound."""
+        if self.mesh is not None or merge_ops.merge_impl() != "host_perm":
+            return False
+        if plan is not None:
+            est_rows = sum(f.meta.num_rows
+                           for seg in plan.segments for f in seg.ssts)
+            if est_rows * _CACHE_BYTES_PER_ROW > self._cache_bytes:
+                return False
+        import os
+
+        forced = os.environ.get("HORAEDB_FUSED_AGG", "")
+        if forced == "1":
+            return True
+        if forced == "0":
+            return False
+        import jax
+
+        return jax.default_backend() != "cpu"
+
+    async def execute_aggregate_fused(self, plan: ScanPlan,
+                                      spec: AggregateSpec,
+                                      counted: Optional[set] = None):
+        """Merge + downsample with a QUERY-GLOBAL device accumulator:
+        rounds of stacked windows aggregate and scatter into one
+        (groups, buckets) grid set on device; nothing is downloaded
+        until the final grids.
+
+        Two-phase by design: all windows are collected first so the
+        union group space is known before any round runs (remap targets
+        global rows directly).  Host RAM for the collected windows is
+        the same rows the parts path would hold across its pipeline;
+        the streamed-segment path still bounds per-segment
+        materialization.
+
+        Returns (group_values, grids) where grids hold DEVICE float32
+        arrays (downloaded lazily by the caller — np.asarray works; the
+        device work itself is complete, block_until_ready'd).  `last`
+        queries additionally materialize count/last_ts on host for the
+        int64 absolute-time conversion."""
+        if counted is None:
+            counted = set()
+        items: list[tuple[int, encode.DeviceBatch, tuple]] = []
+        windows_iter = self._cached_windows(plan)
+        try:
+            async for seg, windows, read_s in windows_iter:
+                s = seg.segment_start
+                # `counted` survives compaction-race restarts so a
+                # re-scanned segment doesn't double-count ops metrics
+                count_metrics = s not in counted
+
+                def prep(ws=windows, s=s, cm=count_metrics):
+                    out = []
+                    for w in ws:
+                        if cm:
+                            _ROWS_SCANNED.inc(w.n_valid)
+                        pr = self._window_groups(w, spec, plan)
+                        if pr is not None:
+                            out.append((s, w, pr))
+                    return out
+
+                items.extend(await self._run_pool(plan.pool, prep))
+                if count_metrics:
+                    _SCAN_LATENCY.observe(read_s)
+                    counted.add(s)
+        finally:
+            await windows_iter.aclose()
+        if not items:
+            values, grids = combine_aggregate_parts([], spec.num_buckets,
+                                                    which=spec.which)
+            return values, grids
+        all_values = np.unique(np.concatenate([it[2][0] for it in items]))
+        g = len(all_values)
+        g_pad = max(8, 1 << (g - 1).bit_length())
+        local_ok = all(
+            it[1].encodings[spec.ts_col].kind == "offset" for it in items)
+        width = self._window_grid_width(spec) if local_ok \
+            else spec.num_buckets
+        max_w = max(1, self.config.scan.agg_batch_windows)
+        total = jnp.int32(spec.num_buckets)
+        bucket_ms = jnp.int32(spec.bucket_ms)
+
+        def run_rounds():
+            acc = _fused_acc_init_jit(num_groups=g_pad,
+                                      num_buckets=spec.num_buckets,
+                                      which=spec.which)
+            i = 0
+            while i < len(items):
+                chunk = items[i:i + max_w]
+                batch_w = min(max_w, 1 << (len(chunk) - 1).bit_length())
+                cap = max(it[1].capacity for it in chunk)
+                ts_s, gid_s, val_s, remap_d, shift_d, lo_dev, _lo = \
+                    self._build_round_stacks(chunk, spec, plan, batch_w,
+                                             cap, g_pad, width, all_values,
+                                             local_ok)
+                acc = _fused_round_accumulate_jit(
+                    acc, ts_s, gid_s, val_s, remap_d, shift_d, lo_dev,
+                    total, bucket_ms, num_groups=g_pad, width=width,
+                    which=spec.which)
+                i += len(chunk)
+            final = _fused_finalize_jit(acc, spec.which)
+            out = {k: v[:g] for k, v in final.items()}
+            jax.block_until_ready(out)
+            return out
+
+        grids = await self._run_pool(plan.pool, run_rounds)
+        if "last_ts" in grids:
+            # absolute float ms needs int64 range: host conversion
+            count_h = np.asarray(grids["count"])
+            lt = np.asarray(grids["last_ts"]).astype(np.float64)
+            grids["last_ts"] = np.where(count_h > 0,
+                                        lt + spec.range_start, np.nan)
+        return all_values, grids
 
     async def aggregate_segments(self, plan: ScanPlan, spec: AggregateSpec):
         """Per segment, yield (segment_start, partial parts) — the
@@ -1101,6 +1237,10 @@ class ParquetReader:
         ensure(abs(shift) < 2**31, "query range too far from segment epoch")
         group_values = _decode_group_values(
             uniq, out_batch.encodings[spec.group_col])
+        # host windows keep a host gid (stacked + uploaded per round);
+        # device windows memoize the gid device-resident
+        if isinstance(out_batch.columns[spec.group_col], np.ndarray):
+            return group_values, gid_full, shift
         return group_values, jnp.asarray(gid_full), shift
 
     def _stack_cache_get(self, key: tuple, windows_now: tuple):
@@ -1149,6 +1289,100 @@ class ParquetReader:
         return int(min(spec.num_buckets,
                        max(8, 1 << (need - 1).bit_length())))
 
+    def _build_round_stacks(self, items: list, spec: AggregateSpec,
+                            plan: ScanPlan, batch_w: int, cap: int,
+                            g_pad: int, width: int,
+                            group_space: np.ndarray, local_ok: bool):
+        """Stack one round of windows for the aggregation program,
+        tunnel-aware:
+
+        - HOST windows (the default merge layout) stack in numpy and
+          cross to the device as ONE transfer per array — not one per
+          window per column;
+        - remap/shift/lo are placed on device HERE and cached with the
+          stacks, so a stack-cache hit issues ZERO transfers;
+        - under a mesh, placement uses the segment-axis sharding
+          directly (cached rounds live sharded — re-placing per query
+          would re-pay the transfer).
+
+        Stacked inputs are memoized in a reader-level LRU: for repeat
+        queries over scan-cached windows the stacks are identical.  The
+        entry carries the round's window OBJECTS: a hit requires the
+        exact same DeviceBatches (object identity — stable while
+        scan-cached), which both prevents id-reuse collisions and makes
+        entries self-invalidating; byte accounting and eviction live in
+        _stack_cache_put.
+
+        Returns (ts_s, gid_s, val_s, remap_d, shift_d, lo_d, lo_host).
+        """
+        if self.mesh is not None:
+            from horaedb_tpu.parallel.scan import shard_leading_axis
+
+            put = functools.partial(shard_leading_axis, self.mesh)
+        else:
+            put = jax.device_put
+        space_fp = (len(group_space), hash(group_space.tobytes()))
+        stack_key = (items[0][0], spec.group_col, spec.ts_col,
+                     spec.value_col, spec.bucket_ms, spec.range_start,
+                     batch_w, cap, g_pad, width, space_fp,
+                     filter_ops.canonical_predicate_key(plan.predicate))
+        windows_now = tuple(it[1] for it in items)
+        cached_stack = self._stack_cache_get(stack_key, windows_now)
+        if cached_stack is not None:
+            return cached_stack
+        remap = np.zeros((batch_w, g_pad), dtype=np.int32)
+        shift = np.zeros(batch_w, dtype=np.int32)
+        lo = np.zeros(batch_w, dtype=np.int32)
+        host_rows = all(
+            isinstance(it[1].columns[spec.ts_col], np.ndarray)
+            and isinstance(it[2][1], np.ndarray) for it in items)
+        for d, (_seg_start, _w, (values, _gid, sh)) in enumerate(items):
+            remap[d, : len(values)] = np.searchsorted(group_space, values)
+            shift[d] = sh
+            if local_ok:
+                lo[d] = max(0, sh // spec.bucket_ms)
+        if host_rows:
+            ts_m = np.zeros((batch_w, cap), dtype=np.int32)
+            gid_m = np.full((batch_w, cap), -1, dtype=np.int32)
+            val_m = np.zeros((batch_w, cap), dtype=np.float32)
+            for d, (_seg_start, w, (_values, gid, _sh)) in enumerate(items):
+                ts_m[d, : w.capacity] = w.columns[spec.ts_col]
+                gid_m[d, : w.capacity] = gid
+                val_m[d, : w.capacity] = w.columns[spec.value_col]
+            ts_s, gid_s, val_s = put(ts_m), put(gid_m), put(val_m)
+        else:
+            ts_rows, gid_rows, val_rows = [], [], []
+            for d, (_seg_start, w, (_values, gid_dev, _sh)) in \
+                    enumerate(items):
+                ts_d = w.columns[spec.ts_col]
+                val_d = w.columns[spec.value_col]
+                if w.capacity < cap:
+                    pad_n = cap - w.capacity
+                    ts_d = jnp.pad(ts_d, (0, pad_n))
+                    gid_dev = jnp.pad(gid_dev, (0, pad_n),
+                                      constant_values=-1)
+                    val_d = jnp.pad(val_d, (0, pad_n))
+                ts_rows.append(jnp.asarray(ts_d))
+                gid_rows.append(jnp.asarray(gid_dev))
+                val_rows.append(jnp.asarray(val_d))
+            if len(items) < batch_w:  # pad the round with no-op windows
+                empty_gid = jnp.full(cap, -1, dtype=jnp.int32)
+                zeros_i = jnp.zeros(cap, dtype=jnp.int32)
+                zeros_f = jnp.zeros(cap, dtype=jnp.float32)
+                for _ in range(batch_w - len(items)):
+                    ts_rows.append(zeros_i)
+                    gid_rows.append(empty_gid)
+                    val_rows.append(zeros_f)
+            ts_s = jnp.stack(ts_rows)
+            gid_s = jnp.stack(gid_rows)
+            val_s = jnp.stack(val_rows)
+            if self.mesh is not None:
+                ts_s, gid_s, val_s = put(ts_s), put(gid_s), put(val_s)
+        remap_d, shift_d, lo_d = put(remap), put(shift), put(lo)
+        entry = (ts_s, gid_s, val_s, remap_d, shift_d, lo_d, lo)
+        self._stack_cache_put(stack_key, windows_now, entry)
+        return entry
+
     def _flush_window_batch(self, items: list, spec: AggregateSpec,
                             plan: ScanPlan) -> list:
         """Aggregate one round of windows (possibly from several
@@ -1180,65 +1414,13 @@ class ParquetReader:
         width = self._window_grid_width(spec) if local_ok \
             else spec.num_buckets
 
-        # Stacked inputs are memoized in a reader-level LRU: for repeat
-        # queries over scan-cached windows the (B, cap) stacks, remap
-        # matrix, and shifts are identical, so rebuilding them (3 stack
-        # copies + pads per flush) is pure waste.  The entry carries the
-        # round's window OBJECTS: a hit requires the exact same
-        # DeviceBatches (object identity — stable while scan-cached),
-        # which both prevents id-reuse collisions and makes entries
-        # self-invalidating; byte accounting and eviction live in
-        # _stack_cache_put, independent of the per-window memo budget.
-        stack_key = (items[0][0], spec.group_col, spec.ts_col,
-                     spec.value_col, spec.bucket_ms, spec.range_start,
-                     batch_w, cap, g_pad, width,
-                     filter_ops.canonical_predicate_key(plan.predicate))
-        windows_now = tuple(it[1] for it in items)
-        cached_stack = self._stack_cache_get(stack_key, windows_now)
-        if cached_stack is not None:
-            ts_s, gid_s, val_s, remap, shift, lo = cached_stack
-        else:
-            ts_rows, gid_rows, val_rows = [], [], []
-            remap = np.zeros((batch_w, g_pad), dtype=np.int32)
-            shift = np.zeros(batch_w, dtype=np.int32)
-            lo = np.zeros(batch_w, dtype=np.int32)
-            for d, (_seg_start, w, (values, gid_dev, sh)) in enumerate(items):
-                ts_d = w.columns[spec.ts_col]
-                val_d = w.columns[spec.value_col]
-                if w.capacity < cap:
-                    pad_n = cap - w.capacity
-                    ts_d = jnp.pad(ts_d, (0, pad_n))
-                    gid_dev = jnp.pad(gid_dev, (0, pad_n),
-                                      constant_values=-1)
-                    val_d = jnp.pad(val_d, (0, pad_n))
-                ts_rows.append(ts_d)
-                gid_rows.append(gid_dev)
-                val_rows.append(val_d)
-                remap[d, : len(values)] = np.searchsorted(round_values,
-                                                          values)
-                shift[d] = sh
-                if local_ok:
-                    lo[d] = max(0, sh // spec.bucket_ms)
-            if len(items) < batch_w:  # pad the round with no-op windows
-                empty_gid = jnp.full(cap, -1, dtype=jnp.int32)
-                zeros_i = jnp.zeros(cap, dtype=jnp.int32)
-                zeros_f = jnp.zeros(cap, dtype=jnp.float32)
-                for _ in range(batch_w - len(items)):
-                    ts_rows.append(zeros_i)
-                    gid_rows.append(empty_gid)
-                    val_rows.append(zeros_f)
-            ts_s = jnp.stack(ts_rows)
-            gid_s = jnp.stack(gid_rows)
-            val_s = jnp.stack(val_rows)
-            self._stack_cache_put(stack_key, windows_now,
-                                  (ts_s, gid_s, val_s, remap, shift, lo))
+        ts_s, gid_s, val_s, remap_d, shift_d, lo_dev, lo = \
+            self._build_round_stacks(items, spec, plan, batch_w, cap,
+                                     g_pad, width, round_values, local_ok)
         total = jnp.int32(spec.num_buckets)
 
         if self.mesh is not None:
-            from horaedb_tpu.parallel.scan import (
-                shard_leading_axis,
-                sharded_remap_partials,
-            )
+            from horaedb_tpu.parallel.scan import sharded_remap_partials
 
             # memoize the compiled program per grid shape — rebuilding
             # the shard_map closure would recompile every round
@@ -1249,15 +1431,12 @@ class ParquetReader:
                                             num_buckets=width,
                                             which=spec.which)
                 self._mesh_agg_fns[fn_key] = fn
-            shard = functools.partial(shard_leading_axis, self.mesh)
-            stacked = fn(shard(ts_s), shard(gid_s), shard(val_s),
-                         shard(jnp.asarray(remap)), shard(jnp.asarray(shift)),
-                         shard(jnp.asarray(lo)), total,
+            stacked = fn(ts_s, gid_s, val_s, remap_d, shift_d, lo_dev, total,
                          jnp.asarray([spec.bucket_ms], dtype=jnp.int32))
         else:
             stacked = _batched_window_partials_jit(
-                ts_s, gid_s, val_s, jnp.asarray(remap), jnp.asarray(shift),
-                jnp.asarray(lo), total, jnp.int32(spec.bucket_ms),
+                ts_s, gid_s, val_s, remap_d, shift_d,
+                lo_dev, total, jnp.int32(spec.bucket_ms),
                 num_groups=g_pad, num_buckets=width, which=spec.which)
         # per-window partials fold on host in f64 (bit-equal to the
         # single-window path); padding windows are sliced away
@@ -1293,6 +1472,119 @@ class ParquetReader:
             mask = _eval_predicate_host(plan.predicate, merged)
             merged = merged.filter(pa.array(mask))
         return merged
+
+
+_ACC_TS_MIN = jnp.int32(-(2**31))
+
+
+@functools.partial(jax.jit, static_argnames=("num_groups", "num_buckets",
+                                             "which"))
+def _fused_acc_init_jit(*, num_groups: int, num_buckets: int, which: tuple):
+    """Query-global device accumulator grids with combine-identity
+    inits (matching ops.downsample partial conventions)."""
+    shape = (num_groups, num_buckets)
+    want = set(which)
+    if "avg" in want:
+        want.add("sum")
+    acc = {"count": jnp.zeros(shape, jnp.float32)}
+    if "sum" in want:
+        acc["sum"] = jnp.zeros(shape, jnp.float32)
+    if "min" in want:
+        acc["min"] = jnp.full(shape, jnp.finfo(jnp.float32).max, jnp.float32)
+    if "max" in want:
+        acc["max"] = jnp.full(shape, -jnp.finfo(jnp.float32).max,
+                              jnp.float32)
+    if "last" in want:
+        acc["last"] = jnp.zeros(shape, jnp.float32)
+        acc["last_ts"] = jnp.full(shape, _ACC_TS_MIN, jnp.int32)
+    return acc
+
+
+@functools.partial(jax.jit, static_argnames=("num_groups", "width", "which"),
+                   donate_argnums=(0,))
+def _fused_round_accumulate_jit(acc, ts, gid, vals, remap, shift, lo, total,
+                                bucket_ms, *, num_groups: int, width: int,
+                                which: tuple):
+    """One round of windows aggregated AND scattered into the
+    query-global accumulator, entirely on device.
+
+    This is the tunnel-aware replacement for the per-flush host fold:
+    instead of downloading (B, G, width) partial grids every round
+    (device->host is the scarce direction), each round's window-local
+    grids land in `acc` via bucket-offset scatters and only the final
+    grids ever leave the device.  `acc` is donated — the accumulator
+    updates in place round over round.
+
+    Correctness of the scatter combine: count/sum add their identity
+    (0) for cells a window didn't touch; min/max scatter through
+    .at[].min/.max with +/-F32_MAX identities; `last` does a sequential
+    gather-compare-scatter per window (window order = segment order, so
+    `>=` keeps later-window ties, matching the host combiner)."""
+    from horaedb_tpu.ops import downsample
+
+    def one(ts_b, gid_b, vals_b, remap_b, shift_b, lo_b):
+        return downsample.window_local_partials(
+            ts_b, gid_b, vals_b, remap_b, shift_b, lo_b, total, bucket_ms,
+            num_groups=num_groups, num_buckets=width, which=which)
+
+    p = jax.vmap(one)(ts, gid, vals, remap, shift, lo)
+    w_iota = jnp.arange(width, dtype=jnp.int32)
+
+    def body(d, acc):
+        cols = lo[d] + w_iota
+        out = dict(acc)
+        out["count"] = acc["count"].at[:, cols].add(p["count"][d],
+                                                    mode="drop")
+        if "sum" in acc:
+            out["sum"] = acc["sum"].at[:, cols].add(p["sum"][d], mode="drop")
+        if "min" in acc:
+            out["min"] = acc["min"].at[:, cols].min(p["min"][d], mode="drop")
+        if "max" in acc:
+            out["max"] = acc["max"].at[:, cols].max(p["max"][d], mode="drop")
+        if "last" in acc:
+            # fill_value must be a hashable Python scalar (jaxpr param)
+            cur_ts = acc["last_ts"].at[:, cols].get(mode="fill",
+                                                    fill_value=-(2**31))
+            cur_last = acc["last"].at[:, cols].get(mode="fill",
+                                                   fill_value=0.0)
+            win_has = p["count"][d] > 0
+            win_ts = jnp.where(win_has,
+                               p["last_ts"][d] + lo[d] * bucket_ms,
+                               _ACC_TS_MIN)
+            take = win_has & (win_ts >= cur_ts)
+            out["last"] = acc["last"].at[:, cols].set(
+                jnp.where(take, p["last"][d], cur_last), mode="drop")
+            out["last_ts"] = acc["last_ts"].at[:, cols].set(
+                jnp.where(take, win_ts, cur_ts), mode="drop")
+        return out
+
+    return jax.lax.fori_loop(0, ts.shape[0], body, acc)
+
+
+@functools.partial(jax.jit, static_argnames=("which",))
+def _fused_finalize_jit(acc: dict, which: tuple) -> dict:
+    """Device finalize of the fused accumulator.  Conventions match
+    combine_aggregate_parts: min/max empty cells read +/-inf, avg/last
+    NaN.  last_ts stays int32 (range-relative) — the absolute float
+    conversion needs int64 range and happens on host."""
+    count = acc["count"]
+    empty = count == 0
+    nan = jnp.float32(jnp.nan)
+    requested = set(which) | {"count"}
+    out = {"count": count}
+    if "sum" in acc and "sum" in requested:
+        out["sum"] = acc["sum"]
+    if "sum" in acc and "avg" in requested:
+        out["avg"] = jnp.where(empty, nan,
+                               acc["sum"] / jnp.maximum(count, 1.0))
+    if "min" in acc and "min" in requested:
+        out["min"] = jnp.where(empty, jnp.float32(jnp.inf), acc["min"])
+    if "max" in acc and "max" in requested:
+        out["max"] = jnp.where(empty, -jnp.float32(jnp.inf), acc["max"])
+    if "last" in acc and "last" in requested:
+        out["last"] = jnp.where(empty, nan, acc["last"])
+        out["last_ts"] = acc["last_ts"]
+    return out
 
 
 @functools.partial(jax.jit, static_argnames=("num_groups", "num_buckets",
@@ -1482,6 +1774,74 @@ def _batch_merge_perm(sort_cols: list[np.ndarray], seq_h: np.ndarray,
     None when already sorted."""
     return _plan_merge_perm([c[:n] for c in sort_cols],
                             None if seq_ordered else seq_h[:n])
+
+
+def _host_merge_window_descs(dev: encode.DeviceBatch, host_cols: dict,
+                             sort_pk_names: list[str], seq_h: np.ndarray,
+                             seq_ordered: bool, selections: list,
+                             n: int) -> list:
+    """THE host merge under the default host_perm impl, shared by the
+    single-device and mesh window preps so the two paths cannot drift:
+    per window, plan the k-way-merge permutation over pre-sorted SST
+    runs (_plan_merge_perm contract), keep the last row of each PK run,
+    and emit padded HOST-resident column dicts.
+
+    Returns [(cols, n_valid, capacity, encodings)] — deduped, PK-sorted
+    windows ready to wrap as DeviceBatches."""
+    descs = []
+    sort_cols = [host_cols[nm] for nm in sort_pk_names]
+    for sel in selections:
+        if sel is not None and not len(sel):
+            continue
+        if sel is None:
+            base = _batch_merge_perm(sort_cols, seq_h, seq_ordered, n)
+        else:
+            base = _window_merge_sel(sort_cols, seq_h, seq_ordered, sel)
+        keys = (sort_cols if base is None
+                else [c[base] for c in sort_cols])
+        keep = _host_dedup_keep(keys)
+        k = int(keep.sum())
+        if k == 0:
+            continue
+        if base is None:
+            if k == n and sel is None:
+                # no duplicates, already padded by encode_batch
+                descs.append(({kk: np.asarray(v) for kk, v
+                               in dev.columns.items()},
+                              n, dev.capacity, dev.encodings))
+                continue
+            idx = np.flatnonzero(keep)
+        else:
+            idx = base if k == len(base) else base[keep]
+        cap = encode.pad_capacity(k)
+        cols = {kk: np.pad(v[idx], (0, cap - k))
+                for kk, v in host_cols.items()}
+        descs.append((cols, k, cap, dev.encodings))
+    return descs
+
+
+def _host_dedup_keep(sort_cols: list[np.ndarray]) -> np.ndarray:
+    """Boolean keep-mask over PK-SORTED rows: the LAST row of each
+    equal-PK run survives (rows arrive with the preferred — highest
+    sequence — row last; see _plan_merge_perm's ordering contract).
+
+    This is the host half of last-value dedup under the default
+    host_perm merge: with the permutation already planned on host, the
+    run-boundary compare is a single vectorized pass over columns the
+    host just decoded — shipping rows to the device only to compare
+    neighbours and ship survivors back would pay the tunnel twice for
+    an O(n) bandwidth-bound op.  The devices' FLOPs are saved for the
+    aggregation grids."""
+    n = len(sort_cols[0])
+    if n == 0:
+        return np.zeros(0, dtype=bool)
+    keep = np.empty(n, dtype=bool)
+    keep[-1] = True
+    diff = np.zeros(n - 1, dtype=bool)
+    for c in sort_cols:
+        diff |= c[:-1] != c[1:]
+    keep[:-1] = diff
+    return keep
 
 
 def _plan_pk_windows(pk1_codes: np.ndarray, window: int) -> list[np.ndarray]:

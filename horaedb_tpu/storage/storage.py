@@ -225,8 +225,24 @@ class CloudObjectStorage(TimeMergeStorage):
     async def scan_aggregate(self, req: ScanRequest, spec):
         """Downsample pushdown: merge + GROUP BY group_col, time(bucket)
         on device; returns (group_values, grids).  See read.AggregateSpec.
-        Segments completed before a compaction race are not re-aggregated
-        (or re-counted in metrics) on the replan."""
+        The fused path (single-device host_perm) accumulates into one
+        query-global device grid and restarts whole on a compaction
+        race; the parts path skips segments completed before the race
+        on its replan."""
+        first_plan = await self.build_scan_plan(req)
+        if self.reader.fused_aggregate_ok(first_plan):
+            counted: set = set()  # ops metrics survive restarts
+            plan = first_plan
+            for attempt in range(self._SCAN_RETRIES + 1):
+                try:
+                    return await self.reader.execute_aggregate_fused(
+                        plan, spec, counted=counted)
+                except NotFoundError:
+                    if attempt == self._SCAN_RETRIES:
+                        raise
+                    logger.info("fused aggregate raced a compaction; "
+                                "restarting")
+                    plan = await self.build_scan_plan(req)
         done: dict[int, list] = {}
         for attempt in range(self._SCAN_RETRIES + 1):
             plan = await self.build_scan_plan(req)

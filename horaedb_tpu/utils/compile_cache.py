@@ -71,31 +71,22 @@ def enable_compile_cache(path: Optional[str] = None) -> Optional[str]:
     return cache_dir
 
 
-def prewarm(capacities: Iterable[int], *, num_pks: int = 2,
-            num_values: int = 1, num_groups: int = 128,
+def prewarm(capacities: Iterable[int], *, num_groups: int = 128,
             num_buckets: int = 256,
             which: tuple = ("avg", "count")) -> int:
-    """Compile the scan's device kernels for the given capacity buckets.
-
-    Shapes mirror what the read path emits: merge/dedup over
-    (num_pks + seq + num_values) int32/f32 columns at each capacity,
-    plus the downsample grid program.  Returns the number of programs
-    traced.  All dummy inputs are zeros — tracing only depends on
-    shape/dtype.
-    """
+    """Compile the downsample grid program for the given capacity
+    buckets (the merge itself runs on host under the default impl, so
+    the aggregation programs are the compile cost that matters).
+    Returns the number of programs traced.  All dummy inputs are zeros
+    — tracing only depends on shape/dtype."""
     import jax.numpy as jnp
 
-    from horaedb_tpu.ops import downsample, merge
+    from horaedb_tpu.ops import downsample
 
     count = 0
     for cap in sorted(set(int(c) for c in capacities)):
         zi = jnp.zeros(cap, dtype=jnp.int32)
         zf = jnp.zeros(cap, dtype=jnp.float32)
-        pks = tuple(zi for _ in range(num_pks))
-        vals = tuple(zf for _ in range(num_values))
-        merge.dedup_sorted_last(pks, zi, vals, 0)
-        merge.dedup_sorted_last(pks, zi, vals, 0, perm=zi)
-        count += 2
         downsample.time_bucket_aggregate(
             zi, zi, zf, 0, 60_000, num_groups=num_groups,
             num_buckets=num_buckets, which=which)

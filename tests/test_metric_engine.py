@@ -319,12 +319,42 @@ class TestReviewRegressions:
         async def go():
             e = await open_engine()
             try:
-                # write into 8 distinct segments; cache keeps only newest 4
+                # write into 8 distinct segments; cache keeps only 4
                 for i in range(8):
                     await e.write([sample("cpu", [("h", "x")],
                                           T0 + i * 2 * HOUR, float(i))])
                 segs = e.index_manager._seen._by_segment
                 assert len(segs) <= 4
+            finally:
+                await e.close()
+
+        asyncio.run(go())
+
+    def test_seen_cache_backfill_no_rewrite_churn(self):
+        """Steady backfill into an OLD segment must keep hitting the
+        seen-cache: registration rows are written once, not once per
+        batch (the LRU keeps recently-USED segments, not newest-keyed)."""
+        async def go():
+            e = await open_engine()
+            try:
+                # populate newer segments so a newest-by-key policy would
+                # evict the old one
+                for i in range(1, 6):
+                    await e.write([sample("cpu", [("h", "new")],
+                                          T0 + i * 2 * HOUR, 1.0)])
+                index = e.tables["index"]
+                writes_before = None
+                # repeated backfill batches into the OLDEST segment
+                for j in range(5):
+                    await e.write([sample("cpu", [("h", "old")],
+                                          T0 + 60_000 + j, float(j))])
+                    n_ssts = len(await index.manifest.all_ssts())
+                    if writes_before is None:
+                        writes_before = n_ssts  # first batch registers
+                    else:
+                        assert n_ssts == writes_before, (
+                            "backfill batch re-registered index rows: "
+                            f"{n_ssts} SSTs vs {writes_before}")
             finally:
                 await e.close()
 

@@ -194,13 +194,76 @@ class TestShardedRemapPartials:
                         sums[d, u, b], vals[d][m].sum(), rtol=1e-5)
 
 
+class TestFusedAggregate:
+    """The fused device-accumulated aggregate (the accelerator default —
+    one query-global grid on device, nothing downloaded per flush) must
+    match the per-flush host-fold parts path on the same data."""
+
+    def test_fused_matches_parts_path(self, monkeypatch):
+        import asyncio
+
+        import pyarrow as pa
+
+        from horaedb_tpu.metric_engine import MetricEngine
+        from horaedb_tpu.objstore import MemoryObjectStore
+        from horaedb_tpu.storage.config import StorageConfig, from_dict
+        from horaedb_tpu.storage.types import TimeRange
+
+        T0 = (1_700_000_000_000 // 7_200_000) * 7_200_000
+        SPAN = 6 * 3_600_000  # 3 segments
+
+        async def run():
+            cfg = from_dict(StorageConfig, {
+                "scan": {"max_window_rows": 512}})  # several windows/seg
+            e = await MetricEngine.open("fused", MemoryObjectStore(),
+                                        segment_ms=7_200_000, config=cfg)
+            try:
+                rng = np.random.default_rng(7)
+                n, hosts = 6000, 17
+                names = np.array([f"h{i:02d}" for i in range(hosts)],
+                                 dtype=object)
+                sel = rng.integers(0, hosts, n)
+                batch = pa.record_batch({
+                    "host": pa.array(names[sel]),
+                    "timestamp": pa.array(
+                        T0 + rng.integers(0, SPAN - 1, n), type=pa.int64()),
+                    "value": pa.array(rng.random(n) * 100,
+                                      type=pa.float64()),
+                })
+                await e.write_arrow("cpu", ["host"], batch)
+                # duplicate overwrite batch: dedup must hold in both paths
+                await e.write_arrow("cpu", ["host"], batch)
+                return await e.query_downsample(
+                    "cpu", [], TimeRange.new(T0, T0 + SPAN),
+                    bucket_ms=600_000)
+            finally:
+                await e.close()
+
+        results = {}
+        for mode in ("0", "1"):
+            monkeypatch.setenv("HORAEDB_FUSED_AGG", mode)
+            results[mode] = asyncio.run(run())
+        parts, fused = results["0"], results["1"]
+        assert parts["tsids"] == fused["tsids"]
+        np.testing.assert_array_equal(
+            np.asarray(parts["aggs"]["count"]),
+            np.asarray(fused["aggs"]["count"]))
+        for key in ("sum", "min", "max", "avg", "last", "last_ts"):
+            np.testing.assert_allclose(
+                np.asarray(parts["aggs"][key], dtype=np.float64),
+                np.asarray(fused["aggs"][key], dtype=np.float64),
+                rtol=1e-6, err_msg=key)
+
+
 class TestEngineMeshAggregation:
     """The engine's multi-chip aggregate path folds per-shard partials on
     host in f64.  With identical windowing it matches the single-device
     path BIT-FOR-BIT; across different window sizes a small f32
     within-window accumulation tolerance applies."""
 
-    def test_mesh_downsample_equals_single_device(self):
+    def test_mesh_downsample_equals_single_device(self, monkeypatch):
+        # pin the parts f64 fold on both legs so equality is exact
+        monkeypatch.setenv("HORAEDB_FUSED_AGG", "0")
         import asyncio
 
         import pyarrow as pa
@@ -252,6 +315,8 @@ class TestEngineMeshAggregation:
                     np.asarray(meshed["aggs"][key]), rtol=2e-4,
                     err_msg=key)
             # identical windowing: mesh must be BIT-equal to single-device
+            # (both legs run the parts f64 fold — HORAEDB_FUSED_AGG=0 is
+            # pinned; fused-vs-parts tolerance lives in TestFusedAggregate)
             single_small = await run(mesh_devices=0, window_rows=256)
             meshed_small = await run(mesh_devices=4, window_rows=256)
             assert single_small["tsids"] == meshed_small["tsids"]
@@ -318,10 +383,11 @@ class TestEngineMeshAggregation:
 
         asyncio.run(go())
 
-    def test_mesh_spans_segments_and_agg_subset(self):
+    def test_mesh_spans_segments_and_agg_subset(self, monkeypatch):
         """Windows from DIFFERENT segments batch onto one mesh round (the
         UnionExec axis); restricting `aggs` must not change the computed
         grids."""
+        monkeypatch.setenv("HORAEDB_FUSED_AGG", "0")  # parts on both legs
         import asyncio
 
         import pyarrow as pa
@@ -369,18 +435,27 @@ class TestEngineMeshAggregation:
             single = await run(0, ALL_AGGS)
             meshed = await run(4, ALL_AGGS)
             assert single["tsids"] == meshed["tsids"]
-            for key in ("count", "sum", "min", "max", "avg", "last"):
-                np.testing.assert_array_equal(
+            # counts exact; float grids to f32 ulp (fused f32 device
+            # accumulator vs the mesh's host f64 fold)
+            np.testing.assert_array_equal(
+                np.asarray(single["aggs"]["count"]),
+                np.asarray(meshed["aggs"]["count"]))
+            for key in ("sum", "min", "max", "avg", "last"):
+                np.testing.assert_allclose(
                     np.asarray(single["aggs"][key]),
-                    np.asarray(meshed["aggs"][key]), err_msg=key)
-            # restricted aggregates: same numbers, fewer grids
+                    np.asarray(meshed["aggs"][key]), rtol=1e-6,
+                    err_msg=key)
+            # restricted aggregates: same numbers, fewer grids; both
+            # single-device runs share the fused path, so EXACT equality
             subset = await run(0, ("avg",))
             assert "min" not in subset["aggs"] and "last" not in subset["aggs"]
             # sum is avg's dependency but was not requested
             assert "sum" not in subset["aggs"]
-            np.testing.assert_array_equal(subset["aggs"]["avg"],
-                                          np.asarray(single["aggs"]["avg"]))
-            np.testing.assert_array_equal(subset["aggs"]["count"],
-                                          np.asarray(single["aggs"]["count"]))
+            np.testing.assert_array_equal(
+                np.asarray(subset["aggs"]["avg"]),
+                np.asarray(single["aggs"]["avg"]))
+            np.testing.assert_array_equal(
+                np.asarray(subset["aggs"]["count"]),
+                np.asarray(single["aggs"]["count"]))
 
         asyncio.run(go())

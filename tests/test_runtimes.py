@@ -68,7 +68,9 @@ class TestRuntimes:
             try:
                 for _ in range(6):
                     await s.write(WriteRequest(
-                        big_batch(rng, 80_000),
+                        # sized so the rewrite takes >0.3s even with the
+                        # host_perm merge (no device sort to wait on)
+                        big_batch(rng, 200_000),
                         TimeRange.new(0, SEGMENT_MS)))
 
                 task = await s.compact_scheduler.picker.pick_candidate()
