@@ -147,12 +147,21 @@ def run_engine_headline(rows: int, iters: int) -> dict:
         out = await query(e)  # compile + first full read
         compile_s = time.perf_counter() - t0
 
+        from horaedb_tpu.storage.read import plan_stage_snapshot
+
         cold_times = []
-        for _ in range(max(2, iters // 5)):
+        stage_profile = {}
+        for i in range(max(2, iters // 5)):
             scan_cache(e).clear()
+            before = plan_stage_snapshot()
             t0 = time.perf_counter()
             out = await query(e)
             cold_times.append(time.perf_counter() - t0)
+            if i == 0:
+                after = plan_stage_snapshot()
+                stage_profile = {
+                    k: round(after[k] - before[k], 3)
+                    for k in after if after[k] != before[k]}
 
         cached_times = []
         for _ in range(iters):
@@ -160,7 +169,7 @@ def run_engine_headline(rows: int, iters: int) -> dict:
             out = await query(e)
             cached_times.append(time.perf_counter() - t0)
         return (out, compile_s, float(np.percentile(cold_times, 50)),
-                float(np.percentile(cached_times, 50)))
+                float(np.percentile(cached_times, 50)), stage_profile)
 
     async def main_async():
         e = await setup()
@@ -169,8 +178,10 @@ def run_engine_headline(rows: int, iters: int) -> dict:
         finally:
             await e.close()
 
-    out, compile_s, cold_p50, cached_p50 = asyncio.run(main_async())
+    out, compile_s, cold_p50, cached_p50, stage_profile = \
+        asyncio.run(main_async())
     log(f"compile+first query: {compile_s:.1f}s")
+    log(f"cold stage profile: {stage_profile}")
     log(f"cold p50 (parquet->encode->merge->downsample): "
         f"{cold_p50 * 1e3:.1f} ms ({n / cold_p50 / 1e6:.0f}M rows/s)")
     log(f"cached p50 (HBM-resident windows): {cached_p50 * 1e3:.1f} ms "
@@ -227,6 +238,9 @@ def run_engine_headline(rows: int, iters: int) -> dict:
         # the BASELINE metric is "rows scanned/sec/chip"
         "rows_per_s_cached": round(n / cached_p50),
         "rows_per_s_cold": round(n / cold_p50),
+        # per-plan-stage attribution of one cold query (seconds/rows/
+        # bytes deltas from the scan_stage_* registry metrics)
+        "stage_profile": stage_profile,
     }
 
 

@@ -29,11 +29,16 @@ import jax.numpy as jnp
 _PAD_SENTINEL = jnp.int32(2**31 - 1)
 
 # Which merge strategy the scan uses (see storage/read.py):
-#   host_perm   — exploit pre-sorted SST runs: host plans a permutation
-#                 (or proves none is needed), device pays one gather +
-#                 dedup (`dedup_sorted_last`). The default.
-#   device_sort — the original full `lax.sort` program
+#   host_perm   — exploit pre-sorted SST runs: the host plans a k-way
+#                 merge permutation (or proves none is needed) and keeps
+#                 the last row per PK run in one numpy pass
+#                 (read._host_merge_window_descs); rows reach the device
+#                 only as batched aggregation stacks.  The default.
+#   device_sort — the original full `lax.sort` device program
 #                 (`merge_dedup_last`); kept for A/B runs.
+# `dedup_sorted_last` below is the DEVICE twin of the host dedup —
+# exported for device-resident consumers and validated against
+# merge_dedup_last in tests; the default scan path does not call it.
 _MERGE_IMPLS = ("host_perm", "device_sort")
 _merge_impl = "host_perm"
 

@@ -57,6 +57,57 @@ _SCAN_LATENCY = registry.histogram(
     "storage_scan_seconds", "merge-scan latency per segment")
 _ROWS_SCANNED = registry.counter(
     "storage_rows_scanned_total", "rows produced by merge-scan")
+
+# Per-plan-stage attribution (the reference wires ExecutionPlanMetricsSet
+# through its reader, read.rs:84; ours records real numbers): seconds,
+# rows, and bytes per pipeline stage, cumulative in the registry and
+# diffable around a query for a per-query profile (bench.py does this).
+_PLAN_STAGES = ("parquet_read", "encode_merge", "stack_build",
+                "device_aggregate", "combine")
+_STAGE_SECONDS = {
+    s: registry.histogram(f"scan_stage_{s}_seconds",
+                          f"wall seconds spent in the {s} stage")
+    for s in _PLAN_STAGES
+}
+_STAGE_ROWS = {
+    s: registry.counter(f"scan_stage_{s}_rows_total",
+                        f"rows entering the {s} stage")
+    for s in ("parquet_read", "encode_merge")
+}
+_STAGE_BYTES = {
+    s: registry.counter(f"scan_stage_{s}_bytes_total",
+                        f"bytes entering the {s} stage")
+    for s in ("parquet_read", "stack_build")
+}
+
+
+def _timed_stage(stage: str):
+    """Decorator: attribute a function's wall time to a plan stage."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            t0 = time.perf_counter()
+            try:
+                return fn(*args, **kwargs)
+            finally:
+                _STAGE_SECONDS[stage].observe(time.perf_counter() - t0)
+        return wrapper
+    return deco
+
+
+def plan_stage_snapshot() -> dict:
+    """Cumulative per-stage numbers; diff two snapshots to attribute a
+    query's time (bench.py's cold-path profile)."""
+    out = {}
+    for s in _PLAN_STAGES:
+        h = _STAGE_SECONDS[s]
+        out[f"{s}_s"] = round(h.sum, 6)
+        out[f"{s}_calls"] = h.count
+    for s, c in _STAGE_ROWS.items():
+        out[f"{s}_rows"] = int(c.value)
+    for s, c in _STAGE_BYTES.items():
+        out[f"{s}_bytes"] = int(c.value)
+    return out
 # segment tables held in memory at once by _prefetch_tables (bounds BOTH
 # the row-scan and aggregate paths — including compaction's scan)
 _PREFETCH_SEGMENTS = 4
@@ -159,6 +210,12 @@ class ParquetReader:
         from collections import OrderedDict
 
         self._stack_cache: "OrderedDict[tuple, tuple]" = OrderedDict()
+        self._stack_cache_hits = 0
+        self._stack_cache_misses = 0
+        # tiny device constants (num_buckets, bucket_ms) memoized so a
+        # fully-cached query issues literally ZERO host->device
+        # transfers — even scalar uploads pay tunnel latency
+        self._scalar_cache: dict = {}
         self._stack_cache_bytes = 0
         # Under the default host_perm merge, windows live in HOST RAM and
         # the stacks ARE the HBM working set — they get the full budget.
@@ -584,7 +641,11 @@ class ParquetReader:
             t0 = time.perf_counter()
             table = await self._read_segment_table(seg, plan.pushdown,
                                                    pool=plan.pool)
-            return table, time.perf_counter() - t0
+            read_s = time.perf_counter() - t0
+            _STAGE_SECONDS["parquet_read"].observe(read_s)
+            _STAGE_ROWS["parquet_read"].inc(table.num_rows)
+            _STAGE_BYTES["parquet_read"].inc(table.nbytes)
+            return table, read_s
 
         tasks = [asyncio.create_task(read(seg)) for seg in segments]
         try:
@@ -778,6 +839,7 @@ class ParquetReader:
                 yielded_any = True
                 yield tbl.combine_chunks().to_batches()[0]
 
+    @_timed_stage("encode_merge")
     def _prepare_merge_windows(self, batch: pa.RecordBatch,
                                host_perm: Optional[bool] = None) -> list:
         """Host half of the merge: encode + PK-window planning + padding,
@@ -789,6 +851,7 @@ class ParquetReader:
         caller captures merge_impl() once): window prep and the round
         kernel must agree, or an impl flip mid-scan would hand unsorted
         windows to the sort-free kernel."""
+        _STAGE_ROWS["encode_merge"].inc(batch.num_rows)
         dev = encode.encode_batch(batch)
         pk_names = self._pk_names_in(batch.schema.names)
         ensure(len(pk_names) == self.schema.num_primary_keys,
@@ -838,6 +901,7 @@ class ParquetReader:
             descs.append((padded, n_win, cap, dev.encodings))
         return descs
 
+    @_timed_stage("encode_merge")
     def _dispatch_merged_windows(self, batch: pa.RecordBatch) -> list:
         """Merge one segment with bounded memory: segments above
         scan.max_window_rows are split into PK-code-range windows, each a
@@ -853,6 +917,7 @@ class ParquetReader:
         original per-window lax.sort programs dispatch WITHOUT syncing;
         _finalize_windows syncs the run counts either way.
         """
+        _STAGE_ROWS["encode_merge"].inc(batch.num_rows)
         dev = encode.encode_batch(batch)  # host-resident numpy columns
         pk_names = self._pk_names_in(batch.schema.names)
         ensure(len(pk_names) == self.schema.num_primary_keys,
@@ -990,18 +1055,18 @@ class ParquetReader:
         host RAM for the query's duration — the budget is the bound."""
         if self.mesh is not None or merge_ops.merge_impl() != "host_perm":
             return False
+        import os
+
+        forced = os.environ.get("HORAEDB_FUSED_AGG", "")
+        if forced == "1":  # force wins over the budget gate too
+            return True
+        if forced == "0":
+            return False
         if plan is not None:
             est_rows = sum(f.meta.num_rows
                            for seg in plan.segments for f in seg.ssts)
             if est_rows * _CACHE_BYTES_PER_ROW > self._cache_bytes:
                 return False
-        import os
-
-        forced = os.environ.get("HORAEDB_FUSED_AGG", "")
-        if forced == "1":
-            return True
-        if forced == "0":
-            return False
         import jax
 
         return jax.default_backend() != "cpu"
@@ -1065,13 +1130,19 @@ class ParquetReader:
         width = self._window_grid_width(spec) if local_ok \
             else spec.num_buckets
         max_w = max(1, self.config.scan.agg_batch_windows)
-        total = jnp.int32(spec.num_buckets)
-        bucket_ms = jnp.int32(spec.bucket_ms)
+        total = self._dev_scalar(spec.num_buckets)
+        bucket_ms = self._dev_scalar(spec.bucket_ms)
 
         def run_rounds():
+            # device_aggregate time is accumulated around the device
+            # calls only — _build_round_stacks self-reports under
+            # stack_build, so the two stages never double-count
+            t_dev = 0.0
+            t0 = time.perf_counter()
             acc = _fused_acc_init_jit(num_groups=g_pad,
                                       num_buckets=spec.num_buckets,
                                       which=spec.which)
+            t_dev += time.perf_counter() - t0
             i = 0
             while i < len(items):
                 chunk = items[i:i + max_w]
@@ -1081,14 +1152,19 @@ class ParquetReader:
                     self._build_round_stacks(chunk, spec, plan, batch_w,
                                              cap, g_pad, width, all_values,
                                              local_ok)
+                t0 = time.perf_counter()
                 acc = _fused_round_accumulate_jit(
                     acc, ts_s, gid_s, val_s, remap_d, shift_d, lo_dev,
                     total, bucket_ms, num_groups=g_pad, width=width,
                     which=spec.which)
+                t_dev += time.perf_counter() - t0
                 i += len(chunk)
+            t0 = time.perf_counter()
             final = _fused_finalize_jit(acc, spec.which)
             out = {k: v[:g] for k, v in final.items()}
             jax.block_until_ready(out)
+            t_dev += time.perf_counter() - t0
+            _STAGE_SECONDS["device_aggregate"].observe(t_dev)
             return out
 
         grids = await self._run_pool(plan.pool, run_rounds)
@@ -1243,10 +1319,22 @@ class ParquetReader:
             return group_values, gid_full, shift
         return group_values, jnp.asarray(gid_full), shift
 
+    def _dev_scalar(self, val: int, kind: str = "i32"):
+        """Memoized tiny device constants: 'i32' scalar or 'arr1'
+        one-element int32 array."""
+        key = (kind, int(val))
+        a = self._scalar_cache.get(key)
+        if a is None:
+            a = (jnp.asarray([int(val)], dtype=jnp.int32) if kind == "arr1"
+                 else jnp.int32(val))
+            self._scalar_cache[key] = a
+        return a
+
     def _stack_cache_get(self, key: tuple, windows_now: tuple):
         with self._stack_cache_lock:
             entry = self._stack_cache.get(key)
             if entry is None:
+                self._stack_cache_misses += 1
                 return None
             stored_refs, arrays, nbytes = entry
             # WEAK references: the entry must not pin evicted windows'
@@ -1256,8 +1344,10 @@ class ParquetReader:
                     ref() is w for ref, w in zip(stored_refs, windows_now)):
                 del self._stack_cache[key]
                 self._stack_cache_bytes -= nbytes
+                self._stack_cache_misses += 1
                 return None
             self._stack_cache.move_to_end(key)
+            self._stack_cache_hits += 1
             return arrays
 
     def _stack_cache_put(self, key: tuple, windows_now: tuple,
@@ -1330,6 +1420,7 @@ class ParquetReader:
         cached_stack = self._stack_cache_get(stack_key, windows_now)
         if cached_stack is not None:
             return cached_stack
+        t_build = time.perf_counter()
         remap = np.zeros((batch_w, g_pad), dtype=np.int32)
         shift = np.zeros(batch_w, dtype=np.int32)
         lo = np.zeros(batch_w, dtype=np.int32)
@@ -1380,6 +1471,9 @@ class ParquetReader:
                 ts_s, gid_s, val_s = put(ts_s), put(gid_s), put(val_s)
         remap_d, shift_d, lo_d = put(remap), put(shift), put(lo)
         entry = (ts_s, gid_s, val_s, remap_d, shift_d, lo_d, lo)
+        _STAGE_SECONDS["stack_build"].observe(time.perf_counter() - t_build)
+        _STAGE_BYTES["stack_build"].inc(
+            sum(int(a.nbytes) for a in entry[:6]))
         self._stack_cache_put(stack_key, windows_now, entry)
         return entry
 
@@ -1417,7 +1511,8 @@ class ParquetReader:
         ts_s, gid_s, val_s, remap_d, shift_d, lo_dev, lo = \
             self._build_round_stacks(items, spec, plan, batch_w, cap,
                                      g_pad, width, round_values, local_ok)
-        total = jnp.int32(spec.num_buckets)
+        total = self._dev_scalar(spec.num_buckets)
+        t_dev = time.perf_counter()
 
         if self.mesh is not None:
             from horaedb_tpu.parallel.scan import sharded_remap_partials
@@ -1432,15 +1527,17 @@ class ParquetReader:
                                             which=spec.which)
                 self._mesh_agg_fns[fn_key] = fn
             stacked = fn(ts_s, gid_s, val_s, remap_d, shift_d, lo_dev, total,
-                         jnp.asarray([spec.bucket_ms], dtype=jnp.int32))
+                         self._dev_scalar(spec.bucket_ms, "arr1"))
         else:
             stacked = _batched_window_partials_jit(
                 ts_s, gid_s, val_s, remap_d, shift_d,
-                lo_dev, total, jnp.int32(spec.bucket_ms),
+                lo_dev, total, self._dev_scalar(spec.bucket_ms),
                 num_groups=g_pad, num_buckets=width, which=spec.which)
         # per-window partials fold on host in f64 (bit-equal to the
         # single-window path); padding windows are sliced away
         host = {k: np.asarray(v) for k, v in stacked.items()}
+        _STAGE_SECONDS["device_aggregate"].observe(
+            time.perf_counter() - t_dev)
         parts = []
         for d in range(len(items)):
             lo_d = int(lo[d])
@@ -1616,6 +1713,7 @@ def _decode_group_values(codes: np.ndarray, enc) -> np.ndarray:
     return codes
 
 
+@_timed_stage("combine")
 def combine_aggregate_parts(parts: list[tuple[np.ndarray, int, dict]],
                             num_buckets: int,
                             which: tuple = downsample_ops.ALL_AGGS

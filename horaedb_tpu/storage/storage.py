@@ -245,7 +245,10 @@ class CloudObjectStorage(TimeMergeStorage):
                     plan = await self.build_scan_plan(req)
         done: dict[int, list] = {}
         for attempt in range(self._SCAN_RETRIES + 1):
-            plan = await self.build_scan_plan(req)
+            # attempt 0 reuses the plan built for the fused gate — one
+            # manifest lookup per query, not two
+            plan = first_plan if attempt == 0 \
+                else await self.build_scan_plan(req)
             plan.segments = [s for s in plan.segments
                              if s.segment_start not in done]
             try:

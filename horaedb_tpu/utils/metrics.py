@@ -79,6 +79,10 @@ class Histogram:
     def count(self) -> int:
         return self._count
 
+    @property
+    def sum(self) -> float:
+        return self._sum
+
     def quantile(self, q: float) -> Optional[float]:
         with self._lock:
             if not self._samples:

@@ -255,6 +255,72 @@ class TestFusedAggregate:
                 rtol=1e-6, err_msg=key)
 
 
+class TestCachedMeshResidency:
+    """VERDICT r2 item 6: a repeat meshed query must run from the
+    mesh-sharded stack cache — ZERO host->device transfers."""
+
+    def test_repeat_meshed_query_issues_no_transfers(self, monkeypatch):
+        import asyncio
+
+        import pyarrow as pa
+
+        from horaedb_tpu.metric_engine import MetricEngine
+        from horaedb_tpu.objstore import MemoryObjectStore
+        from horaedb_tpu.storage.config import StorageConfig, from_dict
+        from horaedb_tpu.storage.types import TimeRange
+
+        T0 = (1_700_000_000_000 // 7_200_000) * 7_200_000
+        SPAN = 4 * 3_600_000
+
+        async def go():
+            cfg = from_dict(StorageConfig, {
+                "scan": {"mesh_devices": 4, "max_window_rows": 512}})
+            e = await MetricEngine.open("resid", MemoryObjectStore(),
+                                        segment_ms=7_200_000, config=cfg)
+            try:
+                rng = np.random.default_rng(3)
+                n = 5000
+                batch = pa.record_batch({
+                    "host": pa.array(
+                        np.char.add("h", rng.integers(0, 9, n).astype(str))),
+                    "timestamp": pa.array(
+                        T0 + rng.integers(0, SPAN - 1, n), type=pa.int64()),
+                    "value": pa.array(rng.random(n)),
+                })
+                await e.write_arrow("cpu", ["host"], batch)
+                rng_q = TimeRange.new(T0, T0 + SPAN)
+                first = await e.query_downsample("cpu", [], rng_q,
+                                                 bucket_ms=600_000,
+                                                 aggs=("avg",))
+                reader = e.tables["data"].reader
+                assert reader._stack_cache_hits == 0
+                misses_after_first = reader._stack_cache_misses
+                assert misses_after_first > 0
+
+                puts = []
+                real_put = jax.device_put
+
+                def counting_put(x, *a, **kw):
+                    puts.append(np.shape(x))
+                    return real_put(x, *a, **kw)
+
+                monkeypatch.setattr(jax, "device_put", counting_put)
+                second = await e.query_downsample("cpu", [], rng_q,
+                                                  bucket_ms=600_000,
+                                                  aggs=("avg",))
+                monkeypatch.setattr(jax, "device_put", real_put)
+                assert reader._stack_cache_hits >= 1
+                assert reader._stack_cache_misses == misses_after_first
+                assert puts == [], f"repeat query uploaded: {puts}"
+                np.testing.assert_array_equal(
+                    np.asarray(first["aggs"]["avg"]),
+                    np.asarray(second["aggs"]["avg"]))
+            finally:
+                await e.close()
+
+        asyncio.run(go())
+
+
 class TestEngineMeshAggregation:
     """The engine's multi-chip aggregate path folds per-shard partials on
     host in f64.  With identical windowing it matches the single-device

@@ -42,6 +42,11 @@ class TestEndpoints:
                 assert r.status == 200
                 r = await client.get("/metrics")
                 assert r.status == 200
+                body = await r.text()
+                # per-plan-stage attribution is exported (VERDICT r2 #9)
+                for stage in ("parquet_read", "encode_merge",
+                              "device_aggregate", "combine"):
+                    assert f"scan_stage_{stage}_seconds" in body, stage
             finally:
                 await client.close()
                 await engine.close()
