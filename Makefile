@@ -1,11 +1,16 @@
-.PHONY: test native bench clean verify
+.PHONY: test native bench clean verify lint
 
 test:
 	python -m pytest tests/ -q
 
-# the driver-facing deliverables, end to end: full suite + the
+# stdlib AST lint gate (the reference CI runs fmt+clippy -D warnings;
+# this image ships no ruff/flake8, so the gate is tools/lint.py)
+lint:
+	python tools/lint.py
+
+# the driver-facing deliverables, end to end: lint + full suite + the
 # multi-chip dryrun on the virtual CPU mesh + a small engine bench
-verify: test
+verify: lint test
 	python -c "import __graft_entry__; __graft_entry__.dryrun_multichip(8); print('dryrun OK')"
 	BENCH_ROWS=200000 BENCH_ITERS=3 python bench.py
 

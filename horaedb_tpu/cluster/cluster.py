@@ -38,6 +38,11 @@ class Cluster:
         self._store = store
         self._segment_ms = segment_ms
         self._config = config
+        # heartbeat state (start_health_monitor): remote regions marked
+        # dead after consecutive failed pings fail queries fast
+        self._health_task: Optional[asyncio.Task] = None
+        self._health_fails: dict[int, int] = {}
+        self.dead_regions: set[int] = set()
 
     @classmethod
     async def open(cls, root_path: str, store: ObjectStore,
@@ -82,7 +87,6 @@ class Cluster:
         route to the new region only after the durable routing exists —
         a crash mid-split can orphan an empty region directory, never
         lose a routed write."""
-        import copy
 
         await self.add_region(new_region_id)
         new_routing = RoutingTable(rules=list(self.routing.rules),
@@ -95,6 +99,7 @@ class Cluster:
         self.routing = new_routing
 
     async def close(self) -> None:
+        await self.stop_health_monitor()
         for e in self.regions.values():
             await e.close()
 
@@ -111,6 +116,13 @@ class Cluster:
         speaking the server's HTTP API over DCN)."""
         ensure(region_id not in self.regions, f"region {region_id} exists")
         self.regions[region_id] = backend
+        self._clear_dead_mark(region_id)  # fresh backend, fresh health
+
+    def _clear_dead_mark(self, region_id: int) -> None:
+        """A region whose backend changed (adopted locally, re-attached
+        remote) must not inherit a stale dead mark or failure count."""
+        self.dead_regions.discard(region_id)
+        self._health_fails.pop(region_id, None)
 
     # ---- region movement --------------------------------------------------
 
@@ -126,6 +138,7 @@ class Cluster:
         to take it back)."""
         ensure(region_id in self.regions, f"region {region_id} not served")
         engine = self.regions.pop(region_id)
+        self._clear_dead_mark(region_id)
         close = getattr(engine, "close", None)
         if close is not None:
             await close()
@@ -148,21 +161,123 @@ class Cluster:
             if old is not None:
                 self.regions[region_id] = old
             raise
+        # the data is served locally now; a stale dead mark (from the
+        # remote proxy this replaces) must not keep failing queries
+        self._clear_dead_mark(region_id)
         if old is not None:
             close = getattr(old, "close", None)
             if close is not None:
                 await close()
 
     def region_loads(self) -> dict[int, int]:
-        """Rebalancing signal for THIS node: routing rules per region it
-        serves (proxies count too); detached regions are absent.
-        Operators move regions off nodes whose rule share is
-        disproportionate; data sizes come from the store's metrics."""
+        """Routing-rule share per served region — the cheap signal.
+        `region_stats()` is the REAL load signal (rows/bytes actually
+        stored); use this only when manifests are unreachable."""
         loads: dict[int, int] = {rid: 0 for rid in self.regions}
         for rule in self.routing.rules:
             if rule.region_id in loads:
                 loads[rule.region_id] += 1
         return loads
+
+    async def region_stats(self) -> dict[int, dict]:
+        """Per-region data volume: {rid: {rows, bytes, rules, remote}}.
+        Local regions read their manifests; remote regions are asked via
+        /stats (a dead remote reports rows/bytes -1 rather than failing
+        the whole survey)."""
+        rules = self.region_loads()
+        out: dict[int, dict] = {}
+        for rid, backend in self.regions.items():
+            remote = not isinstance(backend, MetricEngine)
+            try:
+                s = await backend.stats()
+                out[rid] = {"rows": int(s["rows"]), "bytes": int(s["bytes"]),
+                            "rules": rules.get(rid, 0), "remote": remote}
+            except Exception:
+                out[rid] = {"rows": -1, "bytes": -1,
+                            "rules": rules.get(rid, 0), "remote": remote}
+        return out
+
+    # ---- health -----------------------------------------------------------
+
+    _HEALTH_FAILS = 2
+
+    def start_health_monitor(self, interval_s: float = 5.0) -> None:
+        """Heartbeat remote regions so a dead peer is discovered by the
+        monitor, not by the first query that fans out to it.  After
+        _HEALTH_FAILS consecutive failed pings a region is marked dead
+        and routed queries fail IMMEDIATELY with an actionable error;
+        a successful ping clears the mark."""
+        ensure(self._health_task is None, "health monitor already running")
+        self._health_task = asyncio.create_task(
+            self._health_loop(interval_s))
+
+    async def stop_health_monitor(self) -> None:
+        if self._health_task is not None:
+            self._health_task.cancel()
+            try:
+                await self._health_task
+            except asyncio.CancelledError:
+                pass
+            self._health_task = None
+
+    async def check_health_once(self) -> dict[int, bool]:
+        """One heartbeat round (the monitor's body; callable directly in
+        tests/ops tooling).  Returns {rid: alive} for remote regions."""
+        alive: dict[int, bool] = {}
+        for rid, backend in list(self.regions.items()):
+            ping = getattr(backend, "ping", None)
+            if ping is None:
+                continue  # local engines don't need heartbeats
+            ok = await ping()
+            alive[rid] = ok
+            if ok:
+                self._health_fails[rid] = 0
+                self.dead_regions.discard(rid)
+            else:
+                self._health_fails[rid] = self._health_fails.get(rid, 0) + 1
+                if self._health_fails[rid] >= self._HEALTH_FAILS:
+                    self.dead_regions.add(rid)
+        return alive
+
+    async def _health_loop(self, interval_s: float) -> None:
+        while True:
+            try:
+                await self.check_health_once()
+            except Exception:  # a heartbeat crash must not kill the loop
+                pass
+            await asyncio.sleep(interval_s)
+
+    # ---- rebalancing ------------------------------------------------------
+
+    async def propose_rebalance(self, skew_ratio: float = 2.0
+                                ) -> list[dict]:
+        """Propose region moves from the REAL load signal: regions whose
+        stored bytes exceed `skew_ratio` x the mean are flagged with the
+        detach/adopt recipe (ownership handoff over the shared store —
+        no data copy).  Returns [] when balanced.  The operator (or an
+        external controller loop) executes the moves; this node cannot
+        know its peers' capacities."""
+        stats = await self.region_stats()
+        sized = {rid: s["bytes"] for rid, s in stats.items()
+                 if s["bytes"] >= 0}
+        if len(sized) < 2:
+            return []
+        mean = sum(sized.values()) / len(sized)
+        if mean <= 0:
+            return []
+        plan = []
+        for rid, b in sorted(sized.items(), key=lambda kv: -kv[1]):
+            if b > skew_ratio * mean:
+                plan.append({
+                    "region": rid,
+                    "bytes": b,
+                    "mean_bytes": round(mean),
+                    "reason": f"stores {b / mean:.1f}x the mean",
+                    "proposal": ("detach_region({rid}) here; "
+                                 "adopt_region({rid}) on a lighter node"
+                                 .format(rid=rid)),
+                })
+        return plan
 
     # ---- write ------------------------------------------------------------
 
@@ -180,6 +295,12 @@ class Cluster:
         ensure(not missing,
                f"routing targets unprovisioned regions {missing}; call "
                "add_region() after split()")
+        dead = [rid for rid in by_region if rid in self.dead_regions]
+        ensure(not dead,
+               f"write routes to DEAD remote regions {dead} (heartbeat "
+               "failing) — failing BEFORE any region commits so a retry "
+               "cannot duplicate rows; restore the peer or move the "
+               "region (adopt_region / add_remote_region)")
         await asyncio.gather(*(
             self.regions[rid].write(batch)
             for rid, batch in by_region.items()))
@@ -201,6 +322,11 @@ class Cluster:
                f"query routes to regions {missing} with no attached "
                "backend (moved/detached?); attach via add_remote_region "
                "or adopt_region")
+        dead = [rid for rid in rids if rid in self.dead_regions]
+        ensure(not dead,
+               f"query routes to DEAD remote regions {dead} (heartbeat "
+               "failing); restore the peer, or move the region here with "
+               "adopt_region / to another node with add_remote_region")
         return rids
 
     async def query(self, metric: str, filters: list[tuple[str, str]],

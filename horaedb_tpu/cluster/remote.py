@@ -56,6 +56,35 @@ class RemoteRegion:
 
         return json.loads(await self._post_raw(path, json=body))
 
+    async def ping(self, timeout_s: float = 2.0) -> bool:
+        """Cheap liveness probe (the server's hello endpoint).  False on
+        any failure — the health monitor turns repeated falses into a
+        dead mark so queries fail fast instead of at gather time."""
+        try:
+            session = await self._ensure_session()
+            async with session.get(
+                    self.base_url + "/",
+                    timeout=aiohttp.ClientTimeout(total=timeout_s)) as resp:
+                return resp.status == 200
+        except Exception:
+            return False
+
+    async def stats(self, timeout_s: float = 10.0) -> dict:
+        """Remote region data volume (rows/bytes) via the server's
+        /stats endpoint — the cluster's real load signal.  Bounded by
+        its own timeout: a blackholed peer must degrade the stats
+        survey, not stall it for aiohttp's 5-minute default."""
+        import json
+
+        session = await self._ensure_session()
+        async with session.get(
+                self.base_url + "/stats",
+                timeout=aiohttp.ClientTimeout(total=timeout_s)) as resp:
+            if resp.status != 200:
+                raise Error(f"remote region {self.base_url}/stats "
+                            f"returned {resp.status}")
+            return json.loads(await resp.read())
+
     # ---- MetricEngine surface ---------------------------------------------
 
     async def write(self, samples: list[Sample]) -> None:

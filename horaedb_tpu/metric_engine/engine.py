@@ -459,6 +459,21 @@ class MetricEngine:
         if getattr(self, "_runtimes", None) is not None:
             self._runtimes.close()
 
+    async def stats(self) -> dict:
+        """Data volume actually stored (rows/bytes per table, from the
+        manifests) — the cluster's rebalancing load signal."""
+        tables = {}
+        rows = size = 0
+        for name, t in self.tables.items():
+            ssts = await t.manifest.all_ssts()
+            t_rows = sum(f.meta.num_rows for f in ssts)
+            t_size = sum(f.meta.size for f in ssts)
+            tables[name] = {"ssts": len(ssts), "rows": t_rows,
+                            "bytes": t_size}
+            rows += t_rows
+            size += t_size
+        return {"rows": rows, "bytes": size, "tables": tables}
+
     # ---- write ------------------------------------------------------------
 
     async def write(self, samples: list[Sample]) -> None:
@@ -704,8 +719,13 @@ class MetricEngine:
         tbl = pa.Table.from_batches(batches)
         return tbl.select(["tsid", "timestamp", "value"])
 
-    def _decode_chunk_batches(self, batches: list[pa.RecordBatch],
-                              time_range: TimeRange) -> pa.Table:
+    @staticmethod
+    def _decode_chunk_arrays(batches: list[pa.RecordBatch],
+                             time_range: TimeRange):
+        """THE chunk-decode semantics (payload -> (tsid, ts, value)
+        numpy arrays, [start, end) masked), shared by the row-table and
+        device-downsample paths so they cannot drift.  Returns None when
+        no samples survive the mask."""
         import numpy as np
 
         from horaedb_tpu.metric_engine import chunks
@@ -726,11 +746,20 @@ class MetricEngine:
                     out_tsid.append(np.full(int(m.sum()), tsid,
                                             dtype=np.uint64))
         if not out_ts:
+            return None
+        return (np.concatenate(out_tsid), np.concatenate(out_ts),
+                np.concatenate(out_val))
+
+    def _decode_chunk_batches(self, batches: list[pa.RecordBatch],
+                              time_range: TimeRange) -> pa.Table:
+        decoded = self._decode_chunk_arrays(batches, time_range)
+        if decoded is None:
             return _empty_result()
+        tsid_np, ts_np, val_np = decoded
         return pa.table({
-            "tsid": pa.array(np.concatenate(out_tsid), type=pa.uint64()),
-            "timestamp": pa.array(np.concatenate(out_ts), type=pa.int64()),
-            "value": pa.array(np.concatenate(out_val), type=pa.float64()),
+            "tsid": pa.array(tsid_np, type=pa.uint64()),
+            "timestamp": pa.array(ts_np, type=pa.int64()),
+            "value": pa.array(val_np, type=pa.float64()),
         })
 
     async def resolve_series(self, metric: str, tsids: list[int],
@@ -760,11 +789,9 @@ class MetricEngine:
                "(~24.8 days); split the query into smaller windows")
         num_buckets = -(-span // bucket_ms)
         if self.chunked_data:
-            # chunk payloads are opaque to the scan, so decode rows first
-            # and aggregate the decoded columns on device
-            tbl = await self.query(metric, filters, time_range, field=field)
-            return self._downsample_rows(tbl, time_range, bucket_ms,
-                                         num_buckets, which=tuple(aggs))
+            return await self._downsample_chunked(
+                metric, filters, time_range, bucket_ms, num_buckets,
+                field=field, which=tuple(aggs))
         pred = await self._resolve_data_predicate(metric, filters,
                                                   time_range, field)
         if pred is None:
@@ -780,21 +807,50 @@ class MetricEngine:
                 "num_buckets": num_buckets,
                 "aggs": aggs if len(group_values) else {}}
 
+    async def _downsample_chunked(self, metric: str, filters, time_range,
+                                  bucket_ms: int, num_buckets: int,
+                                  field: str = "value",
+                                  which: tuple = ALL_AGGS) -> dict:
+        """Chunked-layout downsample that NEVER builds an Arrow row
+        table: chunk payloads batch-decode (numpy-vectorized) straight
+        into the fixed-width arrays the device aggregation consumes
+        (VERDICT r2 item 5; RFC 20240827:218-231 is the layout).  Same
+        pushdown grids as the row layout — parity-tested."""
+        pred = await self._resolve_data_predicate(metric, filters,
+                                                  time_range, field)
+        if pred is None:
+            return {"tsids": [], "num_buckets": num_buckets, "aggs": {}}
+        batches = await _collect(self.tables["data"].scan(ScanRequest(
+            range=time_range, predicate=pred)))
+        decoded = self._decode_chunk_arrays(batches, time_range)
+        if decoded is None:
+            return {"tsids": [], "num_buckets": num_buckets, "aggs": {}}
+        tsid_np, ts_np, val_np = decoded
+        return self._downsample_arrays(tsid_np, ts_np, val_np, time_range,
+                                       bucket_ms, num_buckets, which=which)
+
     def _downsample_rows(self, tbl: pa.Table, time_range: TimeRange,
                          bucket_ms: int, num_buckets: int,
                          which: tuple = ALL_AGGS) -> dict:
+        if tbl.num_rows == 0:
+            return {"tsids": [], "num_buckets": num_buckets, "aggs": {}}
+        return self._downsample_arrays(
+            tbl.column("tsid").to_numpy(), tbl.column("timestamp").to_numpy(),
+            tbl.column("value").to_numpy(), time_range, bucket_ms,
+            num_buckets, which=which)
+
+    def _downsample_arrays(self, tsid_np, ts_np, val_np,
+                           time_range: TimeRange, bucket_ms: int,
+                           num_buckets: int,
+                           which: tuple = ALL_AGGS) -> dict:
         import numpy as np
 
         from horaedb_tpu.ops.downsample import time_bucket_aggregate
         from horaedb_tpu.ops.encode import pad_capacity
 
-        n = tbl.num_rows
-        if n == 0:
-            return {"tsids": [], "num_buckets": num_buckets, "aggs": {}}
-        tsid_np = tbl.column("tsid").to_numpy()
+        n = len(ts_np)
         uniq, gid = np.unique(tsid_np, return_inverse=True)
-        ts_np = tbl.column("timestamp").to_numpy() - int(time_range.start)
-        val_np = tbl.column("value").to_numpy()
+        ts_np = ts_np - int(time_range.start)
         cap = pad_capacity(n)
         pad = lambda a, d: np.pad(a.astype(d), (0, cap - n))
         aggs = time_bucket_aggregate(

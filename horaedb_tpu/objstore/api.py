@@ -52,3 +52,20 @@ class ObjectStore(abc.ABC):
     @abc.abstractmethod
     async def list(self, prefix: str) -> list[ObjectMeta]:
         """All objects whose path starts with `prefix`, sorted by path."""
+
+    async def put_stream(self, path: str, chunks) -> int:
+        """Atomically create/replace `path` from an async iterator of
+        byte chunks; returns total bytes written.
+
+        Streaming-capable backends (local files, S3 multipart) bound
+        peak memory by the chunk/part size — a 1 GiB compaction output
+        costs one row group of RSS, not 1 GiB (ref: the reference
+        streams AsyncArrowWriter -> ParquetObjectWriter,
+        storage.rs:192-212).  This default buffers (correct for the
+        in-RAM memory store, where the object IS the buffer).  Partial
+        failures must not leave a readable object at `path`."""
+        buf = bytearray()
+        async for chunk in chunks:
+            buf += chunk
+        await self.put(path, bytes(buf))
+        return len(buf)

@@ -45,6 +45,38 @@ class LocalObjectStore(ObjectStore):
 
         await asyncio.to_thread(_put)
 
+    async def put_stream(self, path: str, chunks) -> int:
+        """Stream chunks to a temp file, then rename — peak RSS is one
+        chunk; the atomic-replace crash contract of put() holds."""
+        fs = self._fs_path(path)
+
+        def _open():
+            os.makedirs(os.path.dirname(fs), exist_ok=True)
+            return tempfile.mkstemp(dir=os.path.dirname(fs),
+                                    prefix=".tmp-put-")
+
+        fd, tmp = await asyncio.to_thread(_open)
+        total = 0
+        f = os.fdopen(fd, "wb")
+        try:
+            async for chunk in chunks:
+                await asyncio.to_thread(f.write, chunk)
+                total += len(chunk)
+            await asyncio.to_thread(f.flush)
+            f.close()
+            await asyncio.to_thread(os.replace, tmp, fs)
+            return total
+        except BaseException:
+            try:
+                f.close()
+            except OSError:
+                pass
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
     async def get(self, path: str) -> bytes:
         def _get() -> bytes:
             try:

@@ -283,10 +283,12 @@ class S3ObjectStore(ObjectStore):
         resp = await self._request("PUT", path, data=data)
         resp.release()
 
-    async def _put_multipart(self, path: str, data: bytes) -> None:
-        """Multipart upload: initiate, upload parts concurrently (each
-        part retried independently by _request), complete; abort on any
-        failure so no orphaned upload accrues storage."""
+    async def _initiate_multipart(self, path: str) -> str:
+        """CreateMultipartUpload; a RETRIED initiate may have created an
+        upload whose response was lost — that orphan would accrue
+        storage until a bucket lifecycle rule fires.  SST keys have
+        exactly one writer, so any OTHER in-progress upload for the key
+        is a stray from our own retries: sweep them (best-effort)."""
         attempts: list = []
         _resp, body = await self._request("POST", path,
                                           query={"uploads": ""},
@@ -297,13 +299,48 @@ class S3ObjectStore(ObjectStore):
             raise Error(f"s3 multipart initiate returned no UploadId "
                         f"for {path}")
         if len(attempts) > 1:
-            # a retried initiate may have created an upload whose
-            # response was lost — that orphan would accrue storage until
-            # a bucket lifecycle rule fires.  SST keys have exactly one
-            # writer, so any OTHER in-progress upload for this key is a
-            # stray from our own retries: abort them (best-effort).
             await self._abort_stray_uploads(path, keep=upload_id)
+        return upload_id
 
+    async def _abort_multipart(self, path: str, upload_id: str) -> None:
+        """Best-effort AbortMultipartUpload (the caller's error wins)."""
+        try:
+            r = await self._request("DELETE", path,
+                                    query={"uploadId": upload_id},
+                                    ok_status=(200, 204), io=False)
+            r.release()
+        except Exception:
+            pass
+
+    @staticmethod
+    def _complete_xml(etags: list[tuple[int, str]]) -> bytes:
+        parts = "".join(
+            f"<Part><PartNumber>{n}</PartNumber><ETag>{e}</ETag></Part>"
+            for n, e in etags)
+        return (f"<CompleteMultipartUpload>{parts}"
+                f"</CompleteMultipartUpload>").encode()
+
+    @staticmethod
+    def _expected_multipart_etag(etags: list[tuple[int, str]]
+                                 ) -> Optional[str]:
+        """The S3 multipart ETag is md5(concat(part md5s))-N and the
+        part PUT responses already carry each part's md5 — build the
+        expected object ETag from them (no client-side hashing) so a
+        lost complete response can be verified.  SSE-KMS/SSE-C buckets
+        return non-md5 part ETags; returns None there (size fallback
+        still applies)."""
+        try:
+            digests = b"".join(bytes.fromhex(e.strip('"'))
+                               for _n, e in etags)
+            return f"{hashlib.md5(digests).hexdigest()}-{len(etags)}"
+        except ValueError:
+            return None
+
+    async def _put_multipart(self, path: str, data: bytes) -> None:
+        """Multipart upload: initiate, upload parts concurrently (each
+        part retried independently by _request), complete; abort on any
+        failure so no orphaned upload accrues storage."""
+        upload_id = await self._initiate_multipart(path)
         part_size = self.opts.multipart_part_size
         view = memoryview(data)  # parts slice lazily — no payload copy
         n_parts = -(-len(data) // part_size)
@@ -332,35 +369,59 @@ class S3ObjectStore(ObjectStore):
                     t.cancel()
                 await asyncio.gather(*tasks, return_exceptions=True)
                 raise
-            complete = "".join(
-                f"<Part><PartNumber>{n}</PartNumber><ETag>{e}</ETag></Part>"
-                for n, e in etags)
-            xml = (f"<CompleteMultipartUpload>{complete}"
-                   f"</CompleteMultipartUpload>").encode()
-            # the S3 multipart ETag is md5(concat(part md5s))-N and the
-            # part PUT responses already carry each part's md5 — build
-            # the expected object ETag from them (no client-side
-            # hashing) so a lost complete response can be verified.
-            # SSE-KMS/SSE-C buckets return non-md5 part ETags; skip the
-            # ETag check there (size fallback still applies).
-            expected_etag = None
-            try:
-                part_digests = b"".join(
-                    bytes.fromhex(e.strip('"')) for _n, e in etags)
-                expected_etag = (f"{hashlib.md5(part_digests).hexdigest()}"
-                                 f"-{n_parts}")
-            except ValueError:
-                pass
-            await self._complete_multipart(path, upload_id, xml,
-                                           expected_etag, len(data))
+            etags = list(etags)
+            await self._complete_multipart(
+                path, upload_id, self._complete_xml(etags),
+                self._expected_multipart_etag(etags), len(data))
         except BaseException:
-            try:
-                r = await self._request("DELETE", path,
-                                        query={"uploadId": upload_id},
-                                        ok_status=(200, 204), io=False)
-                r.release()
-            except Exception:
-                pass  # abort is best-effort; the error below matters more
+            await self._abort_multipart(path, upload_id)
+            raise
+
+    async def put_stream(self, path: str, chunks) -> int:
+        """Streaming put: chunks accumulate to multipart part size and
+        upload as they fill, so peak memory is ~one part (16 MiB
+        default), not the object.  Objects that finish under the
+        multipart threshold fall back to one ordinary PUT.  On any
+        failure the in-progress upload is aborted — no readable object
+        and no orphaned parts."""
+        part_size = self.opts.multipart_part_size
+        buf = bytearray()
+        upload_id: Optional[str] = None
+        etags: list[tuple[int, str]] = []
+        total = 0
+
+        async def upload_part(data: bytes) -> None:
+            nonlocal upload_id
+            if upload_id is None:
+                upload_id = await self._initiate_multipart(path)
+            num = len(etags) + 1
+            r = await self._request(
+                "PUT", path,
+                query={"partNumber": str(num), "uploadId": upload_id},
+                data=data)
+            etags.append((num, r.headers.get("ETag", "")))
+            r.release()
+
+        try:
+            async for chunk in chunks:
+                buf += chunk
+                total += len(chunk)
+                while len(buf) >= part_size:
+                    await upload_part(bytes(buf[:part_size]))
+                    del buf[:part_size]
+            if upload_id is None:
+                # small object: single PUT, no multipart bookkeeping
+                await self.put(path, bytes(buf))
+                return total
+            if buf or not etags:
+                await upload_part(bytes(buf))
+            await self._complete_multipart(
+                path, upload_id, self._complete_xml(etags),
+                self._expected_multipart_etag(etags), total)
+            return total
+        except BaseException:
+            if upload_id is not None:
+                await self._abort_multipart(path, upload_id)
             raise
 
     async def _abort_stray_uploads(self, key: str, keep: str) -> None:

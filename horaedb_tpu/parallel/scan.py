@@ -20,8 +20,6 @@ the reference's cross-partition SortPreservingMergeExec, except only
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 from jax import shard_map

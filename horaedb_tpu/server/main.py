@@ -7,6 +7,7 @@ main.rs:59-80 notes "No query/read endpoint exists yet"):
   GET  /toggle   pause/resume the test write-load generator
   GET  /compact  trigger compaction on every table
   GET  /metrics  Prometheus text metrics
+  GET  /stats    rows/bytes per table (cluster load signal)
   POST /write    JSON samples: {"samples": [{"name", "labels": {k:v},
                  "timestamp", "value"}]}
   POST /query    JSON: {"metric", "filters": {k:v}, "start", "end",
@@ -108,6 +109,12 @@ def build_app(state: ServerState) -> web.Application:
     async def metrics(_req: web.Request) -> web.Response:
         return web.Response(text=registry.render(),
                             content_type="text/plain")
+
+    @routes.get("/stats")
+    async def stats(_req: web.Request) -> web.Response:
+        # data-volume load signal for cluster rebalancing (rows/bytes
+        # per table from the manifests)
+        return web.json_response(await state.engine.stats())
 
     @routes.post("/write")
     async def write(req: web.Request) -> web.Response:

@@ -255,17 +255,17 @@ class Executor:
         file_id = SstFile.allocate_id()
         path = sst_path(storage.root_path, file_id)
 
-        # stream batches into the parquet encoder as they arrive — peak
-        # memory is the compressed output, not the raw row batches
+        # stream batches through the parquet encoder INTO the store —
+        # peak memory is ~one row group (+ one multipart part on S3),
+        # not the compressed output: a 1 GiB rewrite costs megabytes of
+        # RSS (ref: storage.rs:192-212 AsyncArrowWriter pipeline)
         async def restored():
             async for batch in storage.reader.execute(plan):
                 yield _restore_reserved_column(batch, storage.schema())
 
-        data, num_rows = await parquet_io.encode_sst_stream(
-            restored(), storage.config.write, storage.schema(),
-            runtimes=storage.runtimes, pool="compact")
-        await storage.store.put(path, data)
-        size = len(data)
+        size, num_rows = await parquet_io.write_sst_streaming(
+            storage.store, path, restored(), storage.config.write,
+            storage.schema(), runtimes=storage.runtimes, pool="compact")
         meta = FileMeta(max_sequence=file_id, num_rows=num_rows, size=size,
                         time_range=time_range)
         logger.debug("compaction output sst id=%s rows=%s size=%s",

@@ -87,39 +87,6 @@ def encode_sst(batches: list[pa.RecordBatch], config: WriteConfig,
     return sink.getvalue()
 
 
-async def encode_sst_stream(batches, config: WriteConfig,
-                            schema: StorageSchema, runtimes=None,
-                            pool: str = "compact") -> tuple[bytes, int]:
-    """Streaming twin of encode_sst over an async batch iterator: batches
-    feed the parquet encoder as they arrive, so peak memory is the
-    compressed output.  Encoding runs on a worker pool batch by batch
-    (the writer is driven sequentially, never concurrently).
-    Returns (bytes, num_rows)."""
-    sink = io.BytesIO()
-    writer = pq.ParquetWriter(sink, schema.arrow_schema,
-                              **writer_options(config, schema))
-    num_rows = 0
-    finished = False
-    try:
-        async for batch in batches:
-            num_rows += batch.num_rows
-            await _run(runtimes, pool, writer.write_batch, batch,
-                       row_group_size=config.max_row_group_size)
-
-        def finish() -> bytes:
-            # the close flushes the last row group + footer, and
-            # getvalue copies the whole SST — keep both off the loop
-            writer.close()
-            return sink.getvalue()
-
-        data = await _run(runtimes, pool, finish)
-        finished = True
-        return data, num_rows
-    finally:
-        if not finished:
-            writer.close()
-
-
 async def _run(runtimes, pool: str, fn, *args, **kwargs):
     """Run CPU work on a named pool (common.runtimes), falling back to
     asyncio's default thread pool when no runtimes were provided — the
@@ -141,6 +108,83 @@ async def write_sst(store: ObjectStore, path: str,
     data = await _run(runtimes, pool, encode_sst, batches, config, schema)
     await store.put(path, data)
     return len(data)
+
+
+class _DrainableSink(io.RawIOBase):
+    """File-like sink the ParquetWriter writes into; drain() hands the
+    bytes accumulated since the last drain to the store stream, so the
+    encoded SST never exists in one buffer."""
+
+    def __init__(self) -> None:
+        self._chunks: list[bytes] = []
+        self._pos = 0
+
+    def writable(self) -> bool:
+        return True
+
+    def write(self, b) -> int:
+        data = bytes(b)
+        self._chunks.append(data)
+        self._pos += len(data)
+        return len(data)
+
+    def tell(self) -> int:
+        return self._pos
+
+    def drain(self) -> bytes:
+        out = b"".join(self._chunks)
+        self._chunks.clear()
+        return out
+
+
+async def write_sst_streaming(store: ObjectStore, path: str, batches,
+                              config: WriteConfig, schema: StorageSchema,
+                              runtimes=None, pool: str = "compact"
+                              ) -> tuple[int, int]:
+    """Stream an async iterator of sorted batches through the parquet
+    encoder INTO the store: each flushed row group is handed to
+    store.put_stream as it encodes (S3 uploads it as a multipart part;
+    the local store appends to the temp file), so peak RSS for an
+    arbitrarily large SST is ~one row group + one part buffer — the
+    reference's AsyncArrowWriter -> ParquetObjectWriter pipeline
+    (ref: src/storage/src/storage.rs:192-212, executor.rs:155-222).
+
+    A mid-stream failure propagates out of put_stream's iterator, which
+    aborts the multipart upload / unlinks the temp file — no readable
+    object and no orphaned parts.  Returns (size, num_rows)."""
+    sink = _DrainableSink()
+    writer = pq.ParquetWriter(sink, schema.arrow_schema,
+                              **writer_options(config, schema))
+    rows = 0
+
+    async def chunks():
+        nonlocal rows
+        closed = False
+        try:
+            async for batch in batches:
+                rows += batch.num_rows
+                # slice to row-group size so every flushed group drains
+                # to the store before the next encodes — a large merged
+                # batch must not accumulate in the sink
+                step = max(1, config.max_row_group_size)
+                for off in range(0, batch.num_rows, step):
+                    await _run(runtimes, pool, writer.write_batch,
+                               batch.slice(off, step),
+                               row_group_size=step)
+                    data = sink.drain()
+                    if data:
+                        yield data
+            await _run(runtimes, pool, writer.close)
+            closed = True
+            tail = sink.drain()
+            if tail:
+                yield tail
+        finally:
+            if not closed:
+                writer.close()
+
+    size = await store.put_stream(path, chunks())
+    return size, rows
 
 
 def merge_value_counts(pairs: list) -> tuple:

@@ -16,7 +16,7 @@ from typing import Optional
 import numpy as np
 import pyarrow as pa
 
-from horaedb_tpu.common.error import Error, ensure
+from horaedb_tpu.common.error import ensure
 from horaedb_tpu.storage.config import UpdateMode
 
 BUILTIN_COLUMN_NUM = 2

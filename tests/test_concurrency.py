@@ -15,7 +15,6 @@ import asyncio
 
 import pyarrow as pa
 
-from horaedb_tpu.common import ReadableDuration
 from horaedb_tpu.objstore import MemoryObjectStore
 from horaedb_tpu.storage.config import StorageConfig, from_dict
 from horaedb_tpu.storage.read import ScanRequest

@@ -15,7 +15,7 @@ import asyncio
 import pyarrow as pa
 import pytest
 
-from horaedb_tpu.common import Error, ReadableDuration
+from horaedb_tpu.common import ReadableDuration
 from horaedb_tpu.objstore import MemoryObjectStore
 from horaedb_tpu.storage.config import StorageConfig, from_dict
 from horaedb_tpu.storage.read import ScanRequest

@@ -735,6 +735,52 @@ class TestChunkedDataMode:
 
         asyncio.run(go())
 
+    def test_chunked_downsample_parity_with_row_layout_no_row_table(self):
+        """The chunked fast path must produce the SAME grids as the row
+        layout on identical samples, and must never materialize an
+        Arrow row table (payload -> numpy -> device)."""
+        async def go():
+            rng = np.random.default_rng(11)
+            n = 4000
+            samples = [
+                sample("cpu", [("h", f"h{int(h):02d}")],
+                       T0 + int(t), float(v))
+                for h, t, v in zip(rng.integers(0, 7, n),
+                                   rng.integers(0, 2 * HOUR, n),
+                                   rng.random(n) * 100)
+            ]
+            row_e = await open_engine()
+            chunk_e = await self._open_chunked()
+            try:
+                await row_e.write(samples)
+                await chunk_e.write(samples)
+                rng_q = TimeRange.new(T0, T0 + 2 * HOUR)
+
+                called = []
+                orig = chunk_e.query
+
+                async def spying_query(*a, **kw):
+                    called.append(a)
+                    return await orig(*a, **kw)
+
+                chunk_e.query = spying_query
+                want = await row_e.query_downsample("cpu", [], rng_q,
+                                                    bucket_ms=600_000)
+                got = await chunk_e.query_downsample("cpu", [], rng_q,
+                                                     bucket_ms=600_000)
+                assert called == [], "chunked downsample built a row table"
+                assert got["tsids"] == want["tsids"]
+                for key in want["aggs"]:
+                    np.testing.assert_allclose(
+                        np.asarray(got["aggs"][key], dtype=np.float64),
+                        np.asarray(want["aggs"][key], dtype=np.float64),
+                        rtol=1e-5, err_msg=key)
+            finally:
+                await row_e.close()
+                await chunk_e.close()
+
+        asyncio.run(go())
+
     def test_chunked_storage_is_compact(self):
         """One row per (series, chunk window), not per point."""
 

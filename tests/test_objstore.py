@@ -93,3 +93,48 @@ def test_local_store_atomic_put_no_temp_left(tmp_path):
     leftovers = [p for p in tmp_path.rglob(".tmp-put-*")]
     assert leftovers == []
     assert run(store.list("")) and run(store.get("x/y")) == b"data"
+
+
+class TestPutStream:
+    def test_roundtrip(self, store):
+        async def go():
+            async def chunks():
+                for i in range(5):
+                    yield bytes([i]) * 1000
+
+            total = await store.put_stream("s/obj", chunks())
+            assert total == 5000
+            data = await store.get("s/obj")
+            assert data == b"".join(bytes([i]) * 1000 for i in range(5))
+
+        run(go())
+
+    def test_empty_stream(self, store):
+        async def go():
+            async def chunks():
+                return
+                yield  # pragma: no cover
+
+            assert await store.put_stream("s/empty", chunks()) == 0
+            assert await store.get("s/empty") == b""
+
+        run(go())
+
+
+def test_local_put_stream_failure_leaves_nothing(tmp_path):
+    """A mid-stream failure must leave neither the object nor a temp
+    file — the atomic-replace crash contract extends to streams."""
+    store = LocalObjectStore(str(tmp_path))
+
+    async def go():
+        async def chunks():
+            yield b"partial"
+            raise RuntimeError("producer died")
+
+        with pytest.raises(RuntimeError):
+            await store.put_stream("x/stream", chunks())
+        with pytest.raises(Error):
+            await store.get("x/stream")
+
+    run(go())
+    assert [p for p in tmp_path.rglob(".tmp-put-*")] == []

@@ -292,6 +292,77 @@ class TestS3Store:
 
         asyncio.run(go())
 
+    def test_put_stream_uploads_parts_incrementally(self):
+        """Chunks become multipart parts AS THEY ARRIVE — the server
+        must hold in-flight parts while the stream is still producing
+        (bounded-RSS contract: nothing buffers the whole object)."""
+        async def go():
+            store, server, objects, uploads, _ = await make_store(
+                multipart_threshold=1 << 16, multipart_part_size=1 << 16)
+            try:
+                part = 1 << 16
+                seen_inflight = []
+
+                async def chunks():
+                    for i in range(4):
+                        yield bytes([i]) * part
+                        # parts observed server-side while streaming
+                        seen_inflight.append(
+                            sum(len(p) for p in uploads.values()))
+
+                total = await store.put_stream("db/data/s.sst", chunks())
+                assert total == 4 * part
+                data = b"".join(bytes([i]) * part for i in range(4))
+                assert objects["db/data/s.sst"] == data
+                assert not uploads
+                # by the time chunk i+1 was produced, part i had landed
+                assert seen_inflight[1] >= 1 and seen_inflight[3] >= 3
+            finally:
+                await store.close()
+                await server.close()
+
+        asyncio.run(go())
+
+    def test_put_stream_small_object_single_put(self):
+        async def go():
+            store, server, objects, uploads, _ = await make_store(
+                multipart_threshold=1 << 16, multipart_part_size=1 << 16)
+            try:
+                async def chunks():
+                    yield b"ab"
+                    yield b"cd"
+
+                assert await store.put_stream("k", chunks()) == 4
+                assert objects["k"] == b"abcd"
+                assert not uploads  # never initiated multipart
+            finally:
+                await store.close()
+                await server.close()
+
+        asyncio.run(go())
+
+    def test_put_stream_midstream_failure_aborts(self):
+        """A producer failure mid-stream must abort the multipart
+        upload: no readable object, no orphaned in-progress parts."""
+        async def go():
+            store, server, objects, uploads, _ = await make_store(
+                multipart_threshold=1 << 16, multipart_part_size=1 << 16)
+            try:
+                async def chunks():
+                    yield b"x" * (1 << 16)
+                    yield b"y" * (1 << 16)
+                    raise RuntimeError("encoder died")
+
+                with pytest.raises(RuntimeError):
+                    await store.put_stream("db/data/fail.sst", chunks())
+                assert "db/data/fail.sst" not in objects
+                assert not uploads  # aborted, no dangling parts
+            finally:
+                await store.close()
+                await server.close()
+
+        asyncio.run(go())
+
     def test_retry_recovers_from_5xx_and_drops(self):
         async def go():
             store, server, objects, _, faults = await make_store()

@@ -3,6 +3,8 @@ tests picker.rs:201-236, plan golden test read.rs:575-617)."""
 
 import asyncio
 
+import numpy as np
+
 import pyarrow as pa
 import pytest
 
@@ -11,7 +13,6 @@ from horaedb_tpu.objstore import MemoryObjectStore
 from horaedb_tpu.ops import Eq, Gt, TimeRangePred
 from horaedb_tpu.storage.compaction import Task, TimeWindowCompactionStrategy
 from horaedb_tpu.storage.config import (
-    SchedulerConfig,
     StorageConfig,
     UpdateMode,
     from_dict,
@@ -310,6 +311,59 @@ class TestPickerStrategy:
 
 
 class TestCompactionEndToEnd:
+    def test_compaction_streams_output_in_bounded_chunks(self):
+        """The compaction rewrite must hand the store MANY chunks (one
+        per flushed row group), never one whole-SST buffer — the
+        bounded-RSS contract of write_sst_streaming."""
+        async def go():
+            store = MemoryObjectStore()
+            chunk_sizes: list[int] = []
+            real_put_stream = store.put_stream
+
+            async def spying_put_stream(path, chunks):
+                async def spy():
+                    async for c in chunks:
+                        chunk_sizes.append(len(c))
+                        yield c
+
+                return await real_put_stream(path, spy())
+
+            store.put_stream = spying_put_stream
+            cfg = from_dict(StorageConfig, {
+                "scheduler": {"schedule_interval": "1h",
+                              "input_sst_min_num": 2},
+                "write": {"max_row_group_size": 1024}})
+            s = await CloudObjectStorage.open(
+                "db", SEGMENT_MS, store, user_schema(),
+                num_primary_keys=2, config=cfg)
+            try:
+                rng = np.random.default_rng(0)
+                for _ in range(2):
+                    n = 8000
+                    rows = [(f"t{int(t) % 50:02d}", int(t), float(v))
+                            for t, v in zip(
+                                rng.integers(0, SEGMENT_MS, n),
+                                rng.random(n))]
+                    await s.write(WriteRequest(
+                        make_batch(sorted(rows)),
+                        TimeRange.new(0, SEGMENT_MS)))
+                task = await s.compact_scheduler.picker.pick_candidate()
+                assert task is not None
+                await s.compact_scheduler.executor.execute(task)
+                # many row-group-sized chunks, not one monolith
+                assert len(chunk_sizes) > 4, chunk_sizes
+                total = sum(chunk_sizes)
+                assert max(chunk_sizes) < total, chunk_sizes
+                # output readable and deduped
+                out = [b async for b in s.scan(ScanRequest(
+                    range=TimeRange.new(0, SEGMENT_MS), predicate=None,
+                    projections=None))]
+                assert sum(b.num_rows for b in out) > 0
+            finally:
+                await s.close()
+
+        asyncio.run(go())
+
     def test_compact_merges_files_and_cleans_up(self):
         async def go():
             store = MemoryObjectStore()

@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""Stdlib lint gate (the reference CI runs fmt+clippy -D warnings,
+.github/workflows/ci.yml:52-72; this image has no ruff/flake8 and
+installs are off-limits, so the gate is an AST checker with zero
+dependencies).
+
+Checks, all hard failures:
+  - syntax errors (ast.parse)
+  - unused imports (module scope and function scope; `__init__.py`
+    re-export surfaces are exempt, as is anything in __all__ or marked
+    `# noqa`)
+  - trailing whitespace / tabs in indentation
+  - mutable default arguments (def f(x=[]) / {} / set())
+  - bare `except:` clauses
+
+Usage: python tools/lint.py [paths...]   (default: horaedb_tpu tests
+bench.py __graft_entry__.py)
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import sys
+
+DEFAULT_PATHS = ["horaedb_tpu", "tests", "bench.py", "__graft_entry__.py"]
+
+
+def iter_files(paths: list[str]):
+    for p in paths:
+        path = pathlib.Path(p)
+        if path.is_dir():
+            yield from sorted(path.rglob("*.py"))
+        elif path.suffix == ".py":
+            yield path
+
+
+class _Names(ast.NodeVisitor):
+    """Collect every name read anywhere in the tree (conservative:
+    attribute roots and string annotations count)."""
+
+    def __init__(self) -> None:
+        self.used: set[str] = set()
+
+    def visit_Name(self, node: ast.Name) -> None:
+        self.used.add(node.id)
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        root = node
+        while isinstance(root, ast.Attribute):
+            root = root.value
+        if isinstance(root, ast.Name):
+            self.used.add(root.id)
+        self.generic_visit(node)
+
+    def visit_Constant(self, node: ast.Constant) -> None:
+        # string annotations / forward refs / docstrings may reference
+        # imported names textually — count identifier-looking tokens
+        if isinstance(node.value, str) and len(node.value) < 4096:
+            for tok in (node.value.replace(".", " ").replace("[", " ")
+                        .replace("]", " ").split()):
+                if tok.isidentifier():
+                    self.used.add(tok)
+
+
+def lint_file(path: pathlib.Path) -> list[str]:
+    problems: list[str] = []
+    text = path.read_text()
+    lines = text.splitlines()
+    for i, line in enumerate(lines, 1):
+        if line != line.rstrip():
+            problems.append(f"{path}:{i}: trailing whitespace")
+        stripped_len = len(line) - len(line.lstrip(" \t"))
+        if "\t" in line[:stripped_len]:
+            problems.append(f"{path}:{i}: tab in indentation")
+    try:
+        tree = ast.parse(text, filename=str(path))
+    except SyntaxError as e:
+        problems.append(f"{path}:{e.lineno}: syntax error: {e.msg}")
+        return problems
+
+    names = _Names()
+    names.visit(tree)
+    exported: set[str] = set()
+    for node in tree.body:
+        if (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name) and t.id == "__all__"
+                        for t in node.targets)
+                and isinstance(node.value, (ast.List, ast.Tuple))):
+            exported |= {e.value for e in node.value.elts
+                         if isinstance(e, ast.Constant)}
+
+    is_init = path.name == "__init__.py"
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            if is_init:
+                continue  # re-export surface
+            if (isinstance(node, ast.ImportFrom)
+                    and node.module == "__future__"):
+                continue
+            src = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+            if "noqa" in src:
+                continue
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                bound = (alias.asname or alias.name).split(".")[0]
+                if bound not in names.used and bound not in exported:
+                    problems.append(
+                        f"{path}:{node.lineno}: unused import {bound!r}")
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for d in node.args.defaults + node.args.kw_defaults:
+                if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                    problems.append(
+                        f"{path}:{node.lineno}: mutable default argument "
+                        f"in {node.name}()")
+        elif isinstance(node, ast.ExceptHandler) and node.type is None:
+            problems.append(f"{path}:{node.lineno}: bare except")
+    return problems
+
+
+def main() -> int:
+    paths = sys.argv[1:] or DEFAULT_PATHS
+    all_problems: list[str] = []
+    n = 0
+    for f in iter_files(paths):
+        n += 1
+        all_problems.extend(lint_file(f))
+    for p in all_problems:
+        print(p)
+    print(f"lint: {n} files, {len(all_problems)} problems",
+          file=sys.stderr)
+    return 1 if all_problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
