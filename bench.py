@@ -98,11 +98,17 @@ def run_engine_headline(rows: int, iters: int) -> dict:
         f"buckets, {span // segment_ms + 1} segments")
 
     async def setup() -> MetricEngine:
+        scan_cfg = {"cache_max_rows": rows * 4}
+        # A/B knob: windows per aggregation round (default 16); bigger
+        # rounds = fewer dispatches on remote-attached devices
+        if os.environ.get("BENCH_AGG_WINDOWS"):
+            scan_cfg["agg_batch_windows"] = int(
+                os.environ["BENCH_AGG_WINDOWS"])
         cfg = from_dict(StorageConfig, {
             "scheduler": {"schedule_interval": "1h"},
             # cache must hold every segment's windows for the cached
             # (HBM-resident) number to mean anything at this row count
-            "scan": {"cache_max_rows": rows * 4},
+            "scan": scan_cfg,
         })
         e = await MetricEngine.open("bench", MemoryObjectStore(),
                                     segment_ms=segment_ms, config=cfg)

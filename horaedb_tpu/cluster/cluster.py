@@ -113,10 +113,21 @@ class Cluster:
 
     def add_remote_region(self, region_id: int, backend) -> None:
         """Attach a region served by another process (e.g. a RemoteRegion
-        speaking the server's HTTP API over DCN)."""
+        speaking the server's HTTP API over DCN).  Attaching the first
+        remote auto-starts the heartbeat monitor — dead peers must be
+        discovered by the monitor, not by the first query that fans out
+        to them."""
         ensure(region_id not in self.regions, f"region {region_id} exists")
         self.regions[region_id] = backend
         self._clear_dead_mark(region_id)  # fresh backend, fresh health
+        if (self._health_task is None
+                and getattr(backend, "ping", None) is not None):
+            try:
+                self.start_health_monitor()
+            except RuntimeError:
+                # no running event loop (sync caller building a cluster
+                # before serving): the operator starts it explicitly
+                pass
 
     def _clear_dead_mark(self, region_id: int) -> None:
         """A region whose backend changed (adopted locally, re-attached
@@ -185,17 +196,23 @@ class Cluster:
         /stats (a dead remote reports rows/bytes -1 rather than failing
         the whole survey)."""
         rules = self.region_loads()
-        out: dict[int, dict] = {}
-        for rid, backend in self.regions.items():
+
+        async def one(rid: int, backend) -> tuple[int, dict]:
             remote = not isinstance(backend, MetricEngine)
             try:
                 s = await backend.stats()
-                out[rid] = {"rows": int(s["rows"]), "bytes": int(s["bytes"]),
-                            "rules": rules.get(rid, 0), "remote": remote}
+                return rid, {"rows": int(s["rows"]),
+                             "bytes": int(s["bytes"]),
+                             "rules": rules.get(rid, 0), "remote": remote}
             except Exception:
-                out[rid] = {"rows": -1, "bytes": -1,
-                            "rules": rules.get(rid, 0), "remote": remote}
-        return out
+                return rid, {"rows": -1, "bytes": -1,
+                             "rules": rules.get(rid, 0), "remote": remote}
+
+        # concurrent: the survey is bounded by ONE slow peer's timeout,
+        # not the sum over unreachable peers
+        results = await asyncio.gather(*(one(rid, b) for rid, b
+                                         in self.regions.items()))
+        return dict(results)
 
     # ---- health -----------------------------------------------------------
 
@@ -222,13 +239,14 @@ class Cluster:
 
     async def check_health_once(self) -> dict[int, bool]:
         """One heartbeat round (the monitor's body; callable directly in
-        tests/ops tooling).  Returns {rid: alive} for remote regions."""
+        tests/ops tooling).  Returns {rid: alive} for remote regions.
+        Pings run CONCURRENTLY so a round is bounded by one ping
+        timeout, not the sum over sick peers."""
+        targets = [(rid, ping) for rid, backend in self.regions.items()
+                   if (ping := getattr(backend, "ping", None)) is not None]
+        results = await asyncio.gather(*(p() for _rid, p in targets))
         alive: dict[int, bool] = {}
-        for rid, backend in list(self.regions.items()):
-            ping = getattr(backend, "ping", None)
-            if ping is None:
-                continue  # local engines don't need heartbeats
-            ok = await ping()
+        for (rid, _p), ok in zip(targets, results):
             alive[rid] = ok
             if ok:
                 self._health_fails[rid] = 0

@@ -138,6 +138,7 @@ class MetricManager:
         self.table = table
         self.segment_ms = segment_ms
         self._seen = _SegmentSeen()
+        self._resolve_cache: dict[str, tuple[int, float]] = {}
 
     async def populate_metric_ids(self, samples: list[Sample]) -> None:
         by_seg: dict[int, dict] = {}
@@ -165,14 +166,33 @@ class MetricManager:
             for key in items:
                 self._seen.add(seg, key)
 
+    # positive name->id resolutions are cached briefly: the mapping is
+    # immutable once registered, so the only staleness is a metric whose
+    # data fully expired still resolving for up to the TTL — its query
+    # returns empty grids either way.  Negatives are NOT cached (a
+    # concurrent first write must become visible immediately).
+    _RESOLVE_TTL_S = 10.0
+
     async def resolve(self, metric_name: str,
                       time_range: TimeRange) -> Optional[int]:
         """metric name -> id via the metrics table (cache-through)."""
+        import time as _time
+
+        now = _time.monotonic()
+        hit = self._resolve_cache.get(metric_name)
+        if hit is not None and hit[1] > now:
+            return hit[0]
         batches = await _collect(self.table.scan(ScanRequest(
             range=time_range, predicate=Eq("metric_name", metric_name))))
         for b in batches:
             if b.num_rows:
-                return b.column(b.schema.names.index("metric_id"))[0].as_py()
+                mid = b.column(
+                    b.schema.names.index("metric_id"))[0].as_py()
+                if len(self._resolve_cache) > 1024:
+                    self._resolve_cache.clear()
+                self._resolve_cache[metric_name] = (
+                    mid, now + self._RESOLVE_TTL_S)
+                return mid
         return None
 
     async def list_metrics(self, time_range: TimeRange) -> list[str]:

@@ -323,12 +323,20 @@ class TestClusterHealthAndRebalance:
             try:
                 c.routing.split(0, 1 << 62, 7, now_ms(), 30 * 24 * HOUR)
                 c.add_remote_region(7, remote)
+                # attaching a remote auto-starts the heartbeat monitor
+                assert c._health_task is not None
                 alive = await c.check_health_once()
                 assert alive == {7: True} and not c.dead_regions
 
+                # restart the monitor at test speed and let the LOOP
+                # (not manual rounds) discover the dead peer
+                await c.stop_health_monitor()
                 await server.close()  # kill the peer
-                for _ in range(Cluster._HEALTH_FAILS):
-                    await c.check_health_once()
+                c.start_health_monitor(interval_s=0.02)
+                for _ in range(100):
+                    if 7 in c.dead_regions:
+                        break
+                    await asyncio.sleep(0.02)
                 assert 7 in c.dead_regions
 
                 rng = TimeRange.new(T0, T0 + HOUR)
