@@ -130,8 +130,15 @@ class ScanConfig:
     # then each window's rows are read via parquet predicate pushdown,
     # so host materialization is bounded by the window budget instead of
     # the segment size (the reference's pull-streaming, read.rs:346-385).
-    # 0 disables streaming (always read whole segments).
+    # 0 disables streaming entirely (always read whole segments).
     stream_read_min_rows: int = 8 << 20
+    # byte twin of the row knob (manifest SST sizes): a segment UNDER
+    # the row threshold still streams when its stored bytes exceed this
+    # — row counts under-estimate host RAM for wide schemas.  Only
+    # consulted when streaming is enabled (stream_read_min_rows > 0)
+    # and the segment spans more than one window; 0 disables the byte
+    # trigger.
+    stream_read_min_bytes: int = 512 << 20
 
 
 @dataclass

@@ -730,12 +730,22 @@ class ParquetReader:
 
     def _stream_segment(self, seg: SegmentPlan) -> bool:
         """True when this segment should be read window-by-window instead
-        of fully materialized (manifest row count over the threshold)."""
-        threshold = self.config.scan.stream_read_min_rows
-        if threshold <= 0:
+        of fully materialized: manifest row count over the row threshold,
+        OR stored byte size over the byte threshold — a wide-schema
+        segment can be host-RAM-huge long before it hits the row knob."""
+        row_thresh = self.config.scan.stream_read_min_rows
+        if row_thresh <= 0:
+            return False  # 0 disables streaming entirely (stable contract)
+        rows = sum(f.meta.num_rows for f in seg.ssts)
+        if rows <= self.config.scan.max_window_rows:
+            # everything fits one window: streaming would pay the pass-1
+            # scan and still materialize the same single window
             return False
-        return sum(f.meta.num_rows for f in seg.ssts) > max(
-            threshold, self.config.scan.max_window_rows)
+        if rows > row_thresh:
+            return True
+        byte_thresh = self.config.scan.stream_read_min_bytes
+        return byte_thresh > 0 and sum(
+            f.meta.size for f in seg.ssts) > byte_thresh
 
     async def _stream_window_batches(self, seg: SegmentPlan, plan: ScanPlan,
                                      strict_no_replay: bool = False):

@@ -592,6 +592,23 @@ class TestStreamedRead:
         # rows can't split, so allow that skew)
         assert spy and max(spy) <= 1024 + 600, spy
 
+    def test_byte_threshold_streams_wide_segments(self):
+        """A segment can be host-RAM-huge at a low row count (wide
+        schema): the BYTE knob must trigger streaming when the row knob
+        would not, with identical output."""
+        spy: list = []
+        streamed = self._run(
+            # row knob far above the data; byte knob far below it
+            {"stream_read_min_rows": 1 << 30,
+             "stream_read_min_bytes": 4096, "max_window_rows": 1024},
+            spy=spy)
+        bulk = self._run({"stream_read_min_rows": 0,
+                          "stream_read_min_bytes": 0,
+                          "max_window_rows": 1 << 20})
+        assert streamed == bulk
+        # windows were bounded -> the streamed path actually engaged
+        assert spy and max(spy) <= 1024 + 600, spy
+
     def test_streamed_mesh_equals_bulk(self):
         streamed = self._run(
             {"stream_read_min_rows": 2000, "max_window_rows": 1024,
