@@ -287,6 +287,16 @@ def test_randomized_stress_streamed_reads():
     assert len(model.acked) > 30
 
 
+def test_randomized_stress_fused_aggregate(monkeypatch):
+    """Same invariants with the FUSED device-accumulated aggregate
+    forced on (the accelerator default): its all-or-nothing restart on
+    a compaction race must stay duplicate-free and converge to the
+    acked model under randomized writers + compaction + TTL GC."""
+    monkeypatch.setenv("HORAEDB_FUSED_AGG", "1")
+    model = asyncio.run(run_stress(23, duration_s=2.5))
+    assert len(model.acked) > 30
+
+
 def test_stress_detects_injected_stale_cache_race():
     """Sensitivity check: break scan-cache identity (drop the SST-set
     component, so compactions/writes no longer invalidate) and the

@@ -617,6 +617,58 @@ class TestStreamedRead:
                           "max_window_rows": 1 << 20})
         assert streamed == bulk
 
+    def test_fused_aggregate_restarts_on_compaction_race(self, monkeypatch):
+        """The fused path's all-or-nothing retry: a NotFoundError
+        mid-aggregate (SST vanished under compaction) restarts with a
+        fresh plan and returns the full, duplicate-free grids; ops
+        metrics for re-scanned segments are not double-counted."""
+        monkeypatch.setenv("HORAEDB_FUSED_AGG", "1")
+
+        async def go():
+            from horaedb_tpu.objstore import NotFoundError
+            from horaedb_tpu.storage.read import _ROWS_SCANNED, AggregateSpec
+
+            s = await open_storage()
+            try:
+                rows = [("a", 1000, 1.0), ("a", 2000, 2.0),
+                        ("b", 1000, 3.0), ("b", 2000, 4.0)]
+                await s.write(WriteRequest(make_batch(rows),
+                                           TimeRange.new(1000, 2001)))
+                rows_scanned_before = _ROWS_SCANNED.value
+                real = s.reader.execute_aggregate_fused
+                calls = {"n": 0}
+
+                async def flaky(plan, spec, counted=None):
+                    calls["n"] += 1
+                    if calls["n"] == 1:
+                        # scan everything FIRST (metrics counted), then
+                        # fail — the restart must not re-count
+                        await real(plan, spec, counted=counted)
+                        raise NotFoundError("sst vanished (simulated "
+                                            "compaction race)")
+                    return await real(plan, spec, counted=counted)
+
+                monkeypatch.setattr(s.reader, "execute_aggregate_fused",
+                                    flaky)
+                spec = AggregateSpec(group_col="host", ts_col="ts",
+                                     value_col="cpu", range_start=0,
+                                     bucket_ms=10_000, num_buckets=1,
+                                     which=("sum", "count"))
+                values, grids = await s.scan_aggregate(
+                    ScanRequest(range=TimeRange.new(0, 10_000)), spec)
+                assert calls["n"] == 2  # raced once, restarted once
+                got = {str(v): float(np.asarray(grids["sum"])[i, 0])
+                       for i, v in enumerate(values)}
+                assert got == {"a": 3.0, "b": 7.0}
+                assert float(np.asarray(grids["count"]).sum()) == 4.0
+                # both attempts scanned the segment, but the shared
+                # `counted` set means ops metrics saw it ONCE
+                assert _ROWS_SCANNED.value - rows_scanned_before == 4
+            finally:
+                await s.close()
+
+        asyncio.run(go())
+
     def test_streamed_scan_survives_mid_segment_compaction(self):
         """Append-mode streamed segments yield one batch per window
         WHILE later windows are still being read: an SST vanishing in
