@@ -28,7 +28,24 @@ def _diffuse(x: int) -> int:
 
 
 def hash64(buf: bytes) -> int:
-    """SeaHash of `buf` with the default seed."""
+    """SeaHash of `buf` with the default seed.
+
+    Routed through the C++ kernel when the native library is ALREADY
+    loaded (never triggers the synchronous build — a request-path hash
+    must not block behind a compile; bulk ingest's tsids_of_keys pays
+    the one-time build instead).  Golden-tested byte-identical to the
+    Python spec twin below, which is also the fallback."""
+    from horaedb_tpu import native
+
+    if native.is_loaded():
+        h = native.seahash64(buf)
+        if h is not None:
+            return h
+    return _hash64_py(buf)
+
+
+def _hash64_py(buf: bytes) -> int:
+    """Pure-Python SeaHash (the spec; see module docstring)."""
     a, b, c, d = _SEED_A, _SEED_B, _SEED_C, _SEED_D
     n = len(buf)
     i = 0

@@ -44,6 +44,7 @@ from horaedb_tpu.metric_engine.types import (
     metric_id_of,
     series_key_of,
     tsid_of,
+    tsids_of_keys,
 )
 
 _TABLE_SCHEMAS = {
@@ -596,14 +597,18 @@ class MetricEngine:
         reg_samples = []
         tsid_of_code = np.full(num_series, 0, dtype=np.uint64)
         mid = metric_id_of(metric)
+        series_keys = []
+        code_idxes = []
         for row in pair_rows:
             row = int(row)
             labels = [Label(c, str(tag_arrays[j][row].as_py()))
                       for j, c in enumerate(tag_columns)]
-            code_idx = int(codes[row])
-            tsid_of_code[code_idx] = tsid_of(metric, labels)
+            series_keys.append(series_key_of(metric, labels))
+            code_idxes.append(int(codes[row]))
             reg_samples.append(Sample(metric, labels, int(ts_np[row]), 0.0,
                                       field_name=field))
+        # ONE native SeaHash call for every unique series in the batch
+        tsid_of_code[code_idxes] = tsids_of_keys(series_keys)
         # registration rides the scalar pipeline (per-segment dedup caches
         # make it cheap); data rows go straight to the data table
         await self.metric_manager.populate_metric_ids(reg_samples)

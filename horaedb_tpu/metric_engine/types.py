@@ -64,3 +64,19 @@ def series_key_of(name: str, labels: list[Label]) -> bytes:
 def tsid_of(name: str, labels: list[Label]) -> SeriesId:
     """TSID = hash(sorted labels) scoped by metric name (RFC:99)."""
     return hash64(series_key_of(name, labels)) & _ID_MASK
+
+
+def tsids_of_keys(keys: list[bytes]):
+    """TSIDs for many canonical series keys at once: one native
+    SeaHash FFI call for the whole batch (high-cardinality ingest
+    hashes a key per unique series), Python spec-twin fallback.
+    Returns a uint64 numpy array aligned with `keys`."""
+    import numpy as np
+
+    from horaedb_tpu import native
+
+    h = native.seahash64_batch(keys)
+    if h is None:
+        h = np.fromiter((hash64(k) for k in keys), dtype=np.uint64,
+                        count=len(keys))
+    return h & np.uint64(_ID_MASK)

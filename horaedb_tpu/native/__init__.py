@@ -62,6 +62,19 @@ def _load() -> Optional[ctypes.CDLL]:
         except OSError as e:
             logger.warning("native load failed, using numpy fallbacks: %s", e)
             return None
+        try:
+            _bind(lib)
+        except AttributeError as e:
+            # a stale prebuilt .so missing newer symbols must degrade to
+            # the numpy/Python fallbacks, not crash the first caller
+            logger.warning("native library out of date (%s); "
+                           "using fallbacks", e)
+            return None
+        _lib = lib
+        return _lib
+
+
+def _bind(lib: ctypes.CDLL) -> None:
         lib.snapshot_encode.restype = ctypes.c_longlong
         lib.snapshot_encode.argtypes = [ctypes.c_void_p, ctypes.c_size_t,
                                         ctypes.c_void_p, ctypes.c_size_t]
@@ -75,12 +88,22 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.run_last_indices.restype = ctypes.c_size_t
         lib.run_last_indices.argtypes = [ctypes.c_void_p, ctypes.c_size_t,
                                          ctypes.c_void_p]
-        _lib = lib
-        return _lib
+        lib.seahash64.restype = ctypes.c_uint64
+        lib.seahash64.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+        lib.seahash64_batch.restype = None
+        lib.seahash64_batch.argtypes = [ctypes.c_char_p, ctypes.c_void_p,
+                                        ctypes.c_size_t, ctypes.c_void_p]
 
 
 def available() -> bool:
     return _load() is not None
+
+
+def is_loaded() -> bool:
+    """True iff the library is ALREADY loaded — never triggers a build.
+    Request-path callers (single-key hash64) gate on this so the first
+    hash of a process cannot block behind a synchronous compile."""
+    return _lib is not None
 
 
 # ---------------------------------------------------------------------------
@@ -195,3 +218,35 @@ def run_last_indices(starts: np.ndarray) -> np.ndarray:
         return out[:k]
     idx = np.nonzero(starts)[0]
     return np.append(idx[1:] - 1, n - 1)
+
+
+# ---------------------------------------------------------------------------
+# SeaHash (metric/series id hashing)
+# ---------------------------------------------------------------------------
+
+
+def seahash64(buf: bytes) -> Optional[int]:
+    """Native SeaHash of one key; None when the library is unavailable
+    (callers fall back to the Python spec twin in common/seahash)."""
+    lib = _load()
+    if lib is None:
+        return None
+    return int(lib.seahash64(buf, len(buf)))
+
+
+def seahash64_batch(keys: list[bytes]) -> Optional[np.ndarray]:
+    """Hash many keys in ONE FFI call (high-cardinality ingest hashes a
+    key per unique series).  Returns uint64 hashes, or None when the
+    native library is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    lens = np.fromiter((len(k) for k in keys), dtype=np.int64,
+                       count=len(keys))
+    offsets = np.zeros(len(keys) + 1, dtype=np.int64)
+    np.cumsum(lens, out=offsets[1:])
+    buf = b"".join(keys)
+    out = np.empty(len(keys), dtype=np.uint64)
+    lib.seahash64_batch(buf, offsets.ctypes.data_as(ctypes.c_void_p),
+                        len(keys), out.ctypes.data_as(ctypes.c_void_p))
+    return out

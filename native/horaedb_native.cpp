@@ -115,4 +115,67 @@ size_t run_last_indices(const uint8_t* starts, size_t n, int64_t* out) {
   return k;
 }
 
+// ---- SeaHash (v4.x reference semantics) -----------------------------------
+// The 64-bit hash the reference specifies for metric/series ids
+// (src/metric_engine/src/types.rs uses seahash::hash).  Must produce
+// byte-identical results to the Python spec twin in common/seahash.py
+// (golden-tested); the batch entry point hashes many OFFSET-framed keys
+// (offsets[i], offsets[i+1]) in one call, so high-cardinality ingest
+// pays one FFI hop, not one per key.
+
+namespace {
+
+constexpr uint64_t kSeaK = 0x6EED0E9DA4D94A4Full;
+constexpr uint64_t kSeedA = 0x16F11FE89B0D677Cull;
+constexpr uint64_t kSeedB = 0xB480A793D8E6C86Cull;
+constexpr uint64_t kSeedC = 0x6FE2E5AAF078EBC9ull;
+constexpr uint64_t kSeedD = 0x14F994A4C5259381ull;
+
+inline uint64_t sea_diffuse(uint64_t x) {
+  x *= kSeaK;
+  x ^= (x >> 32) >> (x >> 60);
+  return x * kSeaK;
+}
+
+inline uint64_t sea_read_tail(const uint8_t* p, size_t len) {
+  uint64_t v = 0;
+  std::memcpy(&v, p, len);  // little-endian hosts only (x86/ARM LE)
+  return v;
+}
+
+inline uint64_t seahash_one(const uint8_t* buf, size_t len) {
+  uint64_t lanes[4] = {kSeedA, kSeedB, kSeedC, kSeedD};
+  size_t i = 0;
+  int lane = 0;
+  for (; i + 8 <= len; i += 8) {
+    uint64_t chunk;
+    std::memcpy(&chunk, buf + i, 8);
+    lanes[lane] = sea_diffuse(lanes[lane] ^ chunk);
+    lane = (lane + 1) & 3;
+  }
+  if (i < len) {
+    uint64_t chunk = sea_read_tail(buf + i, len - i);
+    lanes[lane] = sea_diffuse(lanes[lane] ^ chunk);
+  }
+  uint64_t h = lanes[0] ^ lanes[1] ^ lanes[2] ^ lanes[3];
+  return sea_diffuse(h ^ static_cast<uint64_t>(len));
+}
+
+}  // namespace
+
+uint64_t seahash64(const uint8_t* buf, size_t len) {
+  return seahash_one(buf, len);
+}
+
+// Batch: `offsets` has n+1 entries framing n keys inside `buf`
+// (key i = buf[offsets[i], offsets[i+1])); hashes land in out[n].
+void seahash64_batch(const uint8_t* buf, const int64_t* offsets, size_t n,
+                     uint64_t* out) {
+  for (size_t i = 0; i < n; ++i) {
+    const size_t lo = static_cast<size_t>(offsets[i]);
+    const size_t hi = static_cast<size_t>(offsets[i + 1]);
+    out[i] = seahash_one(buf + lo, hi - lo);
+  }
+}
+
 }  // extern "C"

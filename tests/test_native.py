@@ -125,3 +125,44 @@ class TestSpecTwinParity:
         arr = np.zeros(3, dtype=native.RECORD_DTYPE)
         native_header = native.snapshot_encode(arr)[:14]
         assert SnapshotHeader(length=3 * 32).to_bytes() == native_header
+
+
+class TestSeaHashNative:
+    """The C++ SeaHash must be byte-identical to the Python spec twin
+    (common/seahash._hash64_py) — metric/series ids derive from it."""
+
+    def test_single_matches_spec_twin(self):
+        from horaedb_tpu.common.seahash import _hash64_py
+
+        if not native.available():
+            import pytest
+            pytest.skip("native library unavailable")
+        rng = np.random.default_rng(3)
+        cases = [b"", b"a", b"to be or not to be", b"x" * 31, b"y" * 32,
+                 b"z" * 33] + [
+            bytes(rng.integers(0, 256, int(n)).astype(np.uint8))
+            for n in rng.integers(0, 300, 64)]
+        for buf in cases:
+            assert native.seahash64(buf) == _hash64_py(buf), buf
+
+    def test_batch_matches_singles(self):
+        from horaedb_tpu.common.seahash import _hash64_py
+
+        if not native.available():
+            import pytest
+            pytest.skip("native library unavailable")
+        keys = [f"cpu{{host=h{i:03d},region=r{i % 5}}}".encode()
+                for i in range(512)] + [b""]
+        out = native.seahash64_batch(keys)
+        assert [int(h) for h in out] == [_hash64_py(k) for k in keys]
+
+    def test_hash64_routes_native_and_masks_consistently(self):
+        from horaedb_tpu.common.seahash import _hash64_py, hash64
+        from horaedb_tpu.metric_engine.types import (series_key_of,
+                                                     tsid_of, tsids_of_keys)
+        from horaedb_tpu.metric_engine.types import Label
+
+        key = series_key_of("cpu", [Label("host", "a"), Label("dc", "b")])
+        assert hash64(key) == _hash64_py(key)
+        assert int(tsids_of_keys([key])[0]) == tsid_of(
+            "cpu", [Label("host", "a"), Label("dc", "b")])
