@@ -204,8 +204,11 @@ def run_engine_headline(rows: int, iters: int) -> dict:
         with np.errstate(invalid="ignore"):
             return sums / counts, counts
 
+    # enough samples that one scheduler hiccup cannot swing the
+    # vs_baseline ratio (observed 2x swings at 3-5 samples on a busy
+    # 1-core box)
     times = []
-    for _ in range(max(3, iters // 4)):
+    for _ in range(max(9, iters // 2)):
         t0 = time.perf_counter()
         ref_avg, ref_counts = cpu_run()
         times.append(time.perf_counter() - t0)

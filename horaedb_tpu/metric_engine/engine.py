@@ -93,6 +93,34 @@ async def _collect(stream) -> list[pa.RecordBatch]:
     return [b async for b in stream]
 
 
+def _unique_pairs(major, minor):
+    """np.unique over (major, minor) int pairs, lexicographic order.
+
+    Packs both (rebased to their minima) into ONE int64 when ranges
+    allow — `np.unique(..., axis=0)` argsorts a structured view, which
+    measured 2x the whole bulk-write numpy time at 2M rows; the
+    structured path remains as the overflow fallback.  Returns
+    (uniq_major, uniq_minor, first_index, inverse)."""
+    import numpy as np
+
+    maj = np.asarray(major).astype(np.int64, copy=False)
+    mino = np.asarray(minor).astype(np.int64, copy=False)
+    if len(maj) == 0:
+        z = np.zeros(0, dtype=np.int64)
+        return z, z, z, z
+    mlo, nlo = int(maj.min()), int(mino.min())
+    span = int(mino.max()) - nlo + 1
+    if (int(maj.max()) - mlo + 1) * span < 2**62:
+        packed = (maj - mlo) * np.int64(span) + (mino - nlo)
+        u, first, inv = np.unique(packed, return_index=True,
+                                  return_inverse=True)
+        return u // span + mlo, u % span + nlo, first, inv
+    mat = np.stack([maj, mino], axis=1)
+    up, first, inv = np.unique(mat, axis=0, return_index=True,
+                               return_inverse=True)
+    return up[:, 0], up[:, 1], first, inv.reshape(-1)
+
+
 def _empty_result() -> pa.Table:
     return pa.table({"tsid": pa.array([], type=pa.uint64()),
                      "timestamp": pa.array([], type=pa.int64()),
@@ -589,11 +617,11 @@ class MetricEngine:
 
         # registration must happen per (segment, series) — the index is
         # Date-scoped (RFC:104), so a series spanning segments registers
-        # in each one.  One Python trip per unique pair.
-        # dense per-batch codes stand in for the series identity (they
-        # are bijective with the composite/tag-row within one batch)
-        pair = np.stack([seg_ids, codes], axis=1)
-        _, pair_rows = np.unique(pair, axis=0, return_index=True)
+        # in each one.  One Python trip per unique pair; dense per-batch
+        # codes stand in for the series identity (bijective with the
+        # composite/tag-row within one batch).  q is already the exact
+        # segment index (seg_ids = q * seg).
+        _, _, pair_rows, _ = _unique_pairs(q, codes)
         reg_samples = []
         tsid_of_code = np.full(num_series, 0, dtype=np.uint64)
         mid = metric_id_of(metric)
@@ -669,9 +697,9 @@ class MetricEngine:
         ensure(int(ts_np.min()) >= 0,
                "chunked data mode requires non-negative timestamps")
         window = self.chunk_window_ms
-        chunk_ts = (ts_np // window) * window
-        pair = np.stack([codes.astype(np.int64), chunk_ts], axis=1)
-        uniq_pairs, inv = np.unique(pair, axis=0, return_inverse=True)
+        chunk_idx = ts_np // window
+        u_codes, u_cidx, _, inv = _unique_pairs(codes, chunk_idx)
+        uniq_pairs = np.stack([u_codes, u_cidx * window], axis=1)
         order = np.argsort(inv, kind="stable")
         boundaries = np.concatenate(
             [[0], np.cumsum(np.bincount(inv, minlength=len(uniq_pairs)))])

@@ -199,6 +199,71 @@ class TestFusedAggregate:
     one query-global grid on device, nothing downloaded per flush) must
     match the per-flush host-fold parts path on the same data."""
 
+    @pytest.mark.parametrize("seed", range(4))
+    def test_fused_matches_parts_misaligned_ranges(self, seed, monkeypatch):
+        """Property: with the query range start NOT aligned to bucket or
+        segment boundaries, boundary buckets receive rows from TWO
+        segments' windows — the fused scatter-add/min/max and the
+        sequential last RMW must still equal the parts f64 fold (counts
+        exact, floats to f32 ulp)."""
+        import asyncio
+
+        import pyarrow as pa
+
+        from horaedb_tpu.metric_engine import MetricEngine
+        from horaedb_tpu.objstore import MemoryObjectStore
+        from horaedb_tpu.storage.config import StorageConfig, from_dict
+        from horaedb_tpu.storage.types import TimeRange
+
+        SEG = 7_200_000
+        T0 = (1_700_000_000_000 // SEG) * SEG
+        rng = np.random.default_rng(100 + seed)
+        # deliberately awkward: range start offset by a non-bucket
+        # multiple, bucket width that does not divide the segment
+        q_start = T0 + int(rng.integers(1, 500_000))
+        bucket_ms = int(rng.choice([70_000, 130_000, 410_000]))
+        span = int(rng.integers(2, 4)) * SEG - int(rng.integers(0, 90_000))
+
+        async def run(fused: str):
+            monkeypatch.setenv("HORAEDB_FUSED_AGG", fused)
+            cfg = from_dict(StorageConfig, {
+                "scan": {"max_window_rows": 700}})
+            e = await MetricEngine.open(f"mis{seed}{fused}",
+                                        MemoryObjectStore(),
+                                        segment_ms=SEG, config=cfg)
+            try:
+                n, hosts = 5000, 13
+                names = np.array([f"h{i:02d}" for i in range(hosts)],
+                                 dtype=object)
+                batch = pa.record_batch({
+                    "host": pa.array(names[rng2.integers(0, hosts, n)]),
+                    "timestamp": pa.array(
+                        T0 + rng2.integers(0, 3 * SEG, n),
+                        type=pa.int64()),
+                    "value": pa.array(rng2.random(n) * 50,
+                                      type=pa.float64()),
+                })
+                await e.write_arrow("cpu", ["host"], batch)
+                return await e.query_downsample(
+                    "cpu", [], TimeRange.new(q_start, q_start + span),
+                    bucket_ms=bucket_ms)
+            finally:
+                await e.close()
+
+        rng2 = np.random.default_rng(200 + seed)
+        parts = asyncio.run(run("0"))
+        rng2 = np.random.default_rng(200 + seed)  # identical data
+        fused = asyncio.run(run("1"))
+        assert parts["tsids"] == fused["tsids"]
+        np.testing.assert_array_equal(
+            np.asarray(parts["aggs"]["count"]),
+            np.asarray(fused["aggs"]["count"]))
+        for key in ("sum", "min", "max", "avg", "last", "last_ts"):
+            np.testing.assert_allclose(
+                np.asarray(parts["aggs"][key], dtype=np.float64),
+                np.asarray(fused["aggs"][key], dtype=np.float64),
+                rtol=1e-6, err_msg=f"{key} seed={seed}")
+
     def test_fused_matches_parts_path(self, monkeypatch):
         import asyncio
 
