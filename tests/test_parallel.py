@@ -320,6 +320,224 @@ class TestFusedAggregate:
                 rtol=1e-6, err_msg=key)
 
 
+class TestFusedReplay:
+    """Repeat fused queries replay the recorded round composition in one
+    pool dispatch (ROADMAP r3 priority 1) — and fall back to the full
+    path the moment any underlying cache entry moves."""
+
+    @staticmethod
+    async def _open_engine(name):
+        from horaedb_tpu.metric_engine import MetricEngine
+        from horaedb_tpu.objstore import MemoryObjectStore
+        from horaedb_tpu.storage.config import StorageConfig, from_dict
+
+        cfg = from_dict(StorageConfig, {
+            "scan": {"max_window_rows": 512}})
+        return await MetricEngine.open(name, MemoryObjectStore(),
+                                       segment_ms=7_200_000, config=cfg)
+
+    @staticmethod
+    def _mkbatch(seed, n=4000, hosts=11, t0=None, span=None):
+        import pyarrow as pa
+
+        rng = np.random.default_rng(seed)
+        names = np.array([f"h{i:02d}" for i in range(hosts)], dtype=object)
+        sel = rng.integers(0, hosts, n)
+        return pa.record_batch({
+            "host": pa.array(names[sel]),
+            "timestamp": pa.array(t0 + rng.integers(0, span - 1, n),
+                                  type=pa.int64()),
+            "value": pa.array(rng.random(n) * 100, type=pa.float64()),
+        })
+
+    def test_replay_hit_matches_full_path(self, monkeypatch):
+        import asyncio
+
+        from horaedb_tpu.storage.types import TimeRange
+
+        monkeypatch.setenv("HORAEDB_FUSED_AGG", "1")
+        T0 = (1_700_000_000_000 // 7_200_000) * 7_200_000
+        SPAN = 6 * 3_600_000
+
+        async def run():
+            e = await self._open_engine("replay1")
+            try:
+                await e.write_arrow("cpu", ["host"],
+                                    self._mkbatch(3, t0=T0, span=SPAN))
+                reader = e.tables["data"].reader
+
+                async def q():
+                    return await e.query_downsample(
+                        "cpu", [], TimeRange.new(T0, T0 + SPAN),
+                        bucket_ms=600_000)
+
+                first = await q()
+                assert reader._replay_hits == 0
+                second = await q()
+                assert reader._replay_hits == 1, \
+                    "repeat fused query must take the replay path"
+                third = await q()
+                assert reader._replay_hits == 2
+                return first, second, third
+            finally:
+                await e.close()
+
+        first, second, third = asyncio.run(run())
+        assert first["tsids"] == second["tsids"] == third["tsids"]
+        for key in first["aggs"]:
+            np.testing.assert_array_equal(
+                np.asarray(first["aggs"][key]),
+                np.asarray(second["aggs"][key]), err_msg=key)
+            np.testing.assert_array_equal(
+                np.asarray(second["aggs"][key]),
+                np.asarray(third["aggs"][key]), err_msg=key)
+
+    def test_replay_with_multiple_rounds_per_segment(self, monkeypatch):
+        """One segment spanning several accumulate rounds of equal
+        (batch_w, cap): the chunk-offset component of the stack key
+        keeps the rounds distinct, so the repeat query still replays
+        (regression: colliding keys evicted each other and every
+        replay missed)."""
+        import asyncio
+
+        from horaedb_tpu.metric_engine import MetricEngine
+        from horaedb_tpu.objstore import MemoryObjectStore
+        from horaedb_tpu.storage.config import StorageConfig, from_dict
+        from horaedb_tpu.storage.types import TimeRange
+
+        monkeypatch.setenv("HORAEDB_FUSED_AGG", "1")
+        T0 = (1_700_000_000_000 // 7_200_000) * 7_200_000
+        SPAN = 2 * 3_600_000  # ONE segment
+
+        async def run():
+            cfg = from_dict(StorageConfig, {
+                # 6000 rows / 512-row windows = 12 windows; 2 per round
+                # = 6 rounds, all sharing (seg0, batch_w, cap)
+                "scan": {"max_window_rows": 512, "agg_batch_windows": 2}})
+            e = await MetricEngine.open("replay4", MemoryObjectStore(),
+                                        segment_ms=7_200_000, config=cfg)
+            try:
+                await e.write_arrow(
+                    "cpu", ["host"],
+                    self._mkbatch(8, n=6000, t0=T0, span=SPAN))
+                reader = e.tables["data"].reader
+
+                async def q():
+                    return await e.query_downsample(
+                        "cpu", [], TimeRange.new(T0, T0 + SPAN),
+                        bucket_ms=600_000)
+
+                first = await q()
+                second = await q()
+                assert reader._replay_hits == 1, \
+                    "multi-round segments must still replay"
+                return first, second
+            finally:
+                await e.close()
+
+        first, second = asyncio.run(run())
+        for key in first["aggs"]:
+            np.testing.assert_array_equal(
+                np.asarray(first["aggs"][key]),
+                np.asarray(second["aggs"][key]), err_msg=key)
+
+    def test_replay_invalidated_by_write(self, monkeypatch):
+        """A write changes the segment's SST set: the replay key no
+        longer matches and the fresh rows must appear in the result."""
+        import asyncio
+
+        from horaedb_tpu.storage.types import TimeRange
+
+        monkeypatch.setenv("HORAEDB_FUSED_AGG", "1")
+        T0 = (1_700_000_000_000 // 7_200_000) * 7_200_000
+        SPAN = 2 * 3_600_000  # one segment
+
+        async def run():
+            e = await self._open_engine("replay2")
+            try:
+                await e.write_arrow("cpu", ["host"],
+                                    self._mkbatch(4, t0=T0, span=SPAN))
+                reader = e.tables["data"].reader
+
+                async def q():
+                    return await e.query_downsample(
+                        "cpu", [], TimeRange.new(T0, T0 + SPAN),
+                        bucket_ms=600_000, aggs=("sum",))
+
+                await q()
+                before = await q()
+                hits = reader._replay_hits
+                assert hits >= 1
+                await e.write_arrow("cpu", ["host"],
+                                    self._mkbatch(5, t0=T0, span=SPAN))
+                after = await q()
+                assert reader._replay_hits == hits, \
+                    "stale replay entry must not serve post-write queries"
+                return before, after
+            finally:
+                await e.close()
+
+        before, after = asyncio.run(run())
+        tot_before = np.nansum(np.asarray(before["aggs"]["count"]))
+        tot_after = np.nansum(np.asarray(after["aggs"]["count"]))
+        assert tot_after > tot_before  # the second batch's rows arrived
+
+    def test_replay_falls_back_on_evictions(self, monkeypatch):
+        """Scan-cache clear and stack-LRU eviction each break the
+        recorded identity: the query silently re-runs the full path and
+        re-records, still returning correct grids."""
+        import asyncio
+
+        from horaedb_tpu.storage.types import TimeRange
+
+        monkeypatch.setenv("HORAEDB_FUSED_AGG", "1")
+        T0 = (1_700_000_000_000 // 7_200_000) * 7_200_000
+        SPAN = 4 * 3_600_000
+
+        async def run():
+            e = await self._open_engine("replay3")
+            try:
+                await e.write_arrow("cpu", ["host"],
+                                    self._mkbatch(6, t0=T0, span=SPAN))
+                reader = e.tables["data"].reader
+
+                async def q():
+                    return await e.query_downsample(
+                        "cpu", [], TimeRange.new(T0, T0 + SPAN),
+                        bucket_ms=600_000)
+
+                base = await q()
+                await q()
+                hits = reader._replay_hits
+
+                # stack LRU eviction alone -> replay validation fails
+                with reader._stack_cache_lock:
+                    reader._stack_cache.clear()
+                    reader._stack_cache_bytes = 0
+                after_stack = await q()
+                assert reader._replay_hits == hits
+
+                # re-recorded: next query replays again
+                await q()
+                assert reader._replay_hits == hits + 1
+
+                # full scan-cache clear -> windows re-read, still correct
+                reader.scan_cache.clear()
+                after_clear = await q()
+                assert reader._replay_hits == hits + 1
+                return base, after_stack, after_clear
+            finally:
+                await e.close()
+
+        base, after_stack, after_clear = asyncio.run(run())
+        for other in (after_stack, after_clear):
+            assert base["tsids"] == other["tsids"]
+            for key in base["aggs"]:
+                np.testing.assert_array_equal(
+                    np.asarray(base["aggs"][key]),
+                    np.asarray(other["aggs"][key]), err_msg=key)
+
+
 class TestCachedMeshResidency:
     """VERDICT r2 item 6: a repeat meshed query must run from the
     mesh-sharded stack cache — ZERO host->device transfers."""
