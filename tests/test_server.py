@@ -2,6 +2,7 @@
 
 import asyncio
 
+import numpy as np
 import pytest
 from aiohttp.test_utils import TestClient, TestServer
 
@@ -318,6 +319,63 @@ class TestArrowQueryEndpoint:
                     [float(i) for i in range(10)]
                 r = await client.post("/query_arrow", json={"metric": "x"})
                 assert r.status == 400
+            finally:
+                await client.close()
+                await engine.close()
+
+        run(go())
+
+    def test_query_arrow_downsample_matches_json(self):
+        """The Arrow downsample encoding must carry exactly the grids
+        the JSON endpoint serves (NaN in Arrow == null in JSON)."""
+        async def go():
+            import pyarrow.ipc
+
+            from horaedb_tpu.common.ipc import downsample_from_arrow
+
+            client, _state, engine = await make_client()
+            try:
+                samples = [{"name": "cpu", "labels": {"host": "a"},
+                            "timestamp": T0 + i * 60_000,
+                            "value": float(i)} for i in range(10)]
+                # host b reports only the first bucket: NaN cells in avg
+                samples += [{"name": "cpu", "labels": {"host": "b"},
+                             "timestamp": T0, "value": 7.0}]
+                await client.post("/write", json={"samples": samples})
+                req = {"metric": "cpu", "filters": {},
+                       "start": T0, "end": T0 + 600_000,
+                       "bucket_ms": 300_000}
+                r = await client.post("/query", json=req)
+                jbody = await r.json()
+                r = await client.post("/query_arrow",
+                                      json={**req, "compression": "zstd"})
+                assert r.status == 200
+                out = downsample_from_arrow(
+                    pyarrow.ipc.open_stream(await r.read()).read_all())
+                assert [str(t) for t in out["tsids"]] == jbody["tsids"]
+                assert out["num_buckets"] == jbody["num_buckets"]
+                assert set(out["aggs"]) == set(jbody["aggs"])
+                for k, jgrid in jbody["aggs"].items():
+                    expect = np.array(
+                        [[np.nan if c is None else c for c in row]
+                         for row in jgrid], dtype=np.float64)
+                    np.testing.assert_array_equal(out["aggs"][k], expect,
+                                                  err_msg=k)
+                # fn rides the arrow plane too
+                r = await client.post("/query_arrow", json={
+                    **req, "fn": "delta", "compression": "zstd"})
+                assert r.status == 200
+                out = downsample_from_arrow(
+                    pyarrow.ipc.open_stream(await r.read()).read_all())
+                assert "delta" in out["aggs"]
+                r = await client.post("/query_arrow",
+                                      json={**req, "fn": "np"})
+                assert r.status == 400
+                # non-numeric bucket_ms is a 400, not a 500
+                for ep in ("/query", "/query_arrow"):
+                    r = await client.post(ep, json={
+                        **req, "bucket_ms": "5m"})
+                    assert r.status == 400, ep
             finally:
                 await client.close()
                 await engine.close()
