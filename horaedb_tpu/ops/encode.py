@@ -81,6 +81,10 @@ class DeviceBatch:
     n_valid: int
     capacity: int
     memo: dict = field(default_factory=dict)
+    # bytes currently held by `memo` values (maintained by the reader's
+    # byte-bounded memo store; the scan cache charges an allowance for
+    # this — see scan_cache.windows_nbytes)
+    memo_bytes: int = 0
 
     @property
     def names(self) -> list[str]:

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import asyncio
 import functools
+import threading
 import time
 import weakref
 from dataclasses import dataclass
@@ -117,6 +118,35 @@ _CACHE_BYTES_PER_ROW = 32
 # fused replay plans kept per reader (weakref-only entries; see
 # ParquetReader._replay_cache)
 _REPLAY_SLOTS = 8
+
+
+# guards every window's memo put: memo stores run on worker-pool
+# threads, and the byte accounting must not drift (a lost increment
+# would let real HBM exceed the scan cache's charged allowance)
+_MEMO_LOCK = threading.Lock()
+
+
+def _memo_store(w, key, value, nbytes: int) -> None:
+    """Byte-bounded per-window memo put.  The scan cache charges each
+    window MEMO_SLOTS * (capacity*4 + 128) bytes of memo allowance
+    (scan_cache.windows_nbytes); this store keeps the REAL bytes held by
+    memo values under that allowance — raising one without the other
+    would let actual HBM/RAM use exceed the configured cache budget
+    (e.g. a dev_cols entry is 12 bytes/row, three "slots" worth).
+    A same-key put loses to the entry already stored (identical
+    computation by a concurrent query) so bytes are only ever ADDED for
+    distinct keys — no overwrite double-count."""
+    from horaedb_tpu.storage.scan_cache import MEMO_SLOTS
+
+    budget = MEMO_SLOTS * (w.capacity * 4 + 128)
+    with _MEMO_LOCK:
+        if key in w.memo:
+            return
+        if len(w.memo) >= MEMO_SLOTS or w.memo_bytes + nbytes > budget:
+            w.memo.clear()
+            w.memo_bytes = 0
+        w.memo[key] = value
+        w.memo_bytes += nbytes
 
 
 @dataclass
@@ -1207,8 +1237,12 @@ class ParquetReader:
                     chunk, spec, plan, batch_w, cap, g_pad, width,
                     all_values, local_ok, stack_key=stack_key)
                 if replay_key is not None:
-                    recorded_rounds.append((stack_key, tuple(
-                        weakref.ref(it[1]) for it in chunk)))
+                    windows = tuple(it[1] for it in chunk)
+                    recorded_rounds.append((
+                        stack_key,
+                        self._col_stack_key(windows, spec, plan, batch_w,
+                                            cap),
+                        tuple(weakref.ref(w) for w in windows)))
                 i += len(chunk)
                 yield arrays
 
@@ -1262,14 +1296,15 @@ class ParquetReader:
         accumulate rounds straight from the cached device arrays.
         Returns device grids, or None to fall back to the full path."""
         rounds = []
-        for stack_key, refs in entry["rounds"]:
+        for stack_key, col_key, refs in entry["rounds"]:
             ws = tuple(r() for r in refs)
             if any(w is None for w in ws):
                 return None
-            arrays = self._stack_cache_get(stack_key, ws)
-            if arrays is None:
+            cols = self._stack_cache_get(col_key, ws)
+            small = self._stack_cache_get(stack_key, ws)
+            if cols is None or small is None:
                 return None
-            rounds.append(arrays)
+            rounds.append(cols + small)
         out, t_dev = self._fused_run_device_rounds(
             rounds, spec, entry["g"], entry["g_pad"], entry["width"])
         _STAGE_SECONDS["device_aggregate"].observe(t_dev)
@@ -1400,27 +1435,30 @@ class ParquetReader:
         """Shared per-window prep: (group_values, gid_full, ts_shift) or
         None when the window contributes nothing.  Memoized on the batch
         (keyed by group column + full predicate) so repeat queries over
-        scan-cached windows skip the dense-ification."""
+        scan-cached windows skip the dense-ification.  The memo value is
+        RANGE-INDEPENDENT (values + gid); only the two-int shift depends
+        on range_start and is derived per call — so varied-range queries
+        over the same windows still hit the memo."""
         memo_key = ("window_groups", spec.group_col, spec.ts_col,
-                    spec.range_start,
                     filter_ops.canonical_predicate_key(plan.predicate))
         # single atomic .get(): this now runs on worker-pool threads, so
         # a check-then-read against a concurrent clear() could KeyError;
         # duplicate computation on a lost race is benign (same result)
         miss = object()
         cached_val = out_batch.memo.get(memo_key, miss)
-        if cached_val is not miss:
-            return cached_val
-        result = self._window_groups_uncached(out_batch, spec, plan)
-        # bound the memo at the slot count the scan cache CHARGES per
-        # window (scan_cache.windows_nbytes) — raising one without the
-        # other would let real HBM use exceed the cache budget
-        from horaedb_tpu.storage.scan_cache import MEMO_SLOTS
-
-        if len(out_batch.memo) >= MEMO_SLOTS:
-            out_batch.memo.clear()
-        out_batch.memo[memo_key] = result
-        return result
+        if cached_val is miss:
+            cached_val = self._window_groups_uncached(out_batch, spec, plan)
+            # charge the capacity-sized gid only: group_values is a tiny
+            # host array, and the allowance must fit this entry (4B/row)
+            # PLUS a dev_cols entry (12B/row) for the same spec
+            nbytes = 0 if cached_val is None else int(cached_val[1].nbytes)
+            _memo_store(out_batch, memo_key, cached_val, nbytes)
+        if cached_val is None:
+            return None
+        group_values, gid_full, epoch = cached_val
+        shift = epoch - spec.range_start  # host_ts = dev_ts + epoch
+        ensure(abs(shift) < 2**31, "query range too far from segment epoch")
+        return group_values, gid_full, shift
 
     def _window_groups_uncached(self, out_batch: encode.DeviceBatch,
                                 spec: AggregateSpec, plan: ScanPlan):
@@ -1449,15 +1487,15 @@ class ParquetReader:
         ensure(ts_enc.kind in ("offset", "numeric"),
                f"aggregate needs arithmetic timestamps, got "
                f"{ts_enc.kind!r} encoding for {spec.ts_col!r}")
-        shift = ts_enc.epoch - spec.range_start  # host_ts = dev_ts + epoch
-        ensure(abs(shift) < 2**31, "query range too far from segment epoch")
         group_values = _decode_group_values(
             uniq, out_batch.encodings[spec.group_col])
-        # host windows keep a host gid (stacked + uploaded per round);
-        # device windows memoize the gid device-resident
+        # the memo stores the window's ts EPOCH, not a shift: the caller
+        # derives shift = epoch - range_start so the memo entry serves
+        # every query range.  Host windows keep a host gid (stacked +
+        # uploaded per round); device windows memoize it device-resident
         if isinstance(out_batch.columns[spec.group_col], np.ndarray):
-            return group_values, gid_full, shift
-        return group_values, jnp.asarray(gid_full), shift
+            return group_values, gid_full, ts_enc.epoch
+        return group_values, jnp.asarray(gid_full), ts_enc.epoch
 
     def _dev_scalar(self, val: int, kind: str = "i32"):
         """Memoized tiny device constants: 'i32' scalar or 'arr1'
@@ -1519,15 +1557,71 @@ class ParquetReader:
         return int(min(spec.num_buckets,
                        max(8, 1 << (need - 1).bit_length())))
 
+    def _devcol_stack_ok(self) -> bool:
+        """Whether host windows should stack from per-window memoized
+        DEVICE columns instead of a fresh numpy stack + bulk upload.
+        On accelerators the device copies make varied-range queries
+        (distinct specs -> full-stack misses) re-stack cached HBM arrays
+        with only KB-sized remap/shift uploads; on XLA-CPU the numpy
+        stack is a memcpy and the extra dispatches would only slow it.
+        Meshed scans keep the sharded bulk upload (device copies would
+        live on one device).  HORAEDB_DEVCOL_STACK=1/0 forces (tests
+        cover the device-col path on the CPU backend)."""
+        if self.mesh is not None:
+            return False
+        import os
+
+        forced = os.environ.get("HORAEDB_DEVCOL_STACK", "")
+        if forced in ("0", "1"):
+            return forced == "1"
+        import jax
+
+        return jax.default_backend() != "cpu"
+
+    def _window_device_cols(self, w: encode.DeviceBatch,
+                            spec: AggregateSpec, plan: ScanPlan,
+                            gid: np.ndarray):
+        """(ts, gid, value) device copies of one host window at its own
+        capacity — all range-independent, memoized on the window (same
+        MEMO_SLOTS bound the scan cache charges for)."""
+        memo_key = ("dev_cols", spec.group_col, spec.ts_col,
+                    spec.value_col,
+                    filter_ops.canonical_predicate_key(plan.predicate))
+        miss = object()
+        got = w.memo.get(memo_key, miss)
+        if got is not miss:
+            return got
+        out = (jnp.asarray(np.asarray(w.columns[spec.ts_col],
+                                      dtype=np.int32)),
+               jnp.asarray(np.asarray(gid, dtype=np.int32)),
+               jnp.asarray(np.asarray(w.columns[spec.value_col],
+                                      dtype=np.float32)))
+        _memo_store(w, memo_key, out, sum(int(a.nbytes) for a in out))
+        return out
+
     @staticmethod
     def _round_stack_key(seg0: int, spec: AggregateSpec, plan: ScanPlan,
                          batch_w: int, cap: int, g_pad: int, width: int,
                          space_fp: tuple) -> tuple:
-        """Stack-LRU identity of one round (shared with the fused replay
-        recording — the key must be computed ONE way)."""
+        """Stack-LRU identity of one round's RANGE-DEPENDENT small
+        arrays (remap/shift/lo — KBs; shared with the fused replay
+        recording, so the key must be computed ONE way)."""
         return (seg0, spec.group_col, spec.ts_col,
                 spec.value_col, spec.bucket_ms, spec.range_start,
                 batch_w, cap, g_pad, width, space_fp,
+                filter_ops.canonical_predicate_key(plan.predicate))
+
+    @staticmethod
+    def _col_stack_key(windows_now: tuple, spec: AggregateSpec,
+                       plan: ScanPlan, batch_w: int, cap: int) -> tuple:
+        """Stack-LRU identity of one round's RANGE-INDEPENDENT stacked
+        columns (ts/gid/val — the big HBM arrays).  Keyed by the window
+        object ids (validated by identity refs on get, so id reuse after
+        eviction can't alias), NOT by range/bucket/group-space: every
+        query whose round has the same composition reuses the big
+        stacks and only rebuilds the small remap/shift/lo arrays."""
+        return ("colstack", tuple(id(w) for w in windows_now),
+                spec.group_col, spec.ts_col, spec.value_col, batch_w, cap,
                 filter_ops.canonical_predicate_key(plan.predicate))
 
     def _build_round_stacks(self, items: list, spec: AggregateSpec,
@@ -1540,19 +1634,24 @@ class ParquetReader:
 
         - HOST windows (the default merge layout) stack in numpy and
           cross to the device as ONE transfer per array — not one per
-          window per column;
-        - remap/shift/lo are placed on device HERE and cached with the
-          stacks, so a stack-cache hit issues ZERO transfers;
+          window per column — or, on accelerators, re-stack per-window
+          memoized device columns (_window_device_cols) so only the
+          FIRST query over a window pays the upload;
+        - remap/shift/lo are placed on device HERE and cached, so a
+          full cache hit issues ZERO transfers;
         - under a mesh, placement uses the segment-axis sharding
           directly (cached rounds live sharded — re-placing per query
           would re-pay the transfer).
 
-        Stacked inputs are memoized in a reader-level LRU: for repeat
-        queries over scan-cached windows the stacks are identical.  The
-        entry carries the round's window OBJECTS: a hit requires the
-        exact same DeviceBatches (object identity — stable while
-        scan-cached), which both prevents id-reuse collisions and makes
-        entries self-invalidating; byte accounting and eviction live in
+        Stacked inputs live in a reader-level LRU split in TWO entries:
+        the big ts/gid/val stacks under a range-independent key
+        (_col_stack_key — shared by every query range over the same
+        round composition) and the small remap/shift/lo arrays under
+        the full range-dependent key.  Each entry carries the round's
+        window OBJECTS: a hit requires the exact same DeviceBatches
+        (object identity — stable while scan-cached), which both
+        prevents id-reuse collisions and makes entries
+        self-invalidating; byte accounting and eviction live in
         _stack_cache_put.
 
         Returns (ts_s, gid_s, val_s, remap_d, shift_d, lo_d, lo_host).
@@ -1569,65 +1668,81 @@ class ParquetReader:
                                               batch_w, cap, g_pad, width,
                                               space_fp)
         windows_now = tuple(it[1] for it in items)
-        cached_stack = self._stack_cache_get(stack_key, windows_now)
-        if cached_stack is not None:
-            return cached_stack
+        col_key = self._col_stack_key(windows_now, spec, plan, batch_w, cap)
+        cols = self._stack_cache_get(col_key, windows_now)
+        small = self._stack_cache_get(stack_key, windows_now)
+        if cols is not None and small is not None:
+            return cols + small
         t_build = time.perf_counter()
-        remap = np.zeros((batch_w, g_pad), dtype=np.int32)
-        shift = np.zeros(batch_w, dtype=np.int32)
-        lo = np.zeros(batch_w, dtype=np.int32)
+        built_bytes = 0
         host_rows = all(
             isinstance(it[1].columns[spec.ts_col], np.ndarray)
             and isinstance(it[2][1], np.ndarray) for it in items)
-        for d, (_seg_start, _w, (values, _gid, sh)) in enumerate(items):
-            remap[d, : len(values)] = np.searchsorted(group_space, values)
-            shift[d] = sh
-            if local_ok:
-                lo[d] = max(0, sh // spec.bucket_ms)
-        if host_rows:
-            ts_m = np.zeros((batch_w, cap), dtype=np.int32)
-            gid_m = np.full((batch_w, cap), -1, dtype=np.int32)
-            val_m = np.zeros((batch_w, cap), dtype=np.float32)
-            for d, (_seg_start, w, (_values, gid, _sh)) in enumerate(items):
-                ts_m[d, : w.capacity] = w.columns[spec.ts_col]
-                gid_m[d, : w.capacity] = gid
-                val_m[d, : w.capacity] = w.columns[spec.value_col]
-            ts_s, gid_s, val_s = put(ts_m), put(gid_m), put(val_m)
-        else:
-            ts_rows, gid_rows, val_rows = [], [], []
-            for d, (_seg_start, w, (_values, gid_dev, _sh)) in \
-                    enumerate(items):
-                ts_d = w.columns[spec.ts_col]
-                val_d = w.columns[spec.value_col]
-                if w.capacity < cap:
-                    pad_n = cap - w.capacity
-                    ts_d = jnp.pad(ts_d, (0, pad_n))
-                    gid_dev = jnp.pad(gid_dev, (0, pad_n),
-                                      constant_values=-1)
-                    val_d = jnp.pad(val_d, (0, pad_n))
-                ts_rows.append(jnp.asarray(ts_d))
-                gid_rows.append(jnp.asarray(gid_dev))
-                val_rows.append(jnp.asarray(val_d))
-            if len(items) < batch_w:  # pad the round with no-op windows
-                empty_gid = jnp.full(cap, -1, dtype=jnp.int32)
-                zeros_i = jnp.zeros(cap, dtype=jnp.int32)
-                zeros_f = jnp.zeros(cap, dtype=jnp.float32)
-                for _ in range(batch_w - len(items)):
-                    ts_rows.append(zeros_i)
-                    gid_rows.append(empty_gid)
-                    val_rows.append(zeros_f)
-            ts_s = jnp.stack(ts_rows)
-            gid_s = jnp.stack(gid_rows)
-            val_s = jnp.stack(val_rows)
-            if self.mesh is not None:
-                ts_s, gid_s, val_s = put(ts_s), put(gid_s), put(val_s)
-        remap_d, shift_d, lo_d = put(remap), put(shift), put(lo)
-        entry = (ts_s, gid_s, val_s, remap_d, shift_d, lo_d, lo)
+        if cols is None:
+            if host_rows and not self._devcol_stack_ok():
+                ts_m = np.zeros((batch_w, cap), dtype=np.int32)
+                gid_m = np.full((batch_w, cap), -1, dtype=np.int32)
+                val_m = np.zeros((batch_w, cap), dtype=np.float32)
+                for d, (_seg_start, w, (_values, gid, _sh)) in \
+                        enumerate(items):
+                    ts_m[d, : w.capacity] = w.columns[spec.ts_col]
+                    gid_m[d, : w.capacity] = gid
+                    val_m[d, : w.capacity] = w.columns[spec.value_col]
+                ts_s, gid_s, val_s = put(ts_m), put(gid_m), put(val_m)
+            else:
+                ts_rows, gid_rows, val_rows = [], [], []
+                for d, (_seg_start, w, (_values, gid_dev, _sh)) in \
+                        enumerate(items):
+                    if host_rows:
+                        # range-independent device copies, memoized per
+                        # window: a varied-range query re-stacks cached
+                        # device arrays instead of re-uploading the rows
+                        ts_d, gid_dev, val_d = self._window_device_cols(
+                            w, spec, plan, gid_dev)
+                    else:
+                        ts_d = w.columns[spec.ts_col]
+                        val_d = w.columns[spec.value_col]
+                    if w.capacity < cap:
+                        pad_n = cap - w.capacity
+                        ts_d = jnp.pad(ts_d, (0, pad_n))
+                        gid_dev = jnp.pad(gid_dev, (0, pad_n),
+                                          constant_values=-1)
+                        val_d = jnp.pad(val_d, (0, pad_n))
+                    ts_rows.append(jnp.asarray(ts_d))
+                    gid_rows.append(jnp.asarray(gid_dev))
+                    val_rows.append(jnp.asarray(val_d))
+                if len(items) < batch_w:  # pad round with no-op windows
+                    empty_gid = jnp.full(cap, -1, dtype=jnp.int32)
+                    zeros_i = jnp.zeros(cap, dtype=jnp.int32)
+                    zeros_f = jnp.zeros(cap, dtype=jnp.float32)
+                    for _ in range(batch_w - len(items)):
+                        ts_rows.append(zeros_i)
+                        gid_rows.append(empty_gid)
+                        val_rows.append(zeros_f)
+                ts_s = jnp.stack(ts_rows)
+                gid_s = jnp.stack(gid_rows)
+                val_s = jnp.stack(val_rows)
+                if self.mesh is not None:
+                    ts_s, gid_s, val_s = put(ts_s), put(gid_s), put(val_s)
+            cols = (ts_s, gid_s, val_s)
+            built_bytes += sum(int(a.nbytes) for a in cols)
+            self._stack_cache_put(col_key, windows_now, cols)
+        if small is None:
+            remap = np.zeros((batch_w, g_pad), dtype=np.int32)
+            shift = np.zeros(batch_w, dtype=np.int32)
+            lo = np.zeros(batch_w, dtype=np.int32)
+            for d, (_seg_start, _w, (values, _gid, sh)) in enumerate(items):
+                remap[d, : len(values)] = np.searchsorted(group_space,
+                                                          values)
+                shift[d] = sh
+                if local_ok:
+                    lo[d] = max(0, sh // spec.bucket_ms)
+            small = (put(remap), put(shift), put(lo), lo)
+            built_bytes += sum(int(a.nbytes) for a in small[:3])
+            self._stack_cache_put(stack_key, windows_now, small)
         _STAGE_SECONDS["stack_build"].observe(time.perf_counter() - t_build)
-        _STAGE_BYTES["stack_build"].inc(
-            sum(int(a.nbytes) for a in entry[:6]))
-        self._stack_cache_put(stack_key, windows_now, entry)
-        return entry
+        _STAGE_BYTES["stack_build"].inc(built_bytes)
+        return cols + small
 
     def _flush_window_batch(self, items: list, spec: AggregateSpec,
                             plan: ScanPlan) -> list:

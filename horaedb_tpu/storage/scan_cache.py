@@ -33,8 +33,13 @@ _EVICTIONS = registry.counter("scan_cache_evictions_total",
 
 CacheKey = tuple
 
-# DeviceBatch.memo slots (see storage.read._window_groups) each hold up
-# to one capacity-sized int32 gid array
+# DeviceBatch.memo allowance multiplier: the reader's byte-bounded memo
+# store (storage.read._memo_store) caps each window's memo values at
+# MEMO_SLOTS * (capacity*4 + 128) REAL bytes — entries vary in size (a
+# window_groups gid is 4 B/row, a dev_cols entry 12 B/row, i.e. three
+# "slots" worth), so at the current value the worst-case resident pair
+# (gid + dev_cols = 16 B/row) fits exactly.  Lowering MEMO_SLOTS below
+# 3 would make a single dev_cols entry exceed the budget and thrash.
 MEMO_SLOTS = 4
 
 
