@@ -9,12 +9,28 @@ dense (group, bucket) grids cross chips, as psum/pmax/pmin collectives
 over ICI — never row data.
 """
 
-from horaedb_tpu.parallel.mesh import segment_mesh
-from horaedb_tpu.parallel.scan import (
-    sharded_downsample_query,
-    sharded_merge_dedup,
-    sharded_remap_partials,
-)
+# Lazy exports (PEP 562): importing this package must not initialize
+# the XLA backend (scan.py builds jnp constants at import), because
+# multihost users have to call jax.distributed.initialize() FIRST —
+# `from horaedb_tpu.parallel import multihost` stays backend-free.
+_EXPORTS = {
+    "segment_mesh": "horaedb_tpu.parallel.mesh",
+    "sharded_downsample_query": "horaedb_tpu.parallel.scan",
+    "sharded_merge_dedup": "horaedb_tpu.parallel.scan",
+    "sharded_remap_partials": "horaedb_tpu.parallel.scan",
+    "multihost": "horaedb_tpu.parallel.multihost",
+}
 
-__all__ = ["segment_mesh", "sharded_downsample_query",
-           "sharded_merge_dedup", "sharded_remap_partials"]
+__all__ = list(_EXPORTS)
+
+
+def __getattr__(name: str):
+    if name not in _EXPORTS:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    mod = importlib.import_module(_EXPORTS[name])
+    val = mod if name == "multihost" else getattr(mod, name)
+    globals()[name] = val  # cache: next access skips __getattr__
+    return val

@@ -1,0 +1,66 @@
+"""Worker process for tests/test_multihost.py.
+
+Usage: python multihost_worker.py <coordinator> <nprocs> <rank> <outfile>
+
+Joins the distributed runtime with 4 virtual CPU devices, contributes
+rank-dependent window data to the global downsample query, and writes
+the replicated result grids it observed to <outfile> (.npz).
+"""
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+import numpy as np
+
+
+def main(coordinator: str, nprocs: int, rank: int, outfile: str) -> None:
+    # deliberately import the package FIRST: this guards the lazy
+    # parallel/__init__ invariant (a regression to eager scan imports
+    # would initialize the backend here and make initialize() below
+    # raise "must be called before any JAX calls")
+    from horaedb_tpu.parallel import multihost
+
+    multihost.initialize(coordinator_address=coordinator,
+                         num_processes=nprocs, process_id=rank,
+                         local_device_count=4)
+    idx, count = multihost.process_info()
+    assert (idx, count) == (rank, nprocs), (idx, count)
+    mesh = multihost.global_segment_mesh()
+    n_global = int(np.prod(mesh.devices.shape))
+    assert n_global == 4 * nprocs, n_global
+
+    # deterministic global dataset: every process can construct all of
+    # it, but each contributes only ITS OWN local quarter of windows
+    NUM_GROUPS, NUM_BUCKETS, CAP, K = 8, 4, 128, 3
+    bucket_ms = 60_000
+    rng = np.random.default_rng(99)
+    ts = rng.integers(0, NUM_BUCKETS * bucket_ms,
+                      (n_global, CAP)).astype(np.int32)
+    gid = rng.integers(0, NUM_GROUPS, (n_global, CAP)).astype(np.int32)
+    vals = (rng.random((n_global, CAP)) * 100).astype(np.float32)
+    n_valid = np.full(n_global, CAP - 8, dtype=np.int32)
+
+    # local slice: this process's 4 windows
+    lo, hi = rank * 4, rank * 4 + 4
+    g_ts = multihost.host_local_rows_to_global(mesh, ts[lo:hi])
+    g_gid = multihost.host_local_rows_to_global(mesh, gid[lo:hi])
+    g_vals = multihost.host_local_rows_to_global(mesh, vals[lo:hi])
+    g_nv = multihost.host_local_rows_to_global(mesh, n_valid[lo:hi])
+
+    import jax.numpy as jnp
+
+    fn = multihost.downsample_query_global(
+        mesh, num_groups=NUM_GROUPS, num_buckets=NUM_BUCKETS, k=K)
+    final, top_vals, top_idx = fn(
+        g_ts, g_gid, g_vals, g_nv,
+        jnp.asarray([bucket_ms], dtype=jnp.int32))
+    np.savez(outfile,
+             **{k: np.asarray(v.addressable_data(0))
+                for k, v in final.items()},
+             top_vals=np.asarray(top_vals.addressable_data(0)),
+             top_idx=np.asarray(top_idx.addressable_data(0)))
+    print(f"rank {rank}: wrote {outfile}", flush=True)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4])
