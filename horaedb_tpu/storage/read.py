@@ -80,6 +80,35 @@ _STAGE_BYTES = {
                         f"bytes entering the {s} stage")
     for s in ("parquet_read", "stack_build")
 }
+# cache-effectiveness counters (ops parity with scan_cache_*): the
+# replay and stack LRUs are the reason repeat/varied queries are fast —
+# a production operator needs their hit rates on /metrics
+_REPLAY_HITS = registry.counter(
+    "scan_replay_hits_total", "fused-replay plan cache hits")
+_REPLAY_MISSES = registry.counter(
+    "scan_replay_misses_total", "fused-replay plan cache misses")
+_STACK_HITS = registry.counter(
+    "scan_stack_cache_hits_total",
+    "per-range round-stack LRU hits (small remap/shift/lo entries)")
+_STACK_MISSES = registry.counter(
+    "scan_stack_cache_misses_total",
+    "per-range round-stack LRU misses")
+_COLSTACK_HITS = registry.counter(
+    "scan_colstack_cache_hits_total",
+    "range-independent column-stack LRU hits (the big ts/gid/val "
+    "arrays — the expensive reuse)")
+_COLSTACK_MISSES = registry.counter(
+    "scan_colstack_cache_misses_total",
+    "range-independent column-stack LRU misses")
+
+
+def _stack_counters(key: tuple):
+    # the two entry families have different hit economics: conflating
+    # them would report ~50% on varied-range workloads even when the
+    # expensive column reuse is perfect
+    if key and key[0] == "colstack":
+        return _COLSTACK_HITS, _COLSTACK_MISSES
+    return _STACK_HITS, _STACK_MISSES
 
 
 def _timed_stage(stage: str):
@@ -1160,6 +1189,7 @@ class ParquetReader:
                 if grids is not None:
                     self._replay_cache.move_to_end(replay_key)
                     self._replay_hits += 1
+                    _REPLAY_HITS.inc()
                     # `counted` gates ops metrics across race restarts,
                     # exactly like the full path's per-segment gate
                     fresh = [(s, r) for s, r in entry["seg_rows"]
@@ -1172,6 +1202,7 @@ class ParquetReader:
                         grids, spec)
                 self._replay_cache.pop(replay_key, None)
             self._replay_misses += 1
+            _REPLAY_MISSES.inc()
         items: list[tuple[int, encode.DeviceBatch, tuple]] = []
         seg_records: list[tuple] = []
         seg_rows: list[tuple] = []
@@ -1510,9 +1541,11 @@ class ParquetReader:
 
     def _stack_cache_get(self, key: tuple, windows_now: tuple):
         with self._stack_cache_lock:
+            hits, misses = _stack_counters(key)
             entry = self._stack_cache.get(key)
             if entry is None:
                 self._stack_cache_misses += 1
+                misses.inc()
                 return None
             stored_refs, arrays, nbytes = entry
             # WEAK references: the entry must not pin evicted windows'
@@ -1523,9 +1556,11 @@ class ParquetReader:
                 del self._stack_cache[key]
                 self._stack_cache_bytes -= nbytes
                 self._stack_cache_misses += 1
+                misses.inc()
                 return None
             self._stack_cache.move_to_end(key)
             self._stack_cache_hits += 1
+            hits.inc()
             return arrays
 
     def _stack_cache_put(self, key: tuple, windows_now: tuple,
