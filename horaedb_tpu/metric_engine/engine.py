@@ -38,6 +38,7 @@ from horaedb_tpu.storage.config import StorageConfig
 from horaedb_tpu.storage.read import AggregateSpec, ScanRequest
 from horaedb_tpu.storage.storage import CloudObjectStorage, WriteRequest
 from horaedb_tpu.storage.types import TimeRange, Timestamp
+from horaedb_tpu.utils import registry
 from horaedb_tpu.metric_engine.types import (
     Sample,
     field_id_of,
@@ -431,6 +432,17 @@ class SampleManager:
                 batch, TimeRange.new(lo, hi + 1)))
 
 
+_CHUNK_CACHE_HITS = registry.counter(
+    "chunk_decode_cache_hits_total",
+    "chunked-layout decode cache hits (the chunked scan cache)")
+_CHUNK_CACHE_MISSES = registry.counter(
+    "chunk_decode_cache_misses_total",
+    "chunked-layout decode cache misses")
+_CHUNK_CACHE_EVICTIONS = registry.counter(
+    "chunk_decode_cache_evictions_total",
+    "chunked-layout decode cache evictions")
+
+
 class MetricEngine:
     """The user-facing metric API over five storage instances.
 
@@ -451,6 +463,22 @@ class MetricEngine:
         self.index_manager = IndexManager(tables["series"], tables["tags"],
                                           tables["index"], segment_ms)
         self.sample_manager = SampleManager(tables["data"], segment_ms)
+        # chunked layout: the Append-mode data table bypasses the
+        # reader's scan cache (host merge, uncached), so decoded sample
+        # arrays get their own byte-budgeted LRU — keyed by (predicate,
+        # exact range, SST-id set) with the scan cache's structural
+        # invalidation (any write/compaction changes the SST set).
+        # Budget: the data-table scan-cache bytes, which chunked mode
+        # otherwise leaves unused.
+        if chunked_data:
+            from horaedb_tpu.storage.scan_cache import ByteLRU
+
+            self._chunk_cache = ByteLRU(
+                tables["data"].reader.cache_budget_bytes,
+                hits=_CHUNK_CACHE_HITS, misses=_CHUNK_CACHE_MISSES,
+                evictions=_CHUNK_CACHE_EVICTIONS)
+        else:
+            self._chunk_cache = None
 
     @classmethod
     async def open(cls, root_path: str, store: ObjectStore,
@@ -868,19 +896,50 @@ class MetricEngine:
         table: chunk payloads batch-decode (numpy-vectorized) straight
         into the fixed-width arrays the device aggregation consumes
         (VERDICT r2 item 5; RFC 20240827:218-231 is the layout).  Same
-        pushdown grids as the row layout — parity-tested."""
+        pushdown grids as the row layout — parity-tested.
+
+        Repeat queries skip the (uncached Append-mode) scan AND the
+        decode via the engine's decode LRU: the key is (canonical
+        predicate, exact range, the data table's overlapping SST ids),
+        so any write or compaction structurally invalidates, exactly
+        like the row layout's scan cache.  The cached entry also memoizes
+        the padded device arrays, so a repeat only re-runs the compiled
+        aggregate."""
+        from horaedb_tpu.ops.filter import canonical_predicate_key
+
         pred = await self._resolve_data_predicate(metric, filters,
                                                   time_range, field)
         if pred is None:
             return {"tsids": [], "num_buckets": num_buckets, "aggs": {}}
-        batches = await _collect(self.tables["data"].scan(ScanRequest(
-            range=time_range, predicate=pred)))
-        decoded = self._decode_chunk_arrays(batches, time_range)
-        if decoded is None:
-            return {"tsids": [], "num_buckets": num_buckets, "aggs": {}}
-        tsid_np, ts_np, val_np = decoded
-        return self._downsample_arrays(tsid_np, ts_np, val_np, time_range,
-                                       bucket_ms, num_buckets, which=which)
+        key = entry = None
+        if self._chunk_cache is not None:
+            ssts = await self.tables["data"].manifest.find_ssts(time_range)
+            key = (canonical_predicate_key(pred),
+                   int(time_range.start), int(time_range.end),
+                   tuple(sorted(f.id for f in ssts)))
+            entry = self._chunk_cache.get(key)
+        fresh = entry is None
+        if fresh:
+            batches = await _collect(self.tables["data"].scan(ScanRequest(
+                range=time_range, predicate=pred)))
+            decoded = self._decode_chunk_arrays(batches, time_range)
+            if decoded is None:
+                return {"tsids": [], "num_buckets": num_buckets,
+                        "aggs": {}}
+            entry = {"decoded": decoded, "memo": {}}
+        tsid_np, ts_np, val_np = entry["decoded"]
+        out = self._downsample_arrays(tsid_np, ts_np, val_np, time_range,
+                                      bucket_ms, num_buckets, which=which,
+                                      memo=entry["memo"])
+        if fresh and key is not None:
+            # charge AFTER the memo is built so the device padded
+            # arrays are counted at their real size
+            dev = entry["memo"].get("dev", {})
+            nbytes = 24 * len(ts_np) + 1024 + sum(
+                int(a.nbytes) for a in dev.values()
+                if hasattr(a, "nbytes"))
+            self._chunk_cache.put(key, entry, nbytes)
+        return out
 
     def _downsample_rows(self, tbl: pa.Table, time_range: TimeRange,
                          bucket_ms: int, num_buckets: int,
@@ -895,19 +954,35 @@ class MetricEngine:
     def _downsample_arrays(self, tsid_np, ts_np, val_np,
                            time_range: TimeRange, bucket_ms: int,
                            num_buckets: int,
-                           which: tuple = ALL_AGGS) -> dict:
+                           which: tuple = ALL_AGGS,
+                           memo: Optional[dict] = None) -> dict:
+        """`memo` (chunk decode cache entries pass one) holds the padded
+        DEVICE arrays after the first aggregate, so repeats upload
+        nothing.  Valid because the cache key pins the exact time range
+        (ts offsets are range_start-relative)."""
         import numpy as np
+
+        import jax.numpy as jnp
 
         from horaedb_tpu.ops.downsample import time_bucket_aggregate
         from horaedb_tpu.ops.encode import pad_capacity
 
         n = len(ts_np)
-        uniq, gid = np.unique(tsid_np, return_inverse=True)
-        ts_np = ts_np - int(time_range.start)
-        cap = pad_capacity(n)
-        pad = lambda a, d: np.pad(a.astype(d), (0, cap - n))
+        dev = memo.get("dev") if memo is not None else None
+        if dev is None:
+            uniq, gid = np.unique(tsid_np, return_inverse=True)
+            ts_rel = ts_np - int(time_range.start)
+            cap = pad_capacity(n)
+            pad = lambda a, d: np.pad(a.astype(d), (0, cap - n))
+            dev = {"uniq": uniq, "gid_host": gid, "ts_rel": ts_rel,
+                   "ts": jnp.asarray(pad(ts_rel, np.int32)),
+                   "gid": jnp.asarray(pad(gid, np.int32)),
+                   "val": jnp.asarray(pad(val_np, np.float32))}
+            if memo is not None:
+                memo["dev"] = dev
+        uniq = dev["uniq"]
         aggs = time_bucket_aggregate(
-            pad(ts_np, np.int32), pad(gid, np.int32), pad(val_np, np.float32),
+            dev["ts"], dev["gid"], dev["val"],
             n, bucket_ms, num_groups=len(uniq), num_buckets=num_buckets,
             which=which)
         host = {k: np.asarray(v) for k, v in aggs.items()}
@@ -915,9 +990,10 @@ class MetricEngine:
             # match the pushdown path's grid keys (it emits last_ts only
             # alongside last): per-cell max sample time (absolute ms as
             # float, NaN for empty cells)
-            cell = gid.astype(np.int64) * num_buckets + ts_np // bucket_ms
+            gid_h, ts_rel = dev["gid_host"], dev["ts_rel"]
+            cell = gid_h.astype(np.int64) * num_buckets + ts_rel // bucket_ms
             last_ts = np.full(len(uniq) * num_buckets, -np.inf)
-            np.maximum.at(last_ts, cell, ts_np.astype(np.float64))
+            np.maximum.at(last_ts, cell, ts_rel.astype(np.float64))
             last_ts = last_ts.reshape(len(uniq), num_buckets)
             host["last_ts"] = np.where(np.isinf(last_ts), np.nan,
                                        last_ts + int(time_range.start))

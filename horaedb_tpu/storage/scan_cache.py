@@ -58,38 +58,50 @@ def windows_nbytes(windows: list) -> int:
     return total
 
 
-class ScanCache:
-    def __init__(self, max_bytes: int):
-        self.max_bytes = max_bytes
-        self._entries: "OrderedDict[CacheKey, tuple[list, int]]" = OrderedDict()
-        self._total_bytes = 0
+class ByteLRU:
+    """Byte-budgeted LRU core (event-loop owned — no lock).  Counters
+    are the caller's registry counters, so every cache built on this
+    core is operator-visible on /metrics."""
 
-    def get(self, key: CacheKey) -> Optional[list]:
+    def __init__(self, max_bytes: int, hits=None, misses=None,
+                 evictions=None):
+        self.max_bytes = max_bytes
+        self._entries: "OrderedDict[CacheKey, tuple[object, int]]" = \
+            OrderedDict()
+        self._total_bytes = 0
+        self._hits = hits
+        self._misses = misses
+        self._evictions = evictions
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, key: CacheKey):
         entry = self._entries.get(key)
         if entry is None:
-            _MISSES.inc()
+            self.misses += 1
+            if self._misses is not None:
+                self._misses.inc()
             return None
         self._entries.move_to_end(key)
-        _HITS.inc()
+        self.hits += 1
+        if self._hits is not None:
+            self._hits.inc()
         return entry[0]
 
-    def put(self, key: CacheKey, windows: list) -> None:
-        nbytes = windows_nbytes(windows)
+    def put(self, key: CacheKey, value, nbytes: int) -> None:
         if self.max_bytes <= 0 or nbytes > self.max_bytes:
             return
         if key in self._entries:
             self._total_bytes -= self._entries.pop(key)[1]
-        self._entries[key] = (windows, nbytes)
+        self._entries[key] = (value, nbytes)
         self._total_bytes += nbytes
         while self._total_bytes > self.max_bytes and self._entries:
             _, (_, evicted) = self._entries.popitem(last=False)
             self._total_bytes -= evicted
-            _EVICTIONS.inc()
+            if self._evictions is not None:
+                self._evictions.inc()
 
     def clear(self) -> None:
-        """Drop every entry (releases device buffers via refcounting).
-        Used by cold-path benchmarks and tests; production invalidation
-        is structural (SST-set keys), never explicit."""
         self._entries.clear()
         self._total_bytes = 0
 
@@ -99,3 +111,21 @@ class ScanCache:
 
     def __len__(self) -> int:
         return len(self._entries)
+
+
+class ScanCache(ByteLRU):
+    """Post-merge window cache (see module docstring): the ByteLRU core
+    with window-aware byte accounting and the scan_cache_* counters."""
+
+    def __init__(self, max_bytes: int):
+        super().__init__(max_bytes, hits=_HITS, misses=_MISSES,
+                         evictions=_EVICTIONS)
+
+    def put(self, key: CacheKey, windows: list) -> None:  # type: ignore[override]
+        super().put(key, windows, windows_nbytes(windows))
+
+    def clear(self) -> None:
+        """Drop every entry (releases device buffers via refcounting).
+        Used by cold-path benchmarks and tests; production invalidation
+        is structural (SST-set keys), never explicit."""
+        super().clear()

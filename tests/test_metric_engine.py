@@ -781,6 +781,51 @@ class TestChunkedDataMode:
 
         asyncio.run(go())
 
+    def test_chunked_decode_cache_hits_and_invalidates(self):
+        """Repeat chunked downsamples serve from the decode LRU (the
+        Append scan is uncached, so this is the chunked layout's scan
+        cache); a write changes the data table's SST set and must
+        invalidate so fresh samples appear."""
+        async def go():
+            e = await self._open_chunked()
+            try:
+                samples = [sample("cpu", [("h", f"h{i % 5}")],
+                                  T0 + i * 10_000, float(i))
+                           for i in range(3000)]
+                await e.write(samples)
+                rng_q = TimeRange.new(T0, T0 + HOUR)
+
+                first = await e.query_downsample("cpu", [], rng_q,
+                                                 bucket_ms=300_000)
+                assert e._chunk_cache.hits == 0
+                second = await e.query_downsample("cpu", [], rng_q,
+                                                  bucket_ms=300_000)
+                assert e._chunk_cache.hits == 1
+                for key in first["aggs"]:
+                    np.testing.assert_array_equal(
+                        np.asarray(first["aggs"][key]),
+                        np.asarray(second["aggs"][key]), err_msg=key)
+                # a different bucket size reuses the SAME decoded entry
+                other = await e.query_downsample("cpu", [], rng_q,
+                                                 bucket_ms=600_000)
+                assert e._chunk_cache.hits == 2
+                assert other["num_buckets"] != second["num_buckets"]
+
+                total1 = float(np.asarray(second["aggs"]["count"]).sum())
+                await e.write([sample("cpu", [("h", "h0")],
+                                      T0 + 5_000, 42.0)])
+                hits = e._chunk_cache.hits
+                third = await e.query_downsample("cpu", [], rng_q,
+                                                 bucket_ms=300_000)
+                assert e._chunk_cache.hits == hits, \
+                    "stale decode entry served after a write"
+                total3 = float(np.asarray(third["aggs"]["count"]).sum())
+                assert total3 == total1 + 1
+            finally:
+                await e.close()
+
+        asyncio.run(go())
+
     def test_chunked_storage_is_compact(self):
         """One row per (series, chunk window), not per point."""
 
