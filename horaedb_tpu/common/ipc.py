@@ -57,8 +57,12 @@ def downsample_to_arrow(out: dict) -> pa.Table:
 
 def downsample_from_arrow(tbl: pa.Table) -> dict:
     """Inverse of downsample_to_arrow."""
-    nb = int(tbl.schema.metadata[b"num_buckets"])
-    width = max(1, nb)
+    meta = tbl.schema.metadata or {}
+    if b"num_buckets" not in meta:
+        raise ValueError(
+            "downsample table missing num_buckets metadata "
+            "(malformed peer response)")
+    nb = int(meta[b"num_buckets"])
     tsids = tbl.column("tsid").to_numpy(zero_copy_only=False)
     n = len(tsids)
     aggs = {}
@@ -66,6 +70,10 @@ def downsample_from_arrow(tbl: pa.Table) -> dict:
         if not name.startswith("agg_"):
             continue
         col = tbl.column(name).combine_chunks()
+        # width comes from the FixedSizeList type itself so the grid
+        # shape always matches what the peer encoded (nb==0 encodes as
+        # width-1 grids; trusting metadata alone would mis-reshape)
+        width = col.type.list_size
         flat = col.values.to_numpy(zero_copy_only=False)
         aggs[name[len("agg_"):]] = flat.reshape(n, width)
     return {"tsids": [int(t) for t in tsids], "num_buckets": nb,

@@ -85,6 +85,9 @@ _STAGE_BYTES = {
 # a production operator needs their hit rates on /metrics
 _REPLAY_HITS = registry.counter(
     "scan_replay_hits_total", "fused-replay plan cache hits")
+_REPLAY_ROWS = registry.counter(
+    "scan_replay_rows_total",
+    "rows served from fused-replay hits without re-scanning")
 _REPLAY_MISSES = registry.counter(
     "scan_replay_misses_total", "fused-replay plan cache misses")
 _STACK_HITS = registry.counter(
@@ -262,7 +265,9 @@ class ParquetReader:
 
         cache_bytes = (config.scan.cache_max_bytes
                        or config.scan.cache_max_rows * _CACHE_BYTES_PER_ROW)
-        self._cache_bytes = cache_bytes
+        # public: consumers that bypass the scan cache (chunked-mode
+        # engine LRU) size their own caches off the same budget
+        self.cache_budget_bytes = cache_bytes
         self.scan_cache = ScanCache(cache_bytes)
         # flush-stack LRU: stacked (B, cap) aggregation inputs reused by
         # repeat queries over cached windows.  Separately byte-accounted
@@ -1146,7 +1151,7 @@ class ParquetReader:
         if plan is not None:
             est_rows = sum(f.meta.num_rows
                            for seg in plan.segments for f in seg.ssts)
-            if est_rows * _CACHE_BYTES_PER_ROW > self._cache_bytes:
+            if est_rows * _CACHE_BYTES_PER_ROW > self.cache_budget_bytes:
                 return False
         import jax
 
@@ -1192,11 +1197,13 @@ class ParquetReader:
                     _REPLAY_HITS.inc()
                     # `counted` gates ops metrics across race restarts,
                     # exactly like the full path's per-segment gate
+                    # replay rows go to their OWN counter — nothing was
+                    # read, so feeding rows_scanned/scan_seconds would
+                    # skew operator rows/s and latency percentiles
                     fresh = [(s, r) for s, r in entry["seg_rows"]
                              if s not in counted]
                     if fresh:
-                        _ROWS_SCANNED.inc(sum(r for _, r in fresh))
-                        _SCAN_LATENCY.observe(0.0)
+                        _REPLAY_ROWS.inc(sum(r for _, r in fresh))
                         counted.update(s for s, _ in fresh)
                     return entry["values"], self._fused_last_ts_to_abs(
                         grids, spec)

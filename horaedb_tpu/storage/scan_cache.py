@@ -22,7 +22,6 @@ releases its device buffers through JAX's reference counting.
 from __future__ import annotations
 
 from collections import OrderedDict
-from typing import Optional
 
 from horaedb_tpu.utils import registry
 
