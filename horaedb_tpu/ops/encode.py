@@ -105,10 +105,20 @@ def _encode_offset(np_col: np.ndarray) -> tuple[np.ndarray, int]:
 
 
 def _dictionary_encode(np_col: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
-    # np.unique returns SORTED uniques, so codes are order-preserving:
-    # code comparison == value comparison.  Load-bearing for the device
-    # sort producing the same order as the reference's arrow sort.
-    dictionary, codes = np.unique(np_col, return_inverse=True)
+    # Codes must be order-preserving (code comparison == value
+    # comparison — load-bearing for the device sort producing the same
+    # order as the reference's arrow sort), i.e. the dictionary is
+    # sorted.  SST columns usually arrive already PK-sorted, where the
+    # uniques are just the run starts — three O(n) passes instead of
+    # np.unique's argsort.
+    if len(np_col) and bool(np.all(np_col[:-1] <= np_col[1:])):
+        new_run = np.empty(len(np_col), dtype=bool)
+        new_run[0] = True
+        np.not_equal(np_col[1:], np_col[:-1], out=new_run[1:])
+        codes = np.cumsum(new_run, dtype=np.int64) - 1
+        dictionary = np_col[new_run]
+    else:
+        dictionary, codes = np.unique(np_col, return_inverse=True)
     # strictly below INT32_MAX: the merge kernel reserves the max int32 as
     # its padding sentinel, so the largest code must never equal it
     ensure(len(dictionary) < int(_INT32_MAX), "dictionary overflow")

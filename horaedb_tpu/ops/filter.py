@@ -166,6 +166,31 @@ def predicate_columns(pred: Predicate) -> set[str]:
     return {pred.column}
 
 
+def leaf_mask_host(leaf: Predicate, col: np.ndarray) -> np.ndarray:
+    """numpy bool mask of one comparison leaf over a host column — THE
+    shared leaf evaluator for every host-side predicate path (parquet
+    residual filters, post-merge host evaluation), so comparison
+    semantics (including the [start, end) time-range convention) live in
+    exactly one place."""
+    if isinstance(leaf, Eq):
+        return col == leaf.value
+    if isinstance(leaf, Ne):
+        return col != leaf.value
+    if isinstance(leaf, Lt):
+        return col < leaf.value
+    if isinstance(leaf, Le):
+        return col <= leaf.value
+    if isinstance(leaf, Gt):
+        return col > leaf.value
+    if isinstance(leaf, Ge):
+        return col >= leaf.value
+    if isinstance(leaf, In):
+        return np.isin(col, list(leaf.values))
+    if isinstance(leaf, TimeRangePred):
+        return (col >= leaf.start) & (col < leaf.end)
+    raise Error(f"not a comparison leaf: {leaf!r}")
+
+
 def to_arrow_expression(pred: Predicate, allowed: set[str]):
     expr, _key = to_arrow_expression_with_key(pred, allowed)
     return expr

@@ -209,6 +209,237 @@ def merge_value_counts(pairs: list) -> tuple:
     return values, counts
 
 
+# ---------------------------------------------------------------------------
+# Stats-pruned structured reads.
+#
+# pq.read_table(filters=...) routes through the dataset scanner, whose
+# per-call overhead and row-level expression evaluation cost ~3-6x a
+# plain decode on the segment-read shapes the engine issues (measured:
+# 5.6ms vs 1.7ms on a 72k-row SST).  The scan predicate is a small
+# conjunctive tree over PK columns, so we prune row groups against
+# parquet statistics ourselves (the reference's pruning predicate,
+# read.rs:442-465), decode with ParquetFile.read_row_groups, and apply
+# residual filters as numpy masks only on boundary groups.  Columns
+# pinned by an Eq leaf whose stats prove min==max==value everywhere are
+# not decoded at all — they are reconstructed as constants.
+# ---------------------------------------------------------------------------
+
+
+def conjunct_leaves(pred, allowed: set) -> Optional[list]:
+    """Flatten an And-tree of stats-checkable leaves over `allowed`
+    columns.  Returns None when the tree contains Or/Not/unsupported
+    leaves or columns outside `allowed` — callers then fall back to the
+    expression path (exactly the rows the pushdown would keep must be
+    kept, so anything not provably equivalent opts out)."""
+    from horaedb_tpu.ops import filter as F
+
+    leaves: list = []
+
+    def walk(p) -> bool:
+        if isinstance(p, F.And):
+            return all(walk(c) for c in p.children)
+        if isinstance(p, (F.Eq, F.Lt, F.Le, F.Gt, F.Ge, F.In,
+                          F.TimeRangePred)):
+            if p.column not in allowed:
+                # the arrow pushdown DROPS non-allowed leaves (they are
+                # applied post-merge); mirror that by skipping the leaf
+                return True
+            leaves.append(p)
+            return True
+        if isinstance(p, (F.Or, F.Not, F.Ne)):
+            return False
+        return False
+
+    if pred is None:
+        return None
+    if not walk(pred) or not leaves:
+        # no constraint survives: unfiltered reads stay on pq.read_table
+        # (multithreaded column decode), pruning would add nothing
+        return None
+    return leaves
+
+
+def _leaf_vs_stats(leaf, stats) -> str:
+    """Classify one row group against one leaf: 'empty' (no row can
+    match), 'full' (every row matches), or 'partial'."""
+    from horaedb_tpu.ops import filter as F
+
+    if stats is None or not stats.has_min_max:
+        return "partial"
+    lo, hi = stats.min, stats.max
+    try:
+        if isinstance(leaf, F.Eq):
+            if leaf.value < lo or leaf.value > hi:
+                return "empty"
+            return "full" if lo == hi == leaf.value else "partial"
+        if isinstance(leaf, F.TimeRangePred):
+            if hi < leaf.start or lo >= leaf.end:
+                return "empty"
+            return ("full" if lo >= leaf.start and hi < leaf.end
+                    else "partial")
+        if isinstance(leaf, F.Lt):
+            if lo >= leaf.value:
+                return "empty"
+            return "full" if hi < leaf.value else "partial"
+        if isinstance(leaf, F.Le):
+            if lo > leaf.value:
+                return "empty"
+            return "full" if hi <= leaf.value else "partial"
+        if isinstance(leaf, F.Gt):
+            if hi <= leaf.value:
+                return "empty"
+            return "full" if lo > leaf.value else "partial"
+        if isinstance(leaf, F.Ge):
+            if hi < leaf.value:
+                return "empty"
+            return "full" if lo >= leaf.value else "partial"
+        if isinstance(leaf, F.In):
+            vals = [v for v in leaf.values if lo <= v <= hi]
+            if not vals:
+                return "empty"
+            if lo == hi and lo in leaf.values:
+                return "full"
+            return "partial"
+    except TypeError:
+        # stats/value type mismatch (e.g. bytes vs int): never prune
+        return "partial"
+    return "partial"
+
+
+def _residual_mask(leaves: list, tbl: pa.Table):
+    """numpy row mask for the leaves not proven full on this run."""
+    import numpy as np
+
+    from horaedb_tpu.ops.filter import leaf_mask_host
+
+    mask = np.ones(tbl.num_rows, dtype=bool)
+    for leaf in leaves:
+        col = tbl.column(leaf.column).to_numpy(zero_copy_only=False)
+        mask &= leaf_mask_host(leaf, col)
+    return mask
+
+
+def read_pruned(pf: pq.ParquetFile, columns: Optional[list[str]],
+                leaves: list) -> pa.Table:
+    """Decode `columns` of the row groups that can match the conjunction
+    `leaves`, filtering boundary groups row-level.  Row-level equivalent
+    to pq.read_table(filters=<AND of leaves>) on non-null data."""
+    import numpy as np
+
+    from horaedb_tpu.ops import filter as F
+
+    md = pf.metadata
+    names = [md.schema.column(i).name for i in range(md.num_columns)]
+    col_idx = {n: i for i, n in enumerate(names)}
+    out_cols = list(columns) if columns is not None else names
+
+    # per-group classification
+    selected: list[tuple[int, tuple]] = []  # (group, residual leaves)
+    full_eq: dict[str, object] = {}  # col -> pinned value, candidate
+    for leaf in leaves:
+        if isinstance(leaf, F.Eq) and leaf.column in col_idx:
+            full_eq.setdefault(leaf.column, leaf.value)
+    for g in range(md.num_row_groups):
+        rg = md.row_group(g)
+        residual = []
+        empty = False
+        for leaf in leaves:
+            i = col_idx.get(leaf.column)
+            if i is None:
+                residual.append(leaf)  # missing column: be conservative
+                continue
+            st = rg.column(i).statistics
+            verdict = _leaf_vs_stats(leaf, st)
+            # any nulls in the group break both 'full' proofs and numpy
+            # residual compares — never trust stats without a null count.
+            # ('empty' survives: null rows fail every comparison under
+            # SQL semantics, so a group with no possible match stays
+            # empty regardless of nulls.)
+            if verdict != "empty" and (
+                    st is None or not getattr(st, "has_null_count", False)
+                    or st.null_count):
+                raise _PruneUnsupported()
+            if verdict == "empty":
+                empty = True
+                break
+            if verdict == "partial":
+                residual.append(leaf)
+        if empty:
+            continue
+        # a pinned-Eq candidate must be proven 'full' in EVERY selected
+        # group — a group where it is merely residual disqualifies it
+        for col in list(full_eq):
+            lf = next(l for l in leaves
+                      if isinstance(l, F.Eq) and l.column == col)
+            if lf in residual or col not in col_idx:
+                full_eq.pop(col, None)
+        selected.append((g, tuple(residual)))
+
+    schema = pf.schema_arrow
+    if not selected:
+        arrays = [pa.array([], type=schema.field(n).type) for n in out_cols]
+        return pa.Table.from_arrays(arrays, names=out_cols)
+
+    # columns provably constant across every selected group are not
+    # decoded; rebuild them as constants afterwards (plain types only —
+    # the reconstruction goes through np.full)
+    def _elidable(c: str) -> bool:
+        t = schema.field(c).type
+        return (pa.types.is_integer(t) or pa.types.is_floating(t)
+                or pa.types.is_string(t))
+
+    elide = {c: v for c, v in full_eq.items()
+             if c in out_cols and _elidable(c)}
+    decode_cols = [c for c in out_cols if c not in elide]
+    # residual evaluation may need a column the projection dropped
+    extra = sorted({l.column for _, res in selected for l in res}
+                   - set(decode_cols))
+    read_cols = decode_cols + extra
+
+    if not decode_cols and not sum(
+            (list(res) for _, res in selected), []):
+        # every projected column is an elided constant and no residual
+        # filter remains: nothing needs decoding — build the constants
+        # at the selected groups' total row count directly
+        # (pa.concat_tables over zero-column tables would drop the count)
+        n = sum(md.row_group(g).num_rows for g, _ in selected)
+        arrays = []
+        for c in out_cols:
+            t = schema.field(c).type
+            arrays.append(pa.array(
+                np.full(n, elide[c], dtype=t.to_pandas_dtype()), type=t))
+        return pa.Table.from_arrays(arrays, names=out_cols)
+
+    # consecutive groups with the same residual decode as one run
+    runs: list[tuple[list[int], tuple]] = []
+    for g, residual in selected:
+        if runs and runs[-1][1] == residual and runs[-1][0][-1] == g - 1:
+            runs[-1][0].append(g)
+        else:
+            runs.append(([g], residual))
+    parts = []
+    for groups, residual in runs:
+        tbl = pf.read_row_groups(groups, columns=read_cols,
+                                 use_threads=False)
+        if residual:
+            mask = _residual_mask(list(residual), tbl)
+            if not mask.all():
+                tbl = tbl.filter(pa.array(mask))
+        parts.append(tbl.select(decode_cols) if extra else tbl)
+    out = pa.concat_tables(parts)
+    for c in elide:
+        t = schema.field(c).type
+        arr = pa.array(np.full(out.num_rows, elide[c],
+                               dtype=t.to_pandas_dtype()), type=t)
+        out = out.append_column(pa.field(c, t), arr)
+    return out.select(out_cols)
+
+
+class _PruneUnsupported(Exception):
+    """Internal: this file/predicate cannot be pruned safely; callers
+    fall back to the expression path."""
+
+
 class SstSource:
     """One SST opened for several reads (the streamed segment read does
     one pass-1 column scan plus one pass-2 filtered read PER WINDOW).
@@ -268,20 +499,39 @@ async def open_sst_source(store: ObjectStore, path: str) -> SstSource:
     return SstSource(data=await store.get(path))
 
 
+def _read_pruned_source(source, columns, leaves, memory_map) -> pa.Table:
+    pf = pq.ParquetFile(source, memory_map=memory_map)
+    try:
+        return read_pruned(pf, columns, leaves)
+    finally:
+        pf.close()
+
+
 async def read_sst(store: ObjectStore, path: str,
                    columns: Optional[list[str]] = None,
                    filters=None, runtimes=None,
-                   pool: str = "sst") -> pa.Table:
-    """Read an SST, optionally a column subset and a pyarrow filter
-    expression (row-group pruning via parquet statistics + row filtering
+                   pool: str = "sst", leaves: Optional[list] = None) -> pa.Table:
+    """Read an SST, optionally a column subset and a pushed-down
+    predicate (row-group pruning via parquet statistics + row filtering
     — the reference's ParquetExec pruning predicate, read.rs:442-465).
 
-    Local stores expose a filesystem path for mmap'd reads; other stores
-    go through a bytes buffer.  Decode always runs on a worker pool.
+    `leaves` (a conjunct_leaves result) selects the fast stats-pruned
+    decode; `filters` (a pyarrow expression) is the fallback for
+    predicate shapes the pruner refuses.  Both keep exactly the same
+    rows.  Local stores expose a filesystem path for mmap'd reads; other
+    stores go through a bytes buffer.  Decode always runs on a worker
+    pool.
     """
     local_path = getattr(store, "local_path", None)
     if local_path is not None:
         try:
+            if leaves is not None:
+                try:
+                    return await _run(runtimes, pool, _read_pruned_source,
+                                      local_path(path), columns, leaves,
+                                      True)
+                except _PruneUnsupported:
+                    pass  # nulls in a predicate column: expression path
             return await _run(runtimes, pool, pq.read_table,
                               local_path(path), columns=columns,
                               memory_map=True, filters=filters)
@@ -290,6 +540,12 @@ async def read_sst(store: ObjectStore, path: str,
             # the store contract's error so scan retries replan (the
             # non-local branch gets this from store.get)
             raise NotFoundError(f"object not found: {path}") from e
-    data = await store.get(path)
+    data = await store.get(path)  # fetched ONCE, shared by both paths
+    if leaves is not None:
+        try:
+            return await _run(runtimes, pool, _read_pruned_source,
+                              pa.BufferReader(data), columns, leaves, False)
+        except _PruneUnsupported:
+            pass
     return await _run(runtimes, pool, pq.read_table, pa.BufferReader(data),
                       columns=columns, filters=filters)

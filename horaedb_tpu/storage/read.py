@@ -229,6 +229,9 @@ class ScanPlan:
     pushdown: object = None
     # canonical string of the pushed subtree (scan-cache identity)
     pushdown_key: str = ""
+    # flattened conjunction of the same pushed subtree for the
+    # stats-pruned decode path (None: shape not prunable, use pushdown)
+    prune_leaves: Optional[list] = None
     # compaction scans set this False: their input SST sets are deleted
     # right after, so caching them only evicts hot query entries
     use_cache: bool = True
@@ -334,12 +337,15 @@ class ParquetReader:
         ]
         pushdown = None
         pushdown_key = ""
+        allowed = set(self.schema.primary_key_names)
         if request.predicate is not None:
             pushdown, pushdown_key = filter_ops.to_arrow_expression_with_key(
-                request.predicate, set(self.schema.primary_key_names))
+                request.predicate, allowed)
         return ScanPlan(segments=segments, mode=self.schema.update_mode,
                         predicate=request.predicate, keep_builtin=keep_builtin,
                         pushdown=pushdown, pushdown_key=pushdown_key,
+                        prune_leaves=parquet_io.conjunct_leaves(
+                            request.predicate, allowed),
                         use_cache=use_cache, pool=pool, range=request.range)
 
     # ---- execution ---------------------------------------------------------
@@ -716,7 +722,8 @@ class ParquetReader:
             await sem.acquire()
             t0 = time.perf_counter()
             table = await self._read_segment_table(seg, plan.pushdown,
-                                                   pool=plan.pool)
+                                                   pool=plan.pool,
+                                                   leaves=plan.prune_leaves)
             read_s = time.perf_counter() - t0
             _STAGE_SECONDS["parquet_read"].observe(read_s)
             _STAGE_ROWS["parquet_read"].inc(table.num_rows)
@@ -737,11 +744,13 @@ class ParquetReader:
 
     async def _read_segment_table(self, seg: SegmentPlan,
                                   pushdown=None,
-                                  pool: str = "sst") -> pa.Table:
+                                  pool: str = "sst",
+                                  leaves: Optional[list] = None) -> pa.Table:
         tables = await asyncio.gather(*(
             parquet_io.read_sst(self.store, sst_path(self.root_path, f.id),
                                 columns=seg.columns, filters=pushdown,
-                                runtimes=self.runtimes, pool=pool)
+                                runtimes=self.runtimes, pool=pool,
+                                leaves=leaves)
             for f in seg.ssts
         ))
         return pa.concat_tables(tables)
@@ -1817,6 +1826,16 @@ class ParquetReader:
         width = self._window_grid_width(spec) if local_ok \
             else spec.num_buckets
 
+        if self.mesh is None and jax.default_backend() == "cpu" and all(
+                isinstance(it[1].columns[spec.ts_col], np.ndarray)
+                for it in items):
+            # XLA-CPU's segmented scatters run ~20x slower than numpy's
+            # bincount and there is no transfer to amortize — aggregate
+            # where the rows already live (the accelerator trade-off is
+            # the opposite; see _build_round_stacks)
+            return _host_window_partials(items, spec, round_values,
+                                         local_ok, width)
+
         ts_s, gid_s, val_s, remap_d, shift_d, lo_dev, lo = \
             self._build_round_stacks(items, spec, plan, batch_w, cap,
                                      g_pad, width, round_values, local_ok)
@@ -1881,6 +1900,79 @@ class ParquetReader:
 
 
 _ACC_TS_MIN = jnp.int32(-(2**31))
+
+
+def _host_window_partials(items: list, spec: AggregateSpec,
+                          round_values: np.ndarray, local_ok: bool,
+                          width: int) -> list:
+    """numpy twin of _batched_window_partials_jit for the CPU backend.
+
+    Grid conventions — combine identities (count/sum 0, min +F32_MAX,
+    max -F32_MAX, last_ts I32_MIN), f32 cells, window-local bucket
+    ranges, later-row tie-break for `last`, and the last_ts rebase —
+    match the device kernel exactly, so combine_aggregate_parts cannot
+    tell the paths apart.  Returns [(seg_start, (round_values, lo_d,
+    grids))] like _flush_window_batch."""
+    t_dev = time.perf_counter()
+    want = set(spec.which)
+    if "avg" in want:
+        want.add("sum")
+    g = len(round_values)
+    ncells = g * width
+    parts = []
+    for seg_start, w, (values, gid_full, sh) in items:
+        remap = np.searchsorted(round_values, values)
+        gid = np.asarray(gid_full)
+        ts = np.asarray(w.columns[spec.ts_col]).astype(np.int64)
+        vals = np.asarray(w.columns[spec.value_col], dtype=np.float64)
+        lo_d = max(0, sh // spec.bucket_ms) if local_ok else 0
+        w_eff = min(width, spec.num_buckets - lo_d)
+        ts_g = ts + sh
+        bucket_g = ts_g // spec.bucket_ms
+        gid_u = np.where(
+            gid >= 0, remap[np.clip(gid, 0, max(0, len(values) - 1))], -1)
+        np.putmask(gid_u, bucket_g >= spec.num_buckets, -1)
+        b_local = bucket_g - lo_d
+        in_grid = (gid_u >= 0) & (b_local >= 0) & (b_local < width)
+        cell = (gid_u * width + b_local)[in_grid]
+        vv = vals[in_grid]
+        count64 = np.bincount(cell, minlength=ncells)
+        count = count64.astype(np.float32).reshape(g, width)
+        grids = {"count": count[:, :w_eff]}
+        if "sum" in want:
+            grids["sum"] = np.bincount(
+                cell, weights=vv, minlength=ncells).astype(
+                    np.float32).reshape(g, width)[:, :w_eff]
+        if "min" in want:
+            # +/-inf identities for untouched cells — masked rows land in
+            # the device kernel's overflow segment, so empty cells read
+            # the segmented op's identity, not the F32_MAX row filler
+            mn = np.full(ncells, np.inf)
+            np.minimum.at(mn, cell, vv)
+            grids["min"] = mn.astype(np.float32).reshape(g, width)[:, :w_eff]
+        if "max" in want:
+            mx = np.full(ncells, -np.inf)
+            np.maximum.at(mx, cell, vv)
+            grids["max"] = mx.astype(np.float32).reshape(g, width)[:, :w_eff]
+        if "last" in want:
+            ts_local = (ts_g - lo_d * spec.bucket_ms)[in_grid]
+            lt = np.full(ncells, int(_ACC_TS_MIN), dtype=np.int64)
+            np.maximum.at(lt, cell, ts_local)
+            at_max = ts_local == lt[cell]
+            rows = np.flatnonzero(in_grid)[at_max]
+            li = np.full(ncells, -1, dtype=np.int64)
+            np.maximum.at(li, cell[at_max], rows)
+            last = np.zeros(ncells)
+            has = li >= 0
+            last[has] = vals[li[has]]
+            grids["last"] = last.astype(np.float32).reshape(
+                g, width)[:, :w_eff]
+            ltg = lt.reshape(g, width)[:, :w_eff]
+            grids["last_ts"] = np.where(count[:, :w_eff] > 0,
+                                        ltg + lo_d * spec.bucket_ms, ltg)
+        parts.append((seg_start, (round_values, lo_d, grids)))
+    _STAGE_SECONDS["device_aggregate"].observe(time.perf_counter() - t_dev)
+    return parts
 
 
 @functools.partial(jax.jit, static_argnames=("num_groups", "num_buckets",
@@ -2144,6 +2236,11 @@ def _plan_merge_perm(sort_cols: list[np.ndarray],
     n = len(keys[0])
     if n <= 1:
         return None
+    # sortedness first: single-SST segments and non-overlapping writes
+    # (the common cold case) exit here after ~one compare pass, before
+    # paying any key-packing arithmetic
+    if _is_lex_sorted(keys):
+        return None
     packed = None
     span_prod = 1
     for c in keys:  # most-significant first
@@ -2157,11 +2254,7 @@ def _plan_merge_perm(sort_cols: list[np.ndarray],
         part = c64 - lo
         packed = part if packed is None else packed * span + part
     if packed is not None:
-        if bool(np.all(packed[:-1] <= packed[1:])):
-            return None
         return np.argsort(packed, kind="stable").astype(np.int32)
-    if _is_lex_sorted(keys):
-        return None
     return np.lexsort(tuple(reversed(keys))).astype(np.int32)
 
 
@@ -2298,24 +2391,7 @@ def _eval_predicate_host(pred, batch: pa.RecordBatch) -> np.ndarray:
     if isinstance(pred, F.Not):
         return ~_eval_predicate_host(pred.child, batch)
     col = batch.column(batch.schema.names.index(pred.column))
-    vals = col.to_numpy(zero_copy_only=False)
-    if isinstance(pred, F.Eq):
-        return vals == pred.value
-    if isinstance(pred, F.Ne):
-        return vals != pred.value
-    if isinstance(pred, F.Lt):
-        return vals < pred.value
-    if isinstance(pred, F.Le):
-        return vals <= pred.value
-    if isinstance(pred, F.Gt):
-        return vals > pred.value
-    if isinstance(pred, F.Ge):
-        return vals >= pred.value
-    if isinstance(pred, F.In):
-        return np.isin(vals, list(pred.values))
-    if isinstance(pred, F.TimeRangePred):
-        return (vals >= pred.start) & (vals < pred.end)
-    raise AssertionError(f"unknown predicate {pred!r}")
+    return F.leaf_mask_host(pred, col.to_numpy(zero_copy_only=False))
 
 
 def describe_plan(plan: ScanPlan) -> str:

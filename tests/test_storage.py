@@ -1087,3 +1087,121 @@ class TestAppendModeWindowing:
             assert windowed == full
             assert order_win == sorted(order_win)  # global key order kept
         asyncio.run(go())
+
+
+class TestPrunedRead:
+    """read_pruned must keep exactly the rows pq.read_table(filters=...)
+    keeps, across group-pruning, residual, constant-elision, and
+    degenerate-projection shapes."""
+
+    def _file(self, nulls=False):
+        import io
+
+        import pyarrow.parquet as pq
+
+        n = 3000
+        mid = np.full(n, 42, dtype=np.uint64)
+        tsid = np.sort(np.random.default_rng(0).integers(
+            0, 1 << 40, 7).astype(np.uint64).repeat(n // 7 + 1)[:n])
+        ts = np.tile(np.arange(n // 10, dtype=np.int64) * 1000, 10)[:n]
+        val = np.random.default_rng(1).random(n)
+        if nulls:
+            ts_arr = pa.array(
+                [None if i == 17 else int(t) for i, t in enumerate(ts)],
+                type=pa.int64())
+        else:
+            ts_arr = pa.array(ts, type=pa.int64())
+        tbl = pa.table({"metric_id": pa.array(mid), "tsid": pa.array(tsid),
+                        "timestamp": ts_arr,
+                        "value": pa.array(val, type=pa.float64())})
+        sink = io.BytesIO()
+        pq.write_table(tbl, sink, row_group_size=256,
+                       compression="snappy", write_statistics=True)
+        return sink.getvalue()
+
+    def _both(self, data, columns, leaves, expr):
+        import pyarrow.parquet as pq
+
+        from horaedb_tpu.storage.parquet_io import read_pruned
+
+        pf = pq.ParquetFile(pa.BufferReader(data))
+        try:
+            pruned = read_pruned(pf, columns, leaves)
+        finally:
+            pf.close()
+        ref = pq.read_table(pa.BufferReader(data), columns=columns,
+                            filters=expr)
+        return pruned, ref
+
+    @pytest.mark.parametrize("shape", ["range", "eq_const", "eq_tsid",
+                                       "in", "empty", "all", "gt"])
+    def test_matches_expression_path(self, shape):
+        import pyarrow.compute as pc
+
+        from horaedb_tpu.ops.filter import Ge, In, Lt
+
+        data = self._file()
+        cases = {
+            "range": ([TimeRangePred("timestamp", 50_000, 150_000)],
+                      (pc.field("timestamp") >= 50_000)
+                      & (pc.field("timestamp") < 150_000)),
+            "eq_const": ([Eq("metric_id", 42),
+                          TimeRangePred("timestamp", 0, 100_000)],
+                         (pc.field("metric_id") == 42)
+                         & (pc.field("timestamp") >= 0)
+                         & (pc.field("timestamp") < 100_000)),
+            "eq_tsid": ([Eq("metric_id", 42)], pc.field("metric_id") == 42),
+            "in": ([In("tsid", frozenset([1, 2]))],
+                   pc.field("tsid").isin([1, 2])),
+            "empty": ([Eq("metric_id", 7)], pc.field("metric_id") == 7),
+            "all": ([Ge("timestamp", 0)], pc.field("timestamp") >= 0),
+            "gt": ([Lt("timestamp", 1234)], pc.field("timestamp") < 1234),
+        }
+        leaves, expr = cases[shape]
+        cols = ["metric_id", "tsid", "timestamp", "value"]
+        pruned, ref = self._both(data, cols, leaves, expr)
+        assert pruned.schema.names == ref.schema.names
+        assert pruned.sort_by("timestamp").equals(
+            ref.sort_by("timestamp").cast(pruned.schema))
+
+    def test_all_columns_elided_keeps_row_count(self):
+        import pyarrow.compute as pc
+
+        data = self._file()
+        pruned, ref = self._both(
+            data, ["metric_id"], [Eq("metric_id", 42)],
+            pc.field("metric_id") == 42)
+        assert pruned.num_rows == ref.num_rows == 3000
+        assert pruned.column("metric_id").to_pylist()[:3] == [42, 42, 42]
+
+    def test_nulls_in_predicate_column_fall_back(self):
+        import pyarrow.parquet as pq
+
+        from horaedb_tpu.storage.parquet_io import (
+            _PruneUnsupported,
+            read_pruned,
+        )
+
+        data = self._file(nulls=True)
+        pf = pq.ParquetFile(pa.BufferReader(data))
+        try:
+            with pytest.raises(_PruneUnsupported):
+                read_pruned(pf, None,
+                            [TimeRangePred("timestamp", 0, 10_000)])
+        finally:
+            pf.close()
+
+    def test_conjunct_leaves_shapes(self):
+        from horaedb_tpu.ops.filter import And, Ne, Or
+        from horaedb_tpu.storage.parquet_io import conjunct_leaves
+
+        pks = {"metric_id", "timestamp"}
+        assert conjunct_leaves(None, pks) is None
+        assert conjunct_leaves(Eq("value", 1.0), pks) is None  # dropped
+        got = conjunct_leaves(
+            And((Eq("metric_id", 1), Eq("value", 2.0),
+                 TimeRangePred("timestamp", 0, 10))), pks)
+        assert got is not None and len(got) == 2
+        assert conjunct_leaves(
+            Or((Eq("metric_id", 1), Eq("metric_id", 2))), pks) is None
+        assert conjunct_leaves(Ne("metric_id", 1), pks) is None
