@@ -757,9 +757,18 @@ class MetricEngine:
 
     async def _resolve_data_predicate(self, metric: str,
                                       filters: list[tuple[str, str]],
-                                      time_range: TimeRange, field: str):
+                                      time_range: TimeRange, field: str,
+                                      ts_leaf: bool = True):
         """Shared resolve + data-table predicate construction for both
-        raw and downsample queries; None means provably empty."""
+        raw and downsample queries; None means provably empty.
+
+        `ts_leaf=False` omits the time-range leaf: bucket-ALIGNED
+        downsample queries enforce [start, end) exactly through the
+        aggregate grid cut, and a predicate without the range makes the
+        scan-cache windows and per-window aggregation memos fully
+        RANGE-INDEPENDENT — rotating/zooming dashboard queries over the
+        same data share one set of cached merge windows instead of
+        re-reading per range."""
         mid = await self.metric_manager.resolve(metric, time_range)
         if mid is None:
             return None
@@ -776,7 +785,7 @@ class MetricEngine:
             lo = int(Timestamp(max(0, int(time_range.start))).truncate_by(
                 self.chunk_window_ms))
             preds.append(TimeRangePred("chunk_ts", lo, int(time_range.end)))
-        else:
+        elif ts_leaf:
             preds.append(TimeRangePred("timestamp", int(time_range.start),
                                        int(time_range.end)))
         if tsids is not None:
@@ -873,8 +882,18 @@ class MetricEngine:
             return await self._downsample_chunked(
                 metric, filters, time_range, bucket_ms, num_buckets,
                 field=field, which=tuple(aggs))
+        # bucket-aligned range: the grid cut ([0, num_buckets) on
+        # range-relative buckets) IS the time filter, exactly — omit the
+        # ts leaf so cached windows/memos serve every aligned range.
+        # Only when the span covers at least one segment, though: there
+        # the read amplification is bounded by the two boundary segments
+        # (<= 2x), while a narrow query over a wide segment would decode
+        # the whole segment for a sliver (config-2 point queries keep
+        # their row-group pruning).
+        aligned = span % bucket_ms == 0 and span >= self.segment_ms
         pred = await self._resolve_data_predicate(metric, filters,
-                                                  time_range, field)
+                                                  time_range, field,
+                                                  ts_leaf=not aligned)
         if pred is None:
             return {"tsids": [], "num_buckets": num_buckets, "aggs": {}}
         spec = AggregateSpec(group_col="tsid", ts_col="timestamp",

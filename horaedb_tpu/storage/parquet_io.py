@@ -396,8 +396,7 @@ def read_pruned(pf: pq.ParquetFile, columns: Optional[list[str]],
                    - set(decode_cols))
     read_cols = decode_cols + extra
 
-    if not decode_cols and not sum(
-            (list(res) for _, res in selected), []):
+    if not decode_cols and not any(res for _, res in selected):
         # every projected column is an elided constant and no residual
         # filter remains: nothing needs decoding — build the constants
         # at the selected groups' total row count directly
@@ -425,7 +424,10 @@ def read_pruned(pf: pq.ParquetFile, columns: Optional[list[str]],
             mask = _residual_mask(list(residual), tbl)
             if not mask.all():
                 tbl = tbl.filter(pa.array(mask))
-        parts.append(tbl.select(decode_cols) if extra else tbl)
+        # with an empty projection the residual columns must stay in the
+        # part — a zero-column table loses its row count in concat
+        parts.append(tbl.select(decode_cols)
+                     if extra and decode_cols else tbl)
     out = pa.concat_tables(parts)
     for c in elide:
         t = schema.field(c).type

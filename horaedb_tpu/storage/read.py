@@ -171,6 +171,11 @@ def _memo_store(w, key, value, nbytes: int) -> None:
     from horaedb_tpu.storage.scan_cache import MEMO_SLOTS
 
     budget = MEMO_SLOTS * (w.capacity * 4 + 128)
+    if nbytes > budget:
+        # an entry larger than the whole allowance (e.g. partial grids
+        # for a huge group count) must not bust the accounting — callers
+        # just recompute next time
+        return
     with _MEMO_LOCK:
         if key in w.memo:
             return
@@ -1807,6 +1812,17 @@ class ParquetReader:
         (rows a window didn't touch have count 0 and fold away in the
         combiner).  Rounds are padded to the full batch width with empty
         windows so one program shape serves every flush."""
+        if self.mesh is None and jax.default_backend() == "cpu" and all(
+                isinstance(it[1].columns[spec.ts_col], np.ndarray)
+                for it in items):
+            # XLA-CPU's segmented scatters run ~20x slower than numpy's
+            # bincount and there is no transfer to amortize — aggregate
+            # where the rows already live (the accelerator trade-off is
+            # the opposite; see _build_round_stacks).  Per-window partial
+            # grids are memoized range-independently, so repeat/varied
+            # queries slice cached grids instead of re-scanning rows.
+            return _host_window_partials(items, spec, plan)
+
         if self.mesh is not None:
             batch_w = self.mesh.devices.size
         else:
@@ -1825,16 +1841,6 @@ class ParquetReader:
             it[1].encodings[spec.ts_col].kind == "offset" for it in items)
         width = self._window_grid_width(spec) if local_ok \
             else spec.num_buckets
-
-        if self.mesh is None and jax.default_backend() == "cpu" and all(
-                isinstance(it[1].columns[spec.ts_col], np.ndarray)
-                for it in items):
-            # XLA-CPU's segmented scatters run ~20x slower than numpy's
-            # bincount and there is no transfer to amortize — aggregate
-            # where the rows already live (the accelerator trade-off is
-            # the opposite; see _build_round_stacks)
-            return _host_window_partials(items, spec, round_values,
-                                         local_ok, width)
 
         ts_s, gid_s, val_s, remap_d, shift_d, lo_dev, lo = \
             self._build_round_stacks(items, spec, plan, batch_w, cap,
@@ -1902,75 +1908,141 @@ class ParquetReader:
 _ACC_TS_MIN = jnp.int32(-(2**31))
 
 
+# cells ceiling for a memoized full-span window grid (~256 MB of f32
+# per aggregate); beyond it the window recomputes range-clipped,
+# unmemoized grids instead of allocating the full span
+_HOST_GRID_MAX_CELLS = 64 << 20
+
+
+def _host_window_full_grids(w: encode.DeviceBatch, values: np.ndarray,
+                            gid: np.ndarray, epoch: int, phase: int,
+                            bucket_ms: int, want: frozenset,
+                            ts_col: str, value_col: str,
+                            clip: Optional[tuple] = None):
+    """One window's partial grids over its FULL ts span, in absolute
+    phase-shifted buckets A = (host_ts - phase) // bucket_ms — no query
+    range anywhere, so the result is reusable by every query sharing
+    (bucket_ms, phase).  Returns (A0, grids): grids cover absolute
+    buckets [A0, A0 + W); last_ts is ABSOLUTE host ms (int64, I32_MIN
+    sentinel in empty cells).
+
+    `clip=(lo_ms, hi_ms)` bounds the rows to a host-ts range first —
+    the fallback shape when the unclipped span would exceed
+    _HOST_GRID_MAX_CELLS (returns the string "toobig" in that case so
+    the caller can re-invoke clipped and skip the memo)."""
+    g = len(values)
+    ts_abs = np.asarray(w.columns[ts_col]).astype(np.int64) + epoch
+    vals = np.asarray(w.columns[value_col], dtype=np.float64)
+    valid = gid >= 0
+    if clip is not None:
+        valid = valid & (ts_abs >= clip[0]) & (ts_abs < clip[1])
+    if not valid.any():
+        return None
+    A = (ts_abs - phase) // bucket_ms
+    A0 = int(A[valid].min())
+    W = int(A[valid].max()) - A0 + 1
+    ncells = g * W
+    if clip is None and ncells > _HOST_GRID_MAX_CELLS:
+        return "toobig"
+    cell = (gid.astype(np.int64) * W + (A - A0))[valid]
+    vv = vals[valid]
+    count = np.bincount(cell, minlength=ncells).astype(
+        np.float32).reshape(g, W)
+    grids = {"count": count}
+    if "sum" in want:
+        grids["sum"] = np.bincount(cell, weights=vv, minlength=ncells
+                                   ).astype(np.float32).reshape(g, W)
+    if "min" in want:
+        # +/-inf identities for untouched cells — masked rows land in
+        # the device kernel's overflow segment, so empty cells read the
+        # segmented op's identity, not the F32_MAX row filler
+        mn = np.full(ncells, np.inf)
+        np.minimum.at(mn, cell, vv)
+        grids["min"] = mn.astype(np.float32).reshape(g, W)
+    if "max" in want:
+        mx = np.full(ncells, -np.inf)
+        np.maximum.at(mx, cell, vv)
+        grids["max"] = mx.astype(np.float32).reshape(g, W)
+    if "last" in want:
+        tsv = ts_abs[valid]
+        lt = np.full(ncells, int(_ACC_TS_MIN), dtype=np.int64)
+        np.maximum.at(lt, cell, tsv)
+        at_max = tsv == lt[cell]
+        rows = np.flatnonzero(valid)[at_max]
+        li = np.full(ncells, -1, dtype=np.int64)
+        np.maximum.at(li, cell[at_max], rows)
+        last = np.zeros(ncells)
+        has = li >= 0
+        last[has] = vals[li[has]]
+        grids["last"] = last.astype(np.float32).reshape(g, W)
+        grids["last_ts"] = lt.reshape(g, W)
+    return A0, grids
+
+
 def _host_window_partials(items: list, spec: AggregateSpec,
-                          round_values: np.ndarray, local_ok: bool,
-                          width: int) -> list:
+                          plan: ScanPlan) -> list:
     """numpy twin of _batched_window_partials_jit for the CPU backend.
 
-    Grid conventions — combine identities (count/sum 0, min +F32_MAX,
-    max -F32_MAX, last_ts I32_MIN), f32 cells, window-local bucket
-    ranges, later-row tie-break for `last`, and the last_ts rebase —
-    match the device kernel exactly, so combine_aggregate_parts cannot
-    tell the paths apart.  Returns [(seg_start, (round_values, lo_d,
+    Each window's full-span grids are memoized RANGE-INDEPENDENTLY on
+    the window (keyed by bucket width + range phase + predicate +
+    aggregates); a query only slices the cached grids to its bucket
+    range and rebases last_ts — repeat AND varied-range queries over
+    scan-cached windows skip row aggregation entirely.  Grid
+    conventions (combine identities, f32 cells, later-row last
+    tie-break) match the device kernel, so combine_aggregate_parts
+    cannot tell the paths apart.  Returns [(seg_start, (values, lo,
     grids))] like _flush_window_batch."""
     t_dev = time.perf_counter()
-    want = set(spec.which)
-    if "avg" in want:
-        want.add("sum")
-    g = len(round_values)
-    ncells = g * width
+    want = frozenset(spec.which) | (
+        {"sum"} if "avg" in spec.which else set())
+    phase = spec.range_start % spec.bucket_ms
+    q0 = (spec.range_start - phase) // spec.bucket_ms
     parts = []
     for seg_start, w, (values, gid_full, sh) in items:
-        remap = np.searchsorted(round_values, values)
-        gid = np.asarray(gid_full)
-        ts = np.asarray(w.columns[spec.ts_col]).astype(np.int64)
-        vals = np.asarray(w.columns[spec.value_col], dtype=np.float64)
-        lo_d = max(0, sh // spec.bucket_ms) if local_ok else 0
-        w_eff = min(width, spec.num_buckets - lo_d)
-        ts_g = ts + sh
-        bucket_g = ts_g // spec.bucket_ms
-        gid_u = np.where(
-            gid >= 0, remap[np.clip(gid, 0, max(0, len(values) - 1))], -1)
-        np.putmask(gid_u, bucket_g >= spec.num_buckets, -1)
-        b_local = bucket_g - lo_d
-        in_grid = (gid_u >= 0) & (b_local >= 0) & (b_local < width)
-        cell = (gid_u * width + b_local)[in_grid]
-        vv = vals[in_grid]
-        count64 = np.bincount(cell, minlength=ncells)
-        count = count64.astype(np.float32).reshape(g, width)
-        grids = {"count": count[:, :w_eff]}
-        if "sum" in want:
-            grids["sum"] = np.bincount(
-                cell, weights=vv, minlength=ncells).astype(
-                    np.float32).reshape(g, width)[:, :w_eff]
-        if "min" in want:
-            # +/-inf identities for untouched cells — masked rows land in
-            # the device kernel's overflow segment, so empty cells read
-            # the segmented op's identity, not the F32_MAX row filler
-            mn = np.full(ncells, np.inf)
-            np.minimum.at(mn, cell, vv)
-            grids["min"] = mn.astype(np.float32).reshape(g, width)[:, :w_eff]
-        if "max" in want:
-            mx = np.full(ncells, -np.inf)
-            np.maximum.at(mx, cell, vv)
-            grids["max"] = mx.astype(np.float32).reshape(g, width)[:, :w_eff]
-        if "last" in want:
-            ts_local = (ts_g - lo_d * spec.bucket_ms)[in_grid]
-            lt = np.full(ncells, int(_ACC_TS_MIN), dtype=np.int64)
-            np.maximum.at(lt, cell, ts_local)
-            at_max = ts_local == lt[cell]
-            rows = np.flatnonzero(in_grid)[at_max]
-            li = np.full(ncells, -1, dtype=np.int64)
-            np.maximum.at(li, cell[at_max], rows)
-            last = np.zeros(ncells)
-            has = li >= 0
-            last[has] = vals[li[has]]
-            grids["last"] = last.astype(np.float32).reshape(
-                g, width)[:, :w_eff]
-            ltg = lt.reshape(g, width)[:, :w_eff]
-            grids["last_ts"] = np.where(count[:, :w_eff] > 0,
-                                        ltg + lo_d * spec.bucket_ms, ltg)
-        parts.append((seg_start, (round_values, lo_d, grids)))
+        epoch = sh + spec.range_start
+        key = ("host_partials", spec.ts_col, spec.value_col,
+               spec.group_col, filter_ops.canonical_predicate_key(
+                   plan.predicate), spec.bucket_ms, phase, want)
+        miss = object()
+        full = w.memo.get(key, miss)
+        if full is miss:
+            full = _host_window_full_grids(
+                w, values, np.asarray(gid_full), epoch, phase,
+                spec.bucket_ms, want, spec.ts_col, spec.value_col)
+            if full == "toobig":
+                # full-span grid too large to hold: compute clipped to
+                # the query's grid bounds, and don't memoize (the clip
+                # makes it range-dependent)
+                full = _host_window_full_grids(
+                    w, values, np.asarray(gid_full), epoch, phase,
+                    spec.bucket_ms, want, spec.ts_col, spec.value_col,
+                    clip=(spec.range_start, spec.range_start
+                          + spec.num_buckets * spec.bucket_ms))
+            else:
+                nbytes = 0 if full is None else sum(
+                    int(a.nbytes) for a in full[1].values())
+                _memo_store(w, key, full, nbytes)
+        if full is None:
+            continue
+        A0, grids_full = full
+        W = grids_full["count"].shape[1]
+        # trim the absolute-bucket grid to the query's range
+        lo_q = A0 - q0
+        cut = max(0, -lo_q)
+        lo = max(0, lo_q)
+        w_eff = min(W - cut, spec.num_buckets - lo)
+        if w_eff <= 0:
+            continue
+        sl = slice(cut, cut + w_eff)
+        grids = {k: v[:, sl] for k, v in grids_full.items()
+                 if k != "last_ts"}
+        if "last_ts" in grids_full:
+            lt = grids_full["last_ts"][:, sl]
+            # memo holds ABSOLUTE host ms; parts carry range-relative
+            grids["last_ts"] = np.where(grids["count"] > 0,
+                                        lt - spec.range_start,
+                                        int(_ACC_TS_MIN))
+        parts.append((seg_start, (values, lo, grids)))
     _STAGE_SECONDS["device_aggregate"].observe(time.perf_counter() - t_dev)
     return parts
 

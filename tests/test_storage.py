@@ -1174,6 +1174,20 @@ class TestPrunedRead:
         assert pruned.num_rows == ref.num_rows == 3000
         assert pruned.column("metric_id").to_pylist()[:3] == [42, 42, 42]
 
+    def test_all_columns_elided_with_residual_keeps_rows(self):
+        import pyarrow.compute as pc
+
+        data = self._file()
+        pruned, ref = self._both(
+            data, ["metric_id"],
+            [Eq("metric_id", 42),
+             TimeRangePred("timestamp", 30_000, 200_000)],
+            (pc.field("metric_id") == 42)
+            & (pc.field("timestamp") >= 30_000)
+            & (pc.field("timestamp") < 200_000))
+        assert pruned.num_rows == ref.num_rows > 0
+        assert pruned.schema.names == ["metric_id"]
+
     def test_nulls_in_predicate_column_fall_back(self):
         import pyarrow.parquet as pq
 
