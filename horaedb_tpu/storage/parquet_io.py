@@ -319,6 +319,23 @@ def _residual_mask(leaves: list, tbl: pa.Table):
     return mask
 
 
+def _stats_constant(md, col_i: int, groups: list):
+    """The single value column `col_i` provably holds across `groups`
+    (min==max everywhere, no nulls), or None."""
+    value = None
+    for g in groups:
+        st = md.row_group(g).column(col_i).statistics
+        if (st is None or not st.has_min_max
+                or not getattr(st, "has_null_count", False)
+                or st.null_count or st.min != st.max):
+            return None
+        if value is None:
+            value = st.min
+        elif value != st.min:
+            return None
+    return value
+
+
 def read_pruned(pf: pq.ParquetFile, columns: Optional[list[str]],
                 leaves: list) -> pa.Table:
     """Decode `columns` of the row groups that can match the conjunction
@@ -390,6 +407,18 @@ def read_pruned(pf: pq.ParquetFile, columns: Optional[list[str]],
 
     elide = {c: v for c, v in full_eq.items()
              if c in out_cols and _elidable(c)}
+    # beyond predicate-pinned columns, ANY projected column whose stats
+    # prove one constant value across every selected group skips decode
+    # (__seq__ is constant in every un-compacted SST; a single-metric
+    # table's ids too even without a predicate)
+    residual_cols = {l.column for _, res in selected for l in res}
+    for c in out_cols:
+        if c in elide or not _elidable(c) or c in residual_cols \
+                or c not in col_idx:
+            continue
+        const = _stats_constant(md, col_idx[c], [g for g, _ in selected])
+        if const is not None:
+            elide[c] = const
     decode_cols = [c for c in out_cols if c not in elide]
     # residual evaluation may need a column the projection dropped
     extra = sorted({l.column for _, res in selected for l in res}
