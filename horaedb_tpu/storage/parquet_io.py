@@ -267,6 +267,18 @@ def _leaf_vs_stats(leaf, stats) -> str:
     if stats is None or not stats.has_min_max:
         return "partial"
     lo, hi = stats.min, stats.max
+    if isinstance(lo, float):
+        # parquet min/max statistics IGNORE NaN (a [1.0, NaN] group
+        # reports min=max=1.0, null_count=0), and NaN fails every
+        # comparison — so a float group can never be proven 'full'.
+        # 'empty' survives: NaN rows can't match either, so a group
+        # with no possible non-NaN match stays empty.
+        verdict = _leaf_vs_minmax(leaf, lo, hi, F)
+        return "partial" if verdict == "full" else verdict
+    return _leaf_vs_minmax(leaf, lo, hi, F)
+
+
+def _leaf_vs_minmax(leaf, lo, hi, F) -> str:
     try:
         if isinstance(leaf, F.Eq):
             if leaf.value < lo or leaf.value > hi:
@@ -401,9 +413,12 @@ def read_pruned(pf: pq.ParquetFile, columns: Optional[list[str]],
     # decoded; rebuild them as constants afterwards (plain types only —
     # the reconstruction goes through np.full)
     def _elidable(c: str) -> bool:
+        # floats are NOT elidable: parquet stats ignore NaN, so
+        # min==max with null_count=0 does not prove a float column
+        # constant ([1.0, NaN, 1.0] reports min=max=1.0) and np.full
+        # reconstruction would silently drop the NaNs
         t = schema.field(c).type
-        return (pa.types.is_integer(t) or pa.types.is_floating(t)
-                or pa.types.is_string(t))
+        return pa.types.is_integer(t) or pa.types.is_string(t)
 
     elide = {c: v for c, v in full_eq.items()
              if c in out_cols and _elidable(c)}

@@ -1476,6 +1476,16 @@ class ParquetReader:
     def finalize_aggregate(parts: list, spec: AggregateSpec):
         group_values, grids = combine_aggregate_parts(parts, spec.num_buckets,
                                                       which=spec.which)
+        # drop groups with no row in ANY bucket: the aligned fast path
+        # omits the ts leaf (query_downsample), so boundary-segment rows
+        # outside [start, end) can register a group whose every cell is
+        # empty — without this the aligned and ts-leaf paths return
+        # different tsid sets for the same data
+        if len(group_values):
+            nonzero = grids["count"].sum(axis=1) > 0
+            if not nonzero.all():
+                group_values = group_values[nonzero]
+                grids = {k: v[nonzero] for k, v in grids.items()}
         # last_ts is computed relative to range_start on device; expose it
         # as ABSOLUTE time so all downsample paths share one unit
         if len(group_values) and "last_ts" in grids:
@@ -1633,6 +1643,22 @@ class ParquetReader:
         import jax
 
         return jax.default_backend() != "cpu"
+
+    def _host_agg_ok(self) -> bool:
+        """Whether window rounds aggregate with the numpy twin instead of
+        the vmap device kernel (_batched_window_partials_jit).  Default:
+        host on the CPU backend (numpy bincount beats XLA-CPU's
+        segmented scatters ~20x), device elsewhere.  HORAEDB_HOST_AGG=1/0
+        forces, mirroring HORAEDB_DEVCOL_STACK, so CPU CI keeps coverage
+        of the device parts kernel."""
+        if self.mesh is not None:
+            return False
+        import os
+
+        forced = os.environ.get("HORAEDB_HOST_AGG", "")
+        if forced in ("0", "1"):
+            return forced == "1"
+        return jax.default_backend() == "cpu"
 
     def _window_device_cols(self, w: encode.DeviceBatch,
                             spec: AggregateSpec, plan: ScanPlan,
@@ -1812,7 +1838,7 @@ class ParquetReader:
         (rows a window didn't touch have count 0 and fold away in the
         combiner).  Rounds are padded to the full batch width with empty
         windows so one program shape serves every flush."""
-        if self.mesh is None and jax.default_backend() == "cpu" and all(
+        if self._host_agg_ok() and all(
                 isinstance(it[1].columns[spec.ts_col], np.ndarray)
                 for it in items):
             # XLA-CPU's segmented scatters run ~20x slower than numpy's

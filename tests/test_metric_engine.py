@@ -212,6 +212,44 @@ class TestWriteQuery:
 
         asyncio.run(go())
 
+    def test_aligned_fast_path_tsid_set_matches_ts_leaf_path(self):
+        """The bucket-aligned fast path omits the ts leaf, so boundary
+        -segment rows outside [start, end) decode too; a series whose
+        rows ALL lie outside the range must not surface as an all-zero
+        -count group (finalize drops empty groups)."""
+        async def go():
+            e = await open_engine()
+            try:
+                seg0 = T0 - T0 % (2 * HOUR)
+                samples = []
+                # series A: rows across [seg0, seg0+4h)
+                for i in range(48):
+                    samples.append(sample("cpu", [("host", "in-range")],
+                                          seg0 + i * 5 * 60_000, float(i)))
+                # series B: rows ONLY in [seg0, seg0+30min) — inside the
+                # query's boundary segment, outside the query range
+                for i in range(6):
+                    samples.append(sample("cpu", [("host", "out-of-range")],
+                                          seg0 + i * 5 * 60_000 + 1,
+                                          99.0))
+                await e.write(samples)
+                rng_q = TimeRange.new(seg0 + 2 * HOUR, seg0 + 4 * HOUR)
+                # span == 2h == segment_ms, bucket divides span -> aligned
+                aligned = await e.query_downsample(
+                    "cpu", [], rng_q, bucket_ms=HOUR)
+                # 7-minute bucket does not divide the span -> ts-leaf path
+                leafed = await e.query_downsample(
+                    "cpu", [], rng_q, bucket_ms=7 * 60_000)
+                b = tsid_of("cpu", [Label("host", "out-of-range")])
+                assert b not in aligned["tsids"]
+                assert sorted(aligned["tsids"]) == sorted(leafed["tsids"])
+                counts = np.asarray(aligned["aggs"]["count"])
+                assert (counts.sum(axis=1) > 0).all()
+            finally:
+                await e.close()
+
+        asyncio.run(go())
+
     def test_persistence_across_reopen(self):
         async def go():
             store = MemoryObjectStore()

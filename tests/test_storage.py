@@ -1205,6 +1205,60 @@ class TestPrunedRead:
         finally:
             pf.close()
 
+    def _nan_file(self):
+        """Constant-valued float column with interspersed NaNs: parquet
+        min/max statistics IGNORE NaN ([1.0, NaN, 1.0] reports
+        min=max=1.0, null_count=0), so neither constant-elision nor a
+        'full'-verdict proof may trust float stats."""
+        import io
+
+        import pyarrow.parquet as pq
+
+        n = 2000
+        mid = np.full(n, 42, dtype=np.uint64)
+        ts = np.arange(n, dtype=np.int64) * 1000
+        val = np.ones(n)
+        val[::37] = np.nan
+        tbl = pa.table({"metric_id": pa.array(mid),
+                        "timestamp": pa.array(ts, type=pa.int64()),
+                        "value": pa.array(val, type=pa.float64())})
+        sink = io.BytesIO()
+        pq.write_table(tbl, sink, row_group_size=256,
+                       compression="snappy", write_statistics=True)
+        return sink.getvalue()
+
+    def test_nan_float_column_never_elided(self):
+        import pyarrow.compute as pc
+
+        data = self._nan_file()
+        pruned, ref = self._both(
+            data, ["timestamp", "value"],
+            [Eq("metric_id", 42), TimeRangePred("timestamp", 0, 500_000)],
+            (pc.field("metric_id") == 42)
+            & (pc.field("timestamp") >= 0)
+            & (pc.field("timestamp") < 500_000))
+        assert pruned.num_rows == ref.num_rows
+        # assert_array_equal treats NaN == NaN; Table.equals does not
+        got = pruned.sort_by("timestamp").column("value").to_numpy()
+        want = ref.sort_by("timestamp").column("value").to_numpy()
+        assert np.isnan(got).sum() == np.isnan(want).sum() > 0
+        np.testing.assert_array_equal(got, want)
+
+    def test_float_full_verdict_keeps_nan_filter(self):
+        # stats say min=max=1.0 so 'Gt 0.5' looks 'full', but the NaN
+        # rows fail the comparison — they must be filtered out exactly
+        # like the expression path does
+        import pyarrow.compute as pc
+
+        from horaedb_tpu.ops.filter import Gt
+
+        data = self._nan_file()
+        pruned, ref = self._both(
+            data, ["timestamp", "value"], [Gt("value", 0.5)],
+            pc.field("value") > 0.5)
+        assert pruned.num_rows == ref.num_rows > 0
+        assert not np.isnan(pruned.column("value").to_numpy()).any()
+
     def test_conjunct_leaves_shapes(self):
         from horaedb_tpu.ops.filter import And, Ne, Or
         from horaedb_tpu.storage.parquet_io import conjunct_leaves
