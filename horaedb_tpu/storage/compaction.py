@@ -30,7 +30,7 @@ import pyarrow as pa
 
 from horaedb_tpu.common.error import Error, ensure
 from horaedb_tpu.common.time_ext import now_ms
-from horaedb_tpu.storage import parquet_io
+from horaedb_tpu.storage import parquet_io, sidecar
 from horaedb_tpu.storage.manifest import ManifestUpdate
 from horaedb_tpu.storage.read import ScanRequest
 from horaedb_tpu.storage.sst import FileMeta, SstFile, sst_path, segment_of
@@ -210,6 +210,13 @@ class Executor:
         for fid, res in zip(file_ids, results):
             if isinstance(res, BaseException):
                 logger.error("failed to delete sst %s: %s", fid, res)
+        # sidecars ride along, fully silent: most SSTs predating the
+        # sidecar (or Append tables) simply have none
+        await asyncio.gather(
+            *(self.storage.store.delete(
+                sidecar.sidecar_path(self.storage.root_path, fid))
+              for fid in file_ids),
+            return_exceptions=True)
 
     async def gc_expired(self, task: Task) -> None:
         """TTL garbage collection: drop expired SSTs from the manifest,
@@ -258,14 +265,70 @@ class Executor:
         # stream batches through the parquet encoder INTO the store —
         # peak memory is ~one row group (+ one multipart part on S3),
         # not the compressed output: a 1 GiB rewrite costs megabytes of
-        # RSS (ref: storage.rs:192-212 AsyncArrowWriter pipeline)
+        # RSS (ref: storage.rs:192-212 AsyncArrowWriter pipeline).
+        # Device-layout sidecar parts are collected alongside (encoded
+        # i32/f32, ~12B/row) up to write.sidecar_max_rows — past that
+        # the cap voids the sidecar to keep the rewrite's RSS bounded.
+        from horaedb_tpu.storage.config import UpdateMode
+
+        sc_parts: Optional[list] = (
+            [] if (storage.schema().update_mode is UpdateMode.OVERWRITE
+                   and storage.config.write.enable_sidecar) else None)
+        sc_rows = 0
+
         async def restored():
-            async for batch in storage.reader.execute(plan):
-                yield _restore_reserved_column(batch, storage.schema())
+            # one sidecar encode stays in flight while the SAME batch's
+            # parquet encode runs (the pool has >1 compact thread), so
+            # the sidecar costs overlap the rewrite instead of adding to
+            # it; RSS holds at most one extra batch's encoded columns
+            nonlocal sc_parts, sc_rows
+            in_flight: Optional[asyncio.Task] = None
+
+            async def settle():
+                nonlocal sc_parts, in_flight
+                if in_flight is None:
+                    return
+                task, in_flight = in_flight, None
+                part = await task
+                if sc_parts is not None:
+                    if part is None:
+                        sc_parts = None
+                    else:
+                        sc_parts.append(part)
+
+            try:
+                async for batch in storage.reader.execute(plan):
+                    await settle()
+                    if sc_parts is not None:
+                        sc_rows += batch.num_rows
+                        if sc_rows > storage.config.write.sidecar_max_rows:
+                            sc_parts = None
+                        else:
+                            in_flight = asyncio.ensure_future(
+                                storage.runtimes.run(
+                                    "compact", sidecar.encode_columns,
+                                    batch))
+                    yield _restore_reserved_column(batch, storage.schema())
+                await settle()
+            finally:
+                if in_flight is not None:
+                    in_flight.cancel()
 
         size, num_rows = await parquet_io.write_sst_streaming(
             storage.store, path, restored(), storage.config.write,
             storage.schema(), runtimes=storage.runtimes, pool="compact")
+        if sc_parts:
+            try:
+                data = await storage.runtimes.run(
+                    "compact", sidecar.build_multi, sc_parts)
+                if data is not None:
+                    await storage.store.put(
+                        sidecar.sidecar_path(storage.root_path, file_id),
+                        data)
+            except Exception as exc:  # noqa: BLE001 — cache write only
+                logger.warning("sidecar write failed for compacted sst "
+                               "%s: %s", file_id, exc)
+        sc_parts = None
         meta = FileMeta(max_sequence=file_id, num_rows=num_rows, size=size,
                         time_range=time_range)
         logger.debug("compaction output sst id=%s rows=%s size=%s",

@@ -77,6 +77,17 @@ class WriteConfig:
     encoding: Optional[str] = None
     compression: CompressionCodec = CompressionCodec.SNAPPY
     column_options: dict[str, ColumnOptions] = field(default_factory=dict)
+    # persist a device-layout sidecar ({id}.enc) next to each OVERWRITE
+    # -mode SST so cold scans skip parquet decode + re-encode entirely
+    # (no reference analogue; see storage/sidecar.py)
+    enable_sidecar: bool = True
+    # compaction outputs above this row count skip the sidecar.  NOTE:
+    # unlike the parquet rewrite (streamed, ~MBs of RSS), the sidecar's
+    # encoded columns accumulate in RAM until the rewrite finishes —
+    # ~12 bytes/row, so the default caps that at ~768 MiB.  Lower it on
+    # memory-constrained nodes; large compactions past the cap simply
+    # fall back to parquet-only cold reads.
+    sidecar_max_rows: int = 64 << 20
 
 
 @dataclass
@@ -139,6 +150,10 @@ class ScanConfig:
     # and the segment spans more than one window; 0 disables the byte
     # trigger.
     stream_read_min_bytes: int = 512 << 20
+    # read device-layout sidecars ({id}.enc) on OVERWRITE-mode bulk
+    # segment reads when present (see storage/sidecar.py); disable to
+    # force the parquet decode path
+    use_sidecar: bool = True
 
 
 @dataclass

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import asyncio
 import functools
+import logging
 import threading
 import time
 import weakref
@@ -51,8 +52,10 @@ from horaedb_tpu.storage.types import (
     StorageSchema,
     TimeRange,
 )
-from horaedb_tpu.storage import parquet_io
+from horaedb_tpu.storage import parquet_io, sidecar
 from horaedb_tpu.utils import registry
+
+logger = logging.getLogger(__name__)
 
 _SCAN_LATENCY = registry.histogram(
     "storage_scan_seconds", "merge-scan latency per segment")
@@ -63,8 +66,8 @@ _ROWS_SCANNED = registry.counter(
 # through its reader, read.rs:84; ours records real numbers): seconds,
 # rows, and bytes per pipeline stage, cumulative in the registry and
 # diffable around a query for a per-query profile (bench.py does this).
-_PLAN_STAGES = ("parquet_read", "encode_merge", "stack_build",
-                "device_aggregate", "combine")
+_PLAN_STAGES = ("parquet_read", "sidecar_read", "encode_merge",
+                "stack_build", "device_aggregate", "combine")
 _STAGE_SECONDS = {
     s: registry.histogram(f"scan_stage_{s}_seconds",
                           f"wall seconds spent in the {s} stage")
@@ -73,12 +76,12 @@ _STAGE_SECONDS = {
 _STAGE_ROWS = {
     s: registry.counter(f"scan_stage_{s}_rows_total",
                         f"rows entering the {s} stage")
-    for s in ("parquet_read", "encode_merge")
+    for s in ("parquet_read", "sidecar_read", "encode_merge")
 }
 _STAGE_BYTES = {
     s: registry.counter(f"scan_stage_{s}_bytes_total",
                         f"bytes entering the {s} stage")
-    for s in ("parquet_read", "stack_build")
+    for s in ("parquet_read", "sidecar_read", "stack_build")
 }
 # cache-effectiveness counters (ops parity with scan_cache_*): the
 # replay and stack LRUs are the reason repeat/varied queries are fast —
@@ -307,6 +310,10 @@ class ParquetReader:
         # case there is 2x the configured budget; see ScanConfig.)
         self._stack_cache_max = cache_bytes
         self._stack_cache_lock = threading.Lock()
+        # SST ids known to lack a sidecar (pre-feature files, failed
+        # best-effort writes): permanent per id, so a memo'd miss saves
+        # the whole segment's sidecar GETs on every later cold scan
+        self._sidecar_missing: set = set()
         self.mesh = None
         self._mesh_agg_fns: dict = {}
         self._mesh_merge_fns: dict = {}
@@ -506,6 +513,8 @@ class ParquetReader:
             dispatched: list = []
             if table.num_rows:
                 def encode_and_dispatch(tbl=table):
+                    if isinstance(tbl, sidecar.EncodedSegment):
+                        return self._dispatch_encoded_windows(tbl)
                     batch = tbl.combine_chunks().to_batches()[0]
                     return self._dispatch_merged_windows(batch)
 
@@ -642,6 +651,9 @@ class ParquetReader:
                         descs = []
                         if table.num_rows:
                             def encode_windows(tbl=table):
+                                if isinstance(tbl, sidecar.EncodedSegment):
+                                    return self._prepare_encoded_windows(
+                                        tbl, scan_host_perm)
                                 batch = tbl.combine_chunks().to_batches()[0]
                                 return self._prepare_merge_windows(
                                     batch, scan_host_perm)
@@ -726,13 +738,19 @@ class ParquetReader:
         async def read(seg: SegmentPlan):
             await sem.acquire()
             t0 = time.perf_counter()
-            table = await self._read_segment_table(seg, plan.pushdown,
-                                                   pool=plan.pool,
-                                                   leaves=plan.prune_leaves)
+            table = None
+            stage = "sidecar_read"
+            if self._sidecar_plan_ok(plan):
+                table = await self._read_segment_encoded(seg, plan)
+            if table is None:
+                stage = "parquet_read"
+                table = await self._read_segment_table(
+                    seg, plan.pushdown, pool=plan.pool,
+                    leaves=plan.prune_leaves)
             read_s = time.perf_counter() - t0
-            _STAGE_SECONDS["parquet_read"].observe(read_s)
-            _STAGE_ROWS["parquet_read"].inc(table.num_rows)
-            _STAGE_BYTES["parquet_read"].inc(table.nbytes)
+            _STAGE_SECONDS[stage].observe(read_s)
+            _STAGE_ROWS[stage].inc(table.num_rows)
+            _STAGE_BYTES[stage].inc(table.nbytes)
             return table, read_s
 
         tasks = [asyncio.create_task(read(seg)) for seg in segments]
@@ -746,6 +764,70 @@ class ParquetReader:
         finally:
             for task in tasks:
                 task.cancel()
+
+    def _sidecar_plan_ok(self, plan: ScanPlan) -> bool:
+        """Whether this plan may serve bulk segments from device-layout
+        sidecars: OVERWRITE merge only (Append's BytesMerge needs exact
+        Arrow bytes), and the pushdown — when present — must have a leaf
+        -conjunction form the sidecar path can evaluate host-side."""
+        if not self.config.scan.use_sidecar:
+            return False
+        if plan.mode is not UpdateMode.OVERWRITE:
+            return False
+        return plan.pushdown is None or plan.prune_leaves is not None
+
+    async def _read_segment_encoded(self, seg: SegmentPlan, plan: ScanPlan
+                                    ) -> Optional[sidecar.EncodedSegment]:
+        """Segment read that never touches parquet: fetch each SST's
+        sidecar and assemble filtered, concatenated encoded columns.
+        None (→ parquet fallback) when any SST lacks a valid sidecar."""
+        if any(f.id in self._sidecar_missing for f in seg.ssts):
+            return None  # known-missing sidecar: skip the GETs entirely
+        got = await asyncio.gather(*(
+            self.store.get(sidecar.sidecar_path(self.root_path, f.id))
+            for f in seg.ssts), return_exceptions=True)
+        bufs = []
+        for f, res in zip(seg.ssts, got):
+            if isinstance(res, NotFoundError):
+                # permanent for this id (SSTs/ids are immutable and the
+                # sidecar is written before the SST becomes visible):
+                # memo the miss so later cold scans of this segment
+                # don't re-fetch the siblings' blobs just to fall back
+                self._memo_sidecar_missing((f.id,))
+                return None
+            if isinstance(res, BaseException):
+                # transient store failure: the sidecar is a cache — fall
+                # back to the authoritative parquet, never fail the scan
+                logger.warning("sidecar fetch failed for sst %s: %s",
+                               f.id, res)
+                return None
+            bufs.append(res)
+        try:
+            es = await self._run_pool(
+                plan.pool, sidecar.assemble_segment, bufs,
+                list(seg.columns), plan.prune_leaves)
+        except Exception as exc:  # noqa: BLE001 — cache read only
+            # a blob that parses but is internally inconsistent can blow
+            # up deep in eval/concat; the contract is fallback, not
+            # failure
+            logger.warning("sidecar assembly raised for segment %s: %s",
+                           seg.segment_start, exc)
+            es = None
+        if es is None:
+            # a downloaded blob failed to parse/concat — as permanent as
+            # a missing one (objects are immutable), so memo the whole
+            # SST set and stop re-downloading it every cold scan
+            self._memo_sidecar_missing(f.id for f in seg.ssts)
+            logger.warning("invalid sidecar(s) for segment %s; using "
+                           "parquet", seg.segment_start)
+        return es
+
+    def _memo_sidecar_missing(self, ids) -> None:
+        """Record permanently-sidecar-less SST ids, bounded (clear-all on
+        overflow: re-learning misses is cheap, unbounded growth is not)."""
+        if len(self._sidecar_missing) > 65536:
+            self._sidecar_missing.clear()
+        self._sidecar_missing.update(ids)
 
     async def _read_segment_table(self, seg: SegmentPlan,
                                   pushdown=None,
@@ -953,7 +1035,20 @@ class ParquetReader:
         windows to the sort-free kernel."""
         _STAGE_ROWS["encode_merge"].inc(batch.num_rows)
         dev = encode.encode_batch(batch)
-        pk_names = self._pk_names_in(batch.schema.names)
+        return self._prepare_windows_dev(dev, list(batch.schema.names),
+                                         host_perm)
+
+    @_timed_stage("encode_merge")
+    def _prepare_encoded_windows(self, es: "sidecar.EncodedSegment",
+                                 host_perm: Optional[bool] = None) -> list:
+        """Sidecar twin of _prepare_merge_windows (mesh window prep)."""
+        _STAGE_ROWS["encode_merge"].inc(es.n)
+        return self._prepare_windows_dev(self._encoded_to_device_batch(es),
+                                         list(es.names), host_perm)
+
+    def _prepare_windows_dev(self, dev: encode.DeviceBatch, names: list,
+                             host_perm: Optional[bool] = None) -> list:
+        pk_names = self._pk_names_in(names)
         ensure(len(pk_names) == self.schema.num_primary_keys,
                "projection lost primary key columns")
         n = dev.n_valid
@@ -1019,10 +1114,38 @@ class ParquetReader:
         """
         _STAGE_ROWS["encode_merge"].inc(batch.num_rows)
         dev = encode.encode_batch(batch)  # host-resident numpy columns
-        pk_names = self._pk_names_in(batch.schema.names)
+        return self._dispatch_windows_dev(dev, list(batch.schema.names))
+
+    @staticmethod
+    def _encoded_to_device_batch(es: "sidecar.EncodedSegment"
+                                 ) -> encode.DeviceBatch:
+        """Pad sidecar columns (read-only views) to a static-shape
+        capacity — the only prep the already-device-layout data needs."""
+        cap = encode.pad_capacity(es.n)
+        columns = {}
+        for name, arr in es.columns.items():
+            padded = np.zeros(cap, dtype=arr.dtype)
+            padded[:es.n] = arr
+            columns[name] = padded
+        return encode.DeviceBatch(columns=columns, encodings=es.encodings,
+                                  n_valid=es.n, capacity=cap)
+
+    @_timed_stage("encode_merge")
+    def _dispatch_encoded_windows(self, es: "sidecar.EncodedSegment"
+                                  ) -> list:
+        """Sidecar twin of _dispatch_merged_windows."""
+        _STAGE_ROWS["encode_merge"].inc(es.n)
+        return self._dispatch_windows_dev(self._encoded_to_device_batch(es),
+                                          list(es.names))
+
+    def _dispatch_windows_dev(self, dev: encode.DeviceBatch,
+                              names: list) -> list:
+        """Post-encode half of the segment merge, shared by the Arrow
+        and sidecar reads (see _dispatch_merged_windows for the plan)."""
+        pk_names = self._pk_names_in(names)
         ensure(len(pk_names) == self.schema.num_primary_keys,
                "projection lost primary key columns")
-        value_names = [n for n in batch.schema.names
+        value_names = [n for n in names
                        if n not in pk_names and n != SEQ_COLUMN_NAME]
         n = dev.n_valid
         host_cols = {name: np.asarray(c)[:n] for name, c in dev.columns.items()}
@@ -1219,8 +1342,9 @@ class ParquetReader:
                     if fresh:
                         _REPLAY_ROWS.inc(sum(r for _, r in fresh))
                         counted.update(s for s, _ in fresh)
-                    return entry["values"], self._fused_last_ts_to_abs(
-                        grids, spec)
+                    values, grids = self._drop_empty_groups_dev(
+                        entry["values"], grids)
+                    return values, self._fused_last_ts_to_abs(grids, spec)
                 self._replay_cache.pop(replay_key, None)
             self._replay_misses += 1
             _REPLAY_MISSES.inc()
@@ -1316,6 +1440,7 @@ class ParquetReader:
             self._replay_cache.move_to_end(replay_key)
             while len(self._replay_cache) > _REPLAY_SLOTS:
                 self._replay_cache.popitem(last=False)
+        all_values, grids = self._drop_empty_groups_dev(all_values, grids)
         return all_values, self._fused_last_ts_to_abs(grids, spec)
 
     def _replay_key(self, plan: ScanPlan, spec: AggregateSpec) -> tuple:
@@ -1392,6 +1517,23 @@ class ParquetReader:
         jax.block_until_ready(out)
         t_dev += time.perf_counter() - t0
         return out, t_dev
+
+    @staticmethod
+    def _drop_empty_groups_dev(values: np.ndarray, grids: dict):
+        """Fused-path twin of finalize_aggregate's empty-group drop (the
+        aligned fast path can register groups whose rows all fall outside
+        the range — see that docstring).  Device-friendly: only a G-byte
+        any-mask crosses to host; the grids move only in the rare case a
+        leak actually exists, so cached/replay queries stay at zero grid
+        downloads."""
+        if not len(values):
+            return values, grids
+        has = np.asarray(_group_has_data_jit(grids["count"]))
+        if has.all():
+            return values, grids
+        idx = np.flatnonzero(has)
+        return values[idx], {k: jnp.take(v, idx, axis=0)
+                             for k, v in grids.items()}
 
     @staticmethod
     def _fused_last_ts_to_abs(grids: dict, spec: AggregateSpec) -> dict:
@@ -2181,6 +2323,13 @@ def _fused_finalize_jit(acc: dict, which: tuple) -> dict:
         out["last"] = jnp.where(empty, nan, acc["last"])
         out["last_ts"] = acc["last_ts"]
     return out
+
+
+@jax.jit
+def _group_has_data_jit(count):
+    """Per-group any-data mask — G bools, the only bytes the aligned
+    fast path's empty-group check ever downloads."""
+    return (count > 0).any(axis=1)
 
 
 @functools.partial(jax.jit, static_argnames=("num_groups", "num_buckets",

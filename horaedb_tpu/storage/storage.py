@@ -16,6 +16,7 @@ reference (storage.rs:125-135):
 from __future__ import annotations
 
 import abc
+import asyncio
 import time
 from dataclasses import dataclass
 from typing import AsyncIterator, Optional
@@ -27,8 +28,8 @@ import logging
 
 from horaedb_tpu.common.error import ensure
 from horaedb_tpu.objstore import NotFoundError, ObjectStore
-from horaedb_tpu.storage import parquet_io
-from horaedb_tpu.storage.config import StorageConfig
+from horaedb_tpu.storage import parquet_io, sidecar
+from horaedb_tpu.storage.config import StorageConfig, UpdateMode
 from horaedb_tpu.storage.manifest import Manifest
 from horaedb_tpu.storage.read import ParquetReader, ScanPlan, ScanRequest
 from horaedb_tpu.storage.sst import FileMeta, SstFile, sst_path
@@ -184,15 +185,39 @@ class CloudObjectStorage(TimeMergeStorage):
 
         stamped = await self.runtimes.run("sst", prep)
         path = sst_path(self.root_path, file_id)
-        size = await parquet_io.write_sst(self.store, path, [stamped],
-                                          self.config.write, self._schema,
-                                          runtimes=self.runtimes)
+        # the sidecar put overlaps the SST put and completes BEFORE the
+        # manifest add: readers never see a manifest-listed SST whose
+        # sidecar is still in flight, so a sidecar miss is permanent
+        # per id (the reader memoizes misses on that contract)
+        size, _ = await asyncio.gather(
+            parquet_io.write_sst(self.store, path, [stamped],
+                                 self.config.write, self._schema,
+                                 runtimes=self.runtimes),
+            self._write_sidecar(file_id, stamped))
         meta = FileMeta(max_sequence=file_id, num_rows=req.batch.num_rows,
                         size=size, time_range=req.time_range)
         await self.manifest.add_file(file_id, meta)
         _WRITE_LATENCY.observe(time.perf_counter() - t0)
         _ROWS_WRITTEN.inc(req.batch.num_rows)
         return WriteResult(id=file_id, seq=file_id, size=size)
+
+    async def _write_sidecar(self, file_id: int,
+                             stamped: pa.RecordBatch) -> None:
+        """Best-effort device-layout sidecar next to the SST (see
+        storage/sidecar.py): pure cache — any failure is logged and
+        swallowed, reads fall back to parquet."""
+        if (self._schema.update_mode is not UpdateMode.OVERWRITE
+                or not self.config.write.enable_sidecar
+                or stamped.num_rows > self.config.write.sidecar_max_rows):
+            return
+        try:
+            data = await self.runtimes.run("sst", sidecar.build, stamped)
+            if data is not None:
+                await self.store.put(
+                    sidecar.sidecar_path(self.root_path, file_id), data)
+        except Exception as exc:  # noqa: BLE001 — cache write only
+            logger.warning("sidecar write failed for sst %s: %s",
+                           file_id, exc)
 
     # Scans race with compaction: the manifest can reference an SST that
     # compaction deletes before the scan's parquet read runs.  The data

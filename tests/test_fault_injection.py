@@ -90,7 +90,10 @@ class TestWriteFaults:
             try:
                 await s.write(WriteRequest(batch([("a", 1, 1.0)]),
                                            TimeRange.new(1, 2)))
-                store.fail_next("put", "/data/")
+                # target the SST object specifically — the sidecar put
+                # runs concurrently under the same /data/ prefix and its
+                # failures are (deliberately) swallowed
+                store.fail_next("put", ".sst")
                 with pytest.raises(OSError):
                     await s.write(WriteRequest(batch([("b", 2, 2.0)]),
                                                TimeRange.new(2, 3)))
@@ -101,6 +104,24 @@ class TestWriteFaults:
                 await s.write(WriteRequest(batch([("c", 3, 3.0)]),
                                            TimeRange.new(3, 4)))
                 assert len(await scan_rows(s)) == 2
+            finally:
+                await s.close()
+
+        asyncio.run(go())
+
+    def test_failed_sidecar_put_is_swallowed(self):
+        """The sidecar is a cache: its put failing must not fail the
+        write, and the SST stays fully readable without it."""
+        async def go():
+            store = FlakyStore()
+            s = await open_storage(store)
+            try:
+                store.fail_next("put", ".enc")
+                await s.write(WriteRequest(batch([("a", 1, 1.0)]),
+                                           TimeRange.new(1, 2)))  # no raise
+                assert await scan_rows(s) == [("a", 1, 1.0)]
+                objs = [m.path for m in await store.list("db/data/")]
+                assert len(objs) == 1 and objs[0].endswith(".sst")
             finally:
                 await s.close()
 
@@ -189,7 +210,8 @@ class TestCompactionFaults:
                 assert await scan_rows(s) == [("k", 1, 2.0)]
                 # the leaked object exists but is not referenced
                 objs = await store.list("db/data/")
-                assert len(objs) == 2  # 1 live + 1 leaked
+                ssts = [m for m in objs if m.path.endswith(".sst")]
+                assert len(ssts) == 2  # 1 live + 1 leaked
             finally:
                 await s.close()
 

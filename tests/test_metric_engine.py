@@ -212,11 +212,18 @@ class TestWriteQuery:
 
         asyncio.run(go())
 
-    def test_aligned_fast_path_tsid_set_matches_ts_leaf_path(self):
+    @pytest.mark.parametrize("fused", ["0", "1"])
+    def test_aligned_fast_path_tsid_set_matches_ts_leaf_path(
+            self, monkeypatch, fused):
         """The bucket-aligned fast path omits the ts leaf, so boundary
         -segment rows outside [start, end) decode too; a series whose
         rows ALL lie outside the range must not surface as an all-zero
-        -count group (finalize drops empty groups)."""
+        -count group.  The query range must STRADDLE a segment boundary
+        (start mid-segment) or the out-of-range SST is never planned and
+        the leak can't occur; both the parts (fused=0) and fused device
+        paths must drop the empty group."""
+        monkeypatch.setenv("HORAEDB_FUSED_AGG", fused)
+
         async def go():
             e = await open_engine()
             try:
@@ -233,18 +240,24 @@ class TestWriteQuery:
                                           seg0 + i * 5 * 60_000 + 1,
                                           99.0))
                 await e.write(samples)
-                rng_q = TimeRange.new(seg0 + 2 * HOUR, seg0 + 4 * HOUR)
+                # starts MID-segment: the boundary segment decodes whole
+                # (B's rows included), the grid cut must drop B entirely
+                rng_q = TimeRange.new(seg0 + HOUR, seg0 + 3 * HOUR)
                 # span == 2h == segment_ms, bucket divides span -> aligned
                 aligned = await e.query_downsample(
+                    "cpu", [], rng_q, bucket_ms=HOUR)
+                # repeat: the fused replay path must drop it too
+                replay = await e.query_downsample(
                     "cpu", [], rng_q, bucket_ms=HOUR)
                 # 7-minute bucket does not divide the span -> ts-leaf path
                 leafed = await e.query_downsample(
                     "cpu", [], rng_q, bucket_ms=7 * 60_000)
                 b = tsid_of("cpu", [Label("host", "out-of-range")])
-                assert b not in aligned["tsids"]
-                assert sorted(aligned["tsids"]) == sorted(leafed["tsids"])
-                counts = np.asarray(aligned["aggs"]["count"])
-                assert (counts.sum(axis=1) > 0).all()
+                for out in (aligned, replay):
+                    assert b not in out["tsids"]
+                    assert sorted(out["tsids"]) == sorted(leafed["tsids"])
+                    counts = np.asarray(out["aggs"]["count"])
+                    assert (counts.sum(axis=1) > 0).all()
             finally:
                 await e.close()
 

@@ -392,9 +392,11 @@ class TestCompactionEndToEnd:
                 new = ssts[0]
                 assert new.meta.num_rows == 3
                 assert new.meta.time_range == TimeRange.new(1000, 3001)
-                # old objects gone, new object present
-                objs = [m.path for m in await store.list("db/data/")]
-                assert objs == [f"db/data/{new.id}.sst"]
+                # old objects gone, new object (+ its device-layout
+                # sidecar) present
+                objs = sorted(m.path for m in await store.list("db/data/"))
+                assert objs == [f"db/data/{new.id}.enc",
+                                f"db/data/{new.id}.sst"]
                 # data still correct post-compaction (dedup survived)
                 got = rows_of(await collect(s.scan(
                     ScanRequest(range=TimeRange.new(0, 10_000)))))
@@ -1032,7 +1034,9 @@ class TestTtlGc:
                 ssts = await s.manifest.all_ssts()
                 assert len(ssts) == 1  # expired file gone from manifest
                 objs = [m.path for m in await store.list("db/data/")]
-                assert len(objs) == 1  # and from the object store
+                # expired sst AND its sidecar gone; survivor keeps both
+                assert sorted(objs) == [f"db/data/{ssts[0].id}.enc",
+                                        f"db/data/{ssts[0].id}.sst"]
                 got = rows_of(await collect(s.scan(
                     ScanRequest(range=TimeRange.new(0, now + SEGMENT_MS)))))
                 assert got == [("new", now, 2.0)]
