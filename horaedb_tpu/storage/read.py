@@ -1124,7 +1124,7 @@ class ParquetReader:
         cap = encode.pad_capacity(es.n)
         columns = {}
         for name, arr in es.columns.items():
-            padded = np.zeros(cap, dtype=arr.dtype)
+            padded = np.zeros(cap, dtype=arr.dtype)  # calloc: tail free
             padded[:es.n] = arr
             columns[name] = padded
         return encode.DeviceBatch(columns=columns, encodings=es.encodings,
@@ -1671,15 +1671,43 @@ class ParquetReader:
         if k == 0:
             return None
         keep = np.arange(cap) < k
+        mask_all = True
         if plan.predicate is not None:
-            mask = filter_ops.eval_predicate(plan.predicate, out_batch)
-            keep &= np.asarray(mask)
+            mask = np.asarray(
+                filter_ops.eval_predicate(plan.predicate, out_batch))
+            mask_all = bool(mask[:k].all())
+            keep &= mask
+            # fully-filtered window: empty result, NOT an encoding error
+            # (the ensure below must only fire for windows with rows)
+            if not mask_all and not keep.any():
+                return None
 
+        ts_enc = out_batch.encodings[spec.ts_col]
+        ensure(ts_enc.kind in ("offset", "numeric"),
+               f"aggregate needs arithmetic timestamps, got "
+               f"{ts_enc.kind!r} encoding for {spec.ts_col!r}")
         # dense group ids: one int32 column roundtrips to host (cheap),
         # values/timestamps stay on device; the dense-id array itself is
         # memoized DEVICE-resident so repeat queries over cached windows
         # upload nothing
         codes = np.asarray(out_batch.columns[spec.group_col])
+        enc_g = out_batch.encodings[spec.group_col]
+        if (mask_all and enc_g.kind == "dict" and len(enc_g.dictionary)
+                and int(codes[:k].min()) == 0
+                and int(codes[:k].max()) == len(enc_g.dictionary) - 1):
+            # dict-encoded group column whose window uses the WHOLE
+            # dictionary (single-window segments — sidecar loads and
+            # encode_batch both produce dense sorted-rank codes): the
+            # codes already ARE the dense ids and the dictionary the
+            # sorted group values — skip the per-window np.unique, the
+            # cold scan's hottest host op.  Windows spanning a code
+            # subrange (pk-windowed big segments) fail the min/max
+            # check and take the exact path below.
+            gid_full = np.where(keep, codes, -1).astype(np.int32)
+            group_values = enc_g.dictionary
+            if isinstance(out_batch.columns[spec.group_col], np.ndarray):
+                return group_values, gid_full, ts_enc.epoch
+            return group_values, jnp.asarray(gid_full), ts_enc.epoch
         sel_codes = codes[keep]
         if len(sel_codes) == 0:
             return None
@@ -1687,10 +1715,6 @@ class ParquetReader:
         gid_full = np.full(cap, -1, dtype=np.int32)
         gid_full[keep] = dense.astype(np.int32)
 
-        ts_enc = out_batch.encodings[spec.ts_col]
-        ensure(ts_enc.kind in ("offset", "numeric"),
-               f"aggregate needs arithmetic timestamps, got "
-               f"{ts_enc.kind!r} encoding for {spec.ts_col!r}")
         group_values = _decode_group_values(
             uniq, out_batch.encodings[spec.group_col])
         # the memo stores the window's ts EPOCH, not a shift: the caller
