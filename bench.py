@@ -70,6 +70,44 @@ def ensure_responsive_backend(timeout_s: int = 120, attempts: int = 3) -> None:
     os.execve(sys.executable, [sys.executable] + sys.argv, env)
 
 
+def _tpu_verified_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "bench_results", "tpu_verified.json")
+
+
+def load_tpu_verified() -> dict:
+    """Latest REAL-hardware numbers, carried inline in every emitted
+    JSON (even CPU-fallback runs) so the driver sees the hardware story
+    in the parsed payload, not behind a file pointer."""
+    try:
+        with open(_tpu_verified_path(), encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def record_tpu_verified(result: dict) -> None:
+    """A run that actually executed on the TPU refreshes the verified
+    block — self-maintaining: the next wedged-relay round still carries
+    these numbers with their capture date."""
+    import datetime
+
+    block = {
+        "date": datetime.date.today().isoformat(),
+        "config": int(os.environ.get("BENCH_CONFIG", 1)),
+        "rows": result.get("rows"),
+        "cached_ms": result.get("value"),
+        "cold_ms": result.get("cold_p50_ms"),
+        "varied_ms": result.get("varied_p50_ms"),
+        "vs_baseline": result.get("vs_baseline"),
+    }
+    try:
+        with open(_tpu_verified_path(), "w", encoding="utf-8") as f:
+            json.dump(block, f, indent=1)
+    except OSError as exc:
+        log(f"could not record tpu_verified: {exc}")
+
+
 def latest_tpu_evidence() -> dict:
     """Most recent dated real-TPU capture under bench_results/ — embedded
     in the emitted JSON so a wedged-relay (CPU fallback) round still
@@ -130,6 +168,21 @@ def run_engine_headline(rows: int, iters: int) -> dict:
     names = pa.array([f"host_{i:03d}" for i in range(hosts)])
     log(f"engine headline: {n:,} rows, {hosts} hosts x {num_buckets} "
         f"buckets, {span // segment_ms + 1} segments")
+
+    # ---- CPU baseline: numpy aggregate of the same rows, in memory ----
+    # defined up front so its trials INTERLEAVE with the engine's cached
+    # queries: on a busy 1-core box the two legs must see the same
+    # scheduler conditions or the vs_baseline ratio swings 2x run-to-run
+    # (paired trials make the <=0.5x target falsifiable)
+    ts_off = ts - T0
+    cell = host_id.astype(np.int64) * num_buckets + ts_off // bucket_ms
+    ncells = hosts * num_buckets
+
+    def cpu_run():
+        counts = np.bincount(cell, minlength=ncells)
+        sums = np.bincount(cell, weights=vals, minlength=ncells)
+        with np.errstate(invalid="ignore"):
+            return sums / counts, counts
 
     async def setup() -> MetricEngine:
         scan_cfg = {"cache_max_rows": rows * 4}
@@ -204,10 +257,15 @@ def run_engine_headline(rows: int, iters: int) -> dict:
                     for k in after if after[k] != before[k]}
 
         cached_times = []
+        base_times = []
         for _ in range(iters):
             t0 = time.perf_counter()
             out = await query(e)
             cached_times.append(time.perf_counter() - t0)
+            # paired baseline trial under the same scheduler conditions
+            t0 = time.perf_counter()
+            cpu_run()
+            base_times.append(time.perf_counter() - t0)
 
         # varied-load leg: rotating half-span windows (bucket-aligned,
         # TSBS-style "random range" shape).  12 distinct ranges exceed
@@ -247,7 +305,7 @@ def run_engine_headline(rows: int, iters: int) -> dict:
             varied_p50 = float(np.percentile(steady, 50))
         return (out, compile_s, float(np.percentile(cold_times, 50)),
                 float(np.percentile(cached_times, 50)), varied_p50,
-                stage_profile)
+                stage_profile, cached_times, base_times)
 
     async def main_async():
         e = await setup()
@@ -256,8 +314,8 @@ def run_engine_headline(rows: int, iters: int) -> dict:
         finally:
             await e.close()
 
-    out, compile_s, cold_p50, cached_p50, varied_p50, stage_profile = \
-        asyncio.run(main_async())
+    (out, compile_s, cold_p50, cached_p50, varied_p50, stage_profile,
+     cached_times, base_times) = asyncio.run(main_async())
     log(f"compile+first query: {compile_s:.1f}s")
     log(f"cold stage profile: {stage_profile}")
     log(f"cold p50 (parquet->encode->merge->downsample): "
@@ -268,28 +326,19 @@ def run_engine_headline(rows: int, iters: int) -> dict:
         log(f"varied p50 (rotating half-span ranges, no replay): "
             f"{varied_p50 * 1e3:.1f} ms")
 
-    # ---- CPU baseline: numpy aggregate of the same rows, in memory ----
-    ts_off = ts - T0
-    cell = host_id.astype(np.int64) * num_buckets + ts_off // bucket_ms
-    ncells = hosts * num_buckets
-
-    def cpu_run():
-        counts = np.bincount(cell, minlength=ncells)
-        sums = np.bincount(cell, weights=vals, minlength=ncells)
-        with np.errstate(invalid="ignore"):
-            return sums / counts, counts
-
-    # enough samples that one scheduler hiccup cannot swing the
-    # vs_baseline ratio (observed 2x swings at 3-5 samples on a busy
-    # 1-core box)
-    times = []
-    for _ in range(max(9, iters // 2)):
-        t0 = time.perf_counter()
-        ref_avg, ref_counts = cpu_run()
-        times.append(time.perf_counter() - t0)
-    cpu_p50 = float(np.percentile(times, 50))
-    log(f"cpu baseline p50 (in-memory, no parquet/merge): "
+    # paired per-trial ratios: engine trial i over the baseline trial
+    # run right after it — the ratio's median/IQR is robust to the
+    # box-wide slowdowns that used to swing the unpaired ratio 2x
+    ratios = np.array(cached_times) / np.array(base_times)
+    vs_baseline = float(np.percentile(ratios, 50))
+    iqr = (float(np.percentile(ratios, 25)),
+           float(np.percentile(ratios, 75)))
+    cpu_p50 = float(np.percentile(base_times, 50))
+    ref_avg, ref_counts = cpu_run()
+    log(f"cpu baseline p50 (in-memory, interleaved): "
         f"{cpu_p50 * 1e3:.2f} ms ({n / cpu_p50 / 1e6:.0f}M rows/s)")
+    log(f"paired vs_baseline: p50 {vs_baseline:.3f}, "
+        f"IQR [{iqr[0]:.3f}, {iqr[1]:.3f}]")
 
     # ---- cross-check the engine's grids against numpy -----------------
     tsid_by_host = np.array(
@@ -313,7 +362,9 @@ def run_engine_headline(rows: int, iters: int) -> dict:
                    f"p50 (cached)"),
         "value": round(cached_p50 * 1e3, 3),
         "unit": "ms",
-        "vs_baseline": round(cached_p50 / cpu_p50, 4),
+        # median of PAIRED per-trial ratios (engine/baseline interleaved)
+        "vs_baseline": round(vs_baseline, 4),
+        "vs_baseline_iqr": [round(iqr[0], 4), round(iqr[1], 4)],
         "cold_p50_ms": round(cold_p50 * 1e3, 3),
         "cold_vs_baseline": round(cold_p50 / cpu_p50, 4),
         # rotating half-span ranges (12 distinct specs > the 8-slot
@@ -467,6 +518,14 @@ def main() -> None:
     # work and must never read as a device number)
     for k, v in provenance().items():
         result.setdefault(k, v)
+    if (result.get("backend") == "tpu" and not result.get("fallback")
+            and config == 1):
+        # only the HEADLINE config refreshes the verified block — a
+        # microbench run must not clobber it with headline-shaped keys
+        record_tpu_verified(result)
+    verified = load_tpu_verified()
+    if verified:
+        result["tpu_verified"] = verified
     if result.get("fallback"):
         result.update(latest_tpu_evidence())
     print(json.dumps(result))
