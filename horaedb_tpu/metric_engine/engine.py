@@ -769,14 +769,27 @@ class MetricEngine:
         RANGE-INDEPENDENT — rotating/zooming dashboard queries over the
         same data share one set of cached merge windows instead of
         re-reading per range."""
+        parts = await self._data_pred_parts(metric, filters, time_range,
+                                            ts_leaf)
+        if parts is None:
+            return None
+        return And([parts[0], Eq("field_id", field_id_of(field))]
+                   + parts[1:])
+
+    async def _data_pred_parts(self, metric: str,
+                               filters: list[tuple[str, str]],
+                               time_range: TimeRange,
+                               ts_leaf: bool = True):
+        """The field-independent predicate leaves (metric id, time leaf,
+        tsid In) shared by single- and multi-field queries; None means
+        provably empty."""
         mid = await self.metric_manager.resolve(metric, time_range)
         if mid is None:
             return None
         tsids = await self.index_manager.find_tsids(mid, filters, time_range)
         if tsids is not None and not tsids:
             return None
-        preds = [Eq("metric_id", mid),
-                 Eq("field_id", field_id_of(field))]
+        preds = [Eq("metric_id", mid)]
         if self.chunked_data:
             # a chunk's row key is its window start; a window overlapping
             # the query starts at or after truncate(start, window)
@@ -790,7 +803,7 @@ class MetricEngine:
                                        int(time_range.end)))
         if tsids is not None:
             preds.append(In("tsid", sorted(tsids)))
-        return And(preds)
+        return preds
 
     async def query(self, metric: str, filters: list[tuple[str, str]],
                     time_range: TimeRange, field: str = "value") -> pa.Table:
@@ -873,27 +886,43 @@ class MetricEngine:
         rides along).  Returns {tsids, num_buckets,
         aggs: {agg -> (series, bucket) grid}}.
         """
+        num_buckets, aligned = self._downsample_grid(time_range, bucket_ms)
+        if self.chunked_data:
+            return await self._downsample_chunked(
+                metric, filters, time_range, bucket_ms, num_buckets,
+                field=field, which=tuple(aggs))
+        pred = await self._resolve_data_predicate(metric, filters,
+                                                  time_range, field,
+                                                  ts_leaf=not aligned)
+        return await self._scan_downsample(pred, time_range, bucket_ms,
+                                           num_buckets, aggs)
+
+    def _downsample_grid(self, time_range: TimeRange,
+                         bucket_ms: int) -> tuple[int, bool]:
+        """Shared bucket-grid math: (num_buckets, aligned).
+
+        A bucket-ALIGNED range's grid cut ([0, num_buckets) on range
+        -relative buckets) IS the time filter, exactly — the scan omits
+        the ts leaf so cached windows/memos serve every aligned range.
+        Only when the span covers at least one segment, though: there
+        the read amplification is bounded by the two boundary segments
+        (<= 2x), while a narrow query over a wide segment would decode
+        the whole segment for a sliver (config-2 point queries keep
+        their row-group pruning)."""
         span = int(time_range.end) - int(time_range.start)
         ensure(span < 2**31,
                f"query window of {span}ms exceeds the int32 offset range "
                "(~24.8 days); split the query into smaller windows")
         num_buckets = -(-span // bucket_ms)
-        if self.chunked_data:
-            return await self._downsample_chunked(
-                metric, filters, time_range, bucket_ms, num_buckets,
-                field=field, which=tuple(aggs))
-        # bucket-aligned range: the grid cut ([0, num_buckets) on
-        # range-relative buckets) IS the time filter, exactly — omit the
-        # ts leaf so cached windows/memos serve every aligned range.
-        # Only when the span covers at least one segment, though: there
-        # the read amplification is bounded by the two boundary segments
-        # (<= 2x), while a narrow query over a wide segment would decode
-        # the whole segment for a sliver (config-2 point queries keep
-        # their row-group pruning).
         aligned = span % bucket_ms == 0 and span >= self.segment_ms
-        pred = await self._resolve_data_predicate(metric, filters,
-                                                  time_range, field,
-                                                  ts_leaf=not aligned)
+        return num_buckets, aligned
+
+    async def _scan_downsample(self, pred, time_range: TimeRange,
+                               bucket_ms: int, num_buckets: int,
+                               aggs: tuple) -> dict:
+        """Shared scan + result shaping for the row-layout downsample
+        paths (single- and multi-field MUST stay in lockstep — parity
+        -tested)."""
         if pred is None:
             return {"tsids": [], "num_buckets": num_buckets, "aggs": {}}
         spec = AggregateSpec(group_col="tsid", ts_col="timestamp",
@@ -901,11 +930,51 @@ class MetricEngine:
                              range_start=int(time_range.start),
                              bucket_ms=bucket_ms, num_buckets=num_buckets,
                              which=tuple(aggs))
-        group_values, aggs = await self.tables["data"].scan_aggregate(
+        group_values, grids = await self.tables["data"].scan_aggregate(
             ScanRequest(range=time_range, predicate=pred), spec)
         return {"tsids": [int(t) for t in group_values],
                 "num_buckets": num_buckets,
-                "aggs": aggs if len(group_values) else {}}
+                "aggs": grids if len(group_values) else {}}
+
+    async def query_downsample_multi(self, metric: str,
+                                     filters: list[tuple[str, str]],
+                                     time_range: TimeRange, bucket_ms: int,
+                                     fields: list[str],
+                                     aggs: tuple = ALL_AGGS) -> dict:
+        """GROUP BY series, time(bucket) over SEVERAL fields of one
+        metric (TSBS devops queries touch up to 10 fields) with ONE
+        metric/index resolve shared by every field's scan.  Returns
+        {field: result}, each result shaped exactly like
+        query_downsample's.
+
+        Fields PARTITION the data table's rows (one row per sample per
+        field, RFC docs/rfcs/20240827-metric-engine.md:106-137), so the
+        per-field pushdown scans below each decode only their own
+        field's rows — N fields cost one pass over the union, not N
+        (bench config 3 reports this as the redundancy factor).  A
+        shared-window variant (push In(field_id, all) once, mask each
+        field post-merge) was measured 4.6x SLOWER on the host path:
+        with device-layout sidecars the leaf-filtered load is cheap,
+        while N masked aggregations over the UNION of rows cost N full
+        passes.
+        """
+        ensure(len(fields) > 0, "fields must be non-empty")
+        if self.chunked_data:
+            return {f: await self.query_downsample(
+                metric, filters, time_range, bucket_ms, field=f, aggs=aggs)
+                for f in fields}
+        num_buckets, aligned = self._downsample_grid(time_range, bucket_ms)
+        parts = await self._data_pred_parts(metric, filters, time_range,
+                                            ts_leaf=not aligned)
+        out = {}
+        for f in fields:
+            pred = (None if parts is None else
+                    And([parts[0], Eq("field_id", field_id_of(f))]
+                        + parts[1:]))
+            out[f] = await self._scan_downsample(pred, time_range,
+                                                 bucket_ms, num_buckets,
+                                                 aggs)
+        return out
 
     async def _downsample_chunked(self, metric: str, filters, time_range,
                                   bucket_ms: int, num_buckets: int,

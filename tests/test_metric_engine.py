@@ -263,6 +263,69 @@ class TestWriteQuery:
 
         asyncio.run(go())
 
+    def test_multi_field_downsample_parity_and_shared_reads(self):
+        """query_downsample_multi must return exactly what N per-field
+        query_downsample calls return, while reading the data table's
+        rows ONCE in total (fields partition the rows; each field's
+        pushdown scan decodes only its own partition)."""
+        from horaedb_tpu.storage.read import _STAGE_ROWS
+
+        FIELDS = ["usage_user", "usage_system", "usage_idle"]
+        N_ROWS = 3 * 40 * len(FIELDS)
+
+        async def go():
+            store = MemoryObjectStore()
+            e = await MetricEngine.open("mf", store, segment_ms=2 * HOUR)
+            try:
+                rng = np.random.default_rng(21)
+                samples = []
+                for host in ("web-1", "web-2", "db-1"):
+                    for i in range(40):
+                        for j, f in enumerate(FIELDS):
+                            samples.append(Sample(
+                                name="cpu",
+                                labels=[Label("host", host)],
+                                timestamp=T0 + i * 60_000 + j,
+                                value=float(rng.random() * 100),
+                                field_name=f))
+                await e.write(samples)
+                rng_q = TimeRange.new(T0, T0 + HOUR)
+                singles = {}
+                for f in FIELDS:
+                    singles[f] = await e.query_downsample(
+                        "cpu", [], rng_q, bucket_ms=300_000, field=f)
+            finally:
+                await e.close()
+            # fresh engine: the multi query runs cold, nothing cached
+            e = await MetricEngine.open("mf", store, segment_ms=2 * HOUR)
+            try:
+                # data table reads go through sidecars (OVERWRITE mode);
+                # metric/index resolve reads are parquet and not counted
+                read_before = _STAGE_ROWS["sidecar_read"].value
+                multi = await e.query_downsample_multi(
+                    "cpu", [], rng_q, bucket_ms=300_000, fields=FIELDS)
+                read_rows = _STAGE_ROWS["sidecar_read"].value - read_before
+                # ONE pass over the data (all fields' rows), not N; the
+                # one-off metrics-table resolve adds its own few rows
+                assert N_ROWS <= read_rows <= N_ROWS + len(FIELDS), \
+                    read_rows
+                for f in FIELDS:
+                    assert multi[f]["tsids"] == singles[f]["tsids"], f
+                    assert set(multi[f]["aggs"]) == set(singles[f]["aggs"])
+                    np.testing.assert_array_equal(
+                        np.asarray(multi[f]["aggs"]["count"]),
+                        np.asarray(singles[f]["aggs"]["count"]),
+                        err_msg=f)
+                    for k in multi[f]["aggs"]:
+                        np.testing.assert_allclose(
+                            np.asarray(multi[f]["aggs"][k]),
+                            np.asarray(singles[f]["aggs"][k]),
+                            rtol=1e-5, atol=1e-5, err_msg=f"{f}/{k}")
+            finally:
+                await e.close()
+
+        asyncio.run(go())
+
     def test_persistence_across_reopen(self):
         async def go():
             store = MemoryObjectStore()
