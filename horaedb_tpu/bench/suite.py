@@ -6,11 +6,14 @@
   4. multi-SST merge-scan: top-k hosts by max(cpu) across 64 SSTs
   5. compaction rollup: 1s -> 1h over 30d, all aggregators, write-back
   6. manifest snapshot codec (the reference's own criterion benchmark)
+  7. mixed read/write: varied downsample queries under sustained write
+     load + compaction churn (vs_baseline here is mixed_p50/quiet_p50 —
+     query latency degradation under churn, 1.0 = churn-proof)
 
 Each run_configN returns {metric, value (p50 ms), unit, vs_baseline
-(device_p50 / cpu_p50, lower is better)}.  Sizes are scaled by `rows`
-so the suite runs anywhere; the driver's headline numbers come from
-bench.py.
+(device_p50 / cpu_p50, lower is better — except config 7, above)}.
+Sizes are scaled by `rows` so the suite runs anywhere; the driver's
+headline numbers come from bench.py.
 
 CLI: python -m horaedb_tpu.bench.suite --config 2 [--rows N] [--iters K]
 """
@@ -70,6 +73,20 @@ def _check_i32_span(ts_off: np.ndarray, what: str) -> None:
     ensure(int(ts_off.max(initial=0)) < 2**31,
            f"{what}: ts offsets exceed int32 — lower --rows (the device "
            "path buckets int32 offsets; larger spans must be segmented)")
+
+
+def _host_record_batch(names, host_id: np.ndarray, ts: np.ndarray,
+                       values: np.ndarray):
+    """The engine-leg ingest batch shape shared by configs 3 and 7:
+    dictionary-encoded host tag + int64 timestamps + float64 values."""
+    import pyarrow as pa
+
+    return pa.record_batch({
+        "host": pa.DictionaryArray.from_arrays(
+            pa.array(host_id.astype(np.int32)), names),
+        "timestamp": pa.array(ts, type=pa.int64()),
+        "value": pa.array(values.astype(np.float64)),
+    })
 
 
 # ---------------------------------------------------------------------------
@@ -278,15 +295,6 @@ def _config3_engine_multifield(rows: int, cfg, bucket: int) -> dict:
     n = len(cols["ts"])
     names = pa.array([f"host_{i:03d}" for i in range(hosts)])
 
-    def host_batch(values: np.ndarray, ts: np.ndarray,
-                   host_id: np.ndarray) -> pa.RecordBatch:
-        return pa.record_batch({
-            "host": pa.DictionaryArray.from_arrays(
-                pa.array(host_id.astype(np.int32)), names),
-            "timestamp": pa.array(ts, type=pa.int64()),
-            "value": pa.array(values.astype(np.float64)),
-        })
-
     async def go():
         e = await MetricEngine.open("cfg3", MemoryObjectStore(),
                                     segment_ms=2 * 3600 * 1000)
@@ -294,8 +302,8 @@ def _config3_engine_multifield(rows: int, cfg, bucket: int) -> dict:
             for f in range(fields):
                 await e.write_arrow(
                     "cpu", ["host"],
-                    host_batch(cols[CPU_FIELDS[f]], cols["ts"],
-                               cols["host_id"]),
+                    _host_record_batch(names, cols["host_id"], cols["ts"],
+                                       cols[CPU_FIELDS[f]]),
                     field=CPU_FIELDS[f])
             rng_q = TimeRange.new(ecfg.start_ms,
                                   ecfg.start_ms + ecfg.span_ms)
@@ -323,8 +331,8 @@ def _config3_engine_multifield(rows: int, cfg, bucket: int) -> dict:
         try:
             await e.write_arrow(
                 "cpu", ["host"],
-                host_batch(scols[CPU_FIELDS[0]], scols["ts"],
-                           scols["host_id"]))
+                _host_record_batch(names, scols["host_id"], scols["ts"],
+                                   scols[CPU_FIELDS[0]]))
             rng_q = TimeRange.new(scfg.start_ms,
                                   scfg.start_ms + scfg.span_ms)
             e.tables["data"].reader.scan_cache.clear()
@@ -658,13 +666,178 @@ def run_config6(rows: int, iters: int) -> dict:
             "backend": "host", "fallback": False}
 
 
+# ---------------------------------------------------------------------------
+# config 7: mixed read/write — sustained write load + compaction churn
+# while serving varied-range downsample queries
+# ---------------------------------------------------------------------------
+
+
+def run_config7(rows: int, iters: int) -> dict:
+    """Queries under churn: the reference's self-test write generator
+    shape (1000-row random batches per interval,
+    /root/reference/src/server/src/main.rs:187-233) runs CONCURRENTLY
+    with rotating varied-range downsample queries and a 1s-interval
+    compaction scheduler.  Reports query p50/p99 quiet vs mixed, cache
+    hit rates and compaction count during the mixed phase.
+    `vs_baseline` is mixed_p50/quiet_p50 — 1.0 means churn-proof."""
+    import asyncio
+    import time
+
+    import pyarrow as pa
+
+    from horaedb_tpu.metric_engine import MetricEngine
+    from horaedb_tpu.objstore import MemoryObjectStore
+    from horaedb_tpu.storage import compaction as compaction_mod
+    from horaedb_tpu.storage import scan_cache as scan_cache_mod
+    from horaedb_tpu.storage.config import StorageConfig, from_dict
+    from horaedb_tpu.storage.read import _REPLAY_HITS
+    from horaedb_tpu.storage.types import TimeRange
+
+    from horaedb_tpu.common.error import ensure
+
+    hosts = 100
+    interval = 10_000
+    bucket = 60_000
+    per_host = max(1, rows // hosts)
+    span = per_host * interval
+    segment_ms = 2 * 3600 * 1000
+    T0 = (1_700_000_000_000 // segment_ms) * segment_ms
+    rng = np.random.default_rng(7)
+    n = per_host * hosts
+    names = pa.array([f"host_{i:03d}" for i in range(hosts)])
+
+    def batch_of(ts: np.ndarray, host_id: np.ndarray) -> pa.RecordBatch:
+        return _host_record_batch(names, host_id, ts,
+                                  rng.random(len(ts)) * 100)
+
+    half = (span // 2 // bucket) * bucket
+    ensure(half > 0, "config7 needs rows >= ~1200 for a non-empty "
+                     "half-span query window")
+    _check_i32_span(np.asarray([span]), "config7")
+    step = max(bucket, (span - half) // 11 // bucket * bucket)
+    starts = [T0 + i * step for i in range(12)
+              if T0 + i * step + half <= T0 + span]
+    # wall-clock floors scale with iters so smoke tests stay fast while
+    # driver runs (iters=20) hold the churn phase open long enough for
+    # the 1s compaction scheduler to fire repeatedly
+    quiet_floor_s = min(2.0, 0.1 * iters)
+    mixed_floor_s = min(5.0, 0.25 * iters)
+
+    async def go():
+        cfg = from_dict(StorageConfig, {
+            "scheduler": {"schedule_interval": "1s"},
+            "scan": {"cache_max_rows": rows * 4},
+        })
+        e = await MetricEngine.open("cfg7", MemoryObjectStore(),
+                                    segment_ms=segment_ms, config=cfg)
+        try:
+            ts_all = T0 + np.repeat(
+                np.arange(per_host, dtype=np.int64) * interval, hosts)
+            hid_all = np.tile(np.arange(hosts, dtype=np.int32), per_host)
+            chunk = max(1, 1_000_000 // hosts) * hosts
+            for lo in range(0, n, chunk):
+                hi = min(n, lo + chunk)
+                await e.write_arrow("cpu", ["host"],
+                                    batch_of(ts_all[lo:hi],
+                                             hid_all[lo:hi]))
+
+            async def q_phase(min_queries: int, min_seconds: float):
+                lats = []
+                t_phase = time.perf_counter()
+                i = 0
+                while (len(lats) < min_queries
+                       or time.perf_counter() - t_phase < min_seconds):
+                    s = starts[i % len(starts)]
+                    i += 1
+                    t0 = time.perf_counter()
+                    await e.query_downsample(
+                        "cpu", [], TimeRange.new(s, s + half),
+                        bucket_ms=bucket, aggs=("avg",))
+                    lats.append(time.perf_counter() - t0)
+                return lats
+
+            # warm + self-check + quiet phase
+            first = await e.query_downsample(
+                "cpu", [], TimeRange.new(starts[0], starts[0] + half),
+                bucket_ms=bucket, aggs=("avg",))
+            ensure(len(first["tsids"]) == hosts,
+                   f"config7 self-check: expected {hosts} series, got "
+                   f"{len(first['tsids'])}")
+            await q_phase(len(starts), 0.0)
+            quiet = await q_phase(max(iters, 2 * len(starts)),
+                                  quiet_floor_s)
+
+            # mixed phase: writer fires 1000-row batches every 100 ms
+            # into a narrow 2-segment window (concentrates SST buildup
+            # so the 1s compaction scheduler actually churns), while
+            # the same varied queries keep running
+            stop = asyncio.Event()
+            writes = 0
+
+            async def writer():
+                nonlocal writes
+                lo_seg = T0 + (span // 2 // segment_ms) * segment_ms
+                while not stop.is_set():
+                    ts_w = lo_seg + rng.integers(
+                        0, min(2 * segment_ms, span), 1000).astype(np.int64)
+                    await e.write_arrow(
+                        "cpu", ["host"],
+                        batch_of(np.sort(ts_w),
+                                 rng.integers(0, hosts, 1000)))
+                    writes += 1
+                    await asyncio.sleep(0.1)
+
+            h0 = scan_cache_mod._HITS.value
+            m0 = scan_cache_mod._MISSES.value
+            c0 = compaction_mod._COMPACTIONS.value
+            r0 = _REPLAY_HITS.value
+            w_task = asyncio.create_task(writer())
+            try:
+                mixed = await q_phase(max(iters, 2 * len(starts)),
+                                      mixed_floor_s)
+            finally:
+                stop.set()
+                await w_task
+            hits = scan_cache_mod._HITS.value - h0
+            misses = scan_cache_mod._MISSES.value - m0
+            compactions = compaction_mod._COMPACTIONS.value - c0
+            replays = _REPLAY_HITS.value - r0
+            return quiet, mixed, writes, hits, misses, compactions, replays
+        finally:
+            await e.close()
+
+    quiet, mixed, writes, hits, misses, compactions, replays = \
+        asyncio.run(go())
+    q50, q99 = np.percentile(quiet, [50, 99])
+    m50, m99 = np.percentile(mixed, [50, 99])
+    hit_rate = hits / max(1, hits + misses)
+    _log(f"config7: quiet p50 {q50*1e3:.1f}/p99 {q99*1e3:.1f} ms; "
+         f"under churn p50 {m50*1e3:.1f}/p99 {m99*1e3:.1f} ms "
+         f"({len(mixed)} queries, {writes} writes, {compactions} "
+         f"compactions, scan-cache hit rate {hit_rate:.2f})")
+    return {
+        "metric": (f"varied downsample p50 under write+compaction churn, "
+                   f"{rows / 1e6:.1f}M rows preloaded"),
+        "value": round(float(m50) * 1e3, 3), "unit": "ms",
+        "vs_baseline": round(float(m50 / q50), 4),
+        "quiet_p50_ms": round(float(q50) * 1e3, 3),
+        "quiet_p99_ms": round(float(q99) * 1e3, 3),
+        "churn_p99_ms": round(float(m99) * 1e3, 3),
+        "mixed_queries": len(mixed),
+        "writes_1k_batches": writes,
+        "compactions": int(compactions),
+        "scan_cache_hit_rate": round(hit_rate, 4),
+        "replay_hits": int(replays),
+    }
+
+
 RUNNERS = {2: run_config2, 3: run_config3, 4: run_config4, 5: run_config5,
-           6: run_config6}
+           6: run_config6, 7: run_config7}
 
 
 def main() -> None:
     parser = argparse.ArgumentParser("horaedb-tpu bench suite")
-    parser.add_argument("--config", type=int, required=True, choices=[2, 3, 4, 5, 6])
+    parser.add_argument("--config", type=int, required=True, choices=[2, 3, 4, 5, 6, 7])
     parser.add_argument("--rows", type=int, default=2_000_000)
     parser.add_argument("--iters", type=int, default=10)
     args = parser.parse_args()
