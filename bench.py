@@ -108,6 +108,18 @@ def record_tpu_verified(result: dict) -> None:
         log(f"could not record tpu_verified: {exc}")
 
 
+def load_scale_proven() -> dict:
+    """Largest row count the engine has been soak-proven at (written by
+    tools/scale_run.py), surfaced as max_rows_proven in every payload."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "bench_results", "scale_proven.json")
+    try:
+        with open(path, encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
 def latest_tpu_evidence() -> dict:
     """Most recent dated real-TPU capture under bench_results/ — embedded
     in the emitted JSON so a wedged-relay (CPU fallback) round still
@@ -149,12 +161,17 @@ def run_engine_headline(rows: int, iters: int) -> dict:
     from horaedb_tpu.storage.config import StorageConfig, from_dict
     from horaedb_tpu.storage.types import TimeRange
 
-    hosts = 100
+    # BENCH_HOSTS scales CARDINALITY: the query window must fit int32
+    # ms offsets (~24.8 days), so beyond ~20M rows the ladder grows
+    # hosts at a fixed tick count instead of growing the time span —
+    # the TSBS-devops shape of "more rows" is more hosts anyway
+    hosts = int(os.environ.get("BENCH_HOSTS", 100))
     interval = 10_000  # 10s scrape
     bucket_ms = 60_000
     per_host = max(1, rows // hosts)
     span = per_host * interval
-    assert span < 2**31, "query window must fit int32 offsets"
+    assert span < 2**31, ("query window must fit int32 offsets — raise "
+                          "BENCH_HOSTS to scale by cardinality instead")
     num_buckets = -(-span // bucket_ms)
     segment_ms = 2 * 3600 * 1000  # reference default segment duration
     T0 = (1_700_000_000_000 // segment_ms) * segment_ms
@@ -183,6 +200,8 @@ def run_engine_headline(rows: int, iters: int) -> dict:
         sums = np.bincount(cell, weights=vals, minlength=ncells)
         with np.errstate(invalid="ignore"):
             return sums / counts, counts
+
+    ingest_box: dict = {}
 
     async def setup() -> MetricEngine:
         scan_cfg = {"cache_max_rows": rows * 4}
@@ -224,7 +243,8 @@ def run_engine_headline(rows: int, iters: int) -> dict:
                     await e.tables["data"].manifest.trigger_merge()
             else:
                 raise Error("ingest failed after 5 backpressure retries")
-        log(f"ingest: {n:,} rows in {time.perf_counter() - t0:.1f}s")
+        ingest_box["s"] = time.perf_counter() - t0
+        log(f"ingest: {n:,} rows in {ingest_box['s']:.1f}s")
         return e
 
     async def query(e: MetricEngine) -> dict:
@@ -379,6 +399,7 @@ def run_engine_headline(rows: int, iters: int) -> dict:
         # the BASELINE metric is "rows scanned/sec/chip"
         "rows_per_s_cached": round(n / cached_p50),
         "rows_per_s_cold": round(n / cold_p50),
+        "ingest_s": round(ingest_box.get("s", 0.0), 1),
         # per-plan-stage attribution of one cold query (seconds/rows/
         # bytes deltas from the scan_stage_* registry metrics)
         "stage_profile": stage_profile,
@@ -518,6 +539,10 @@ def main() -> None:
     # work and must never read as a device number)
     for k, v in provenance().items():
         result.setdefault(k, v)
+    import resource
+
+    result["max_rss_mb"] = round(
+        resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024)
     if (result.get("backend") == "tpu" and not result.get("fallback")
             and config == 1):
         # only the HEADLINE config refreshes the verified block — a
@@ -526,6 +551,10 @@ def main() -> None:
     verified = load_tpu_verified()
     if verified:
         result["tpu_verified"] = verified
+    scale = load_scale_proven()
+    if scale:
+        result["max_rows_proven"] = scale.get("max_rows_proven")
+        result["scale_evidence"] = scale.get("source")
     if result.get("fallback"):
         result.update(latest_tpu_evidence())
     print(json.dumps(result))
