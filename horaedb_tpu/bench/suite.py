@@ -406,37 +406,51 @@ def run_config4(rows: int, iters: int, num_ssts: int = 64) -> dict:
         return s
 
     async def query_once(s):
-        """Full device pipeline via the aggregate pushdown: scan (parquet
-        decode + device merge-dedup) -> downsample grids -> top-k, with
-        merge windows staying device-resident (no Arrow round trip).
-        This is what the metric times."""
+        """Full device pipeline via the composed QueryPlan: scan
+        (parquet decode + device merge-dedup) -> downsample grids ->
+        TopK stage, merge windows staying device-resident (no Arrow
+        round trip).  This is what the metric times."""
+        from horaedb_tpu.storage.plan import TopKSpec
         from horaedb_tpu.storage.read import AggregateSpec
 
         spec = AggregateSpec(group_col="host", ts_col="ts",
                              value_col="cpu", range_start=T0,
-                             bucket_ms=span, num_buckets=1)
-        group_values, grids = await s.scan_aggregate(
+                             bucket_ms=span, num_buckets=1,
+                             which=("max",))
+        qp = await s.plan_query(
+            ScanRequest(range=TimeRange.new(T0, T0 + span)), spec=spec,
+            top_k=TopKSpec(k=10, by="max"))
+        values, grids = await s.execute_plan(qp)
+        return values, grids
+
+    async def check_counts(s):
+        """Dedup-count cross-check needs the UN-sliced grids: one
+        aggregate without the TopK stage, outside the timed loop."""
+        from horaedb_tpu.storage.read import AggregateSpec
+
+        spec = AggregateSpec(group_col="host", ts_col="ts",
+                             value_col="cpu", range_start=T0,
+                             bucket_ms=span, num_buckets=1,
+                             which=("max",))
+        _values, grids = await s.scan_aggregate(
             ScanRequest(range=TimeRange.new(T0, T0 + span)), spec)
-        maxes = np.where(grids["count"][:, 0] > 0, grids["max"][:, 0],
-                         -np.inf)
-        top = np.argsort(maxes)[-10:]
-        n_out = int(grids["count"].sum())
-        return n_out, top, group_values
+        return int(np.asarray(grids["count"]).sum())
 
     async def bench():
         s = await setup()
         try:
-            n_out, top_idx, host_dict = await query_once(s)  # warm/compile
+            top_hosts, _ = await query_once(s)  # warm/compile
+            n_out = await check_counts(s)
             times = []
             for _ in range(iters):
                 t0 = time.perf_counter()
-                n_out, top_idx, host_dict = await query_once(s)
+                top_hosts, _grids = await query_once(s)
                 times.append(time.perf_counter() - t0)
-            return float(np.percentile(times, 50)), n_out, top_idx, host_dict
+            return float(np.percentile(times, 50)), n_out, top_hosts
         finally:
             await s.close()
 
-    dev_p50, n_out, top_idx, host_dict = asyncio.run(bench())
+    dev_p50, n_out, top_hosts = asyncio.run(bench())
 
     # CPU baseline on THE SAME rows: in-memory lexsort+dedup+top-k.  Note
     # this is conservative in the device's disfavor: the CPU side skips
@@ -460,12 +474,15 @@ def run_config4(rows: int, iters: int, num_ssts: int = 64) -> dict:
 
     # cross-check: dedup count and top-k set must match numpy on same data
     assert n_out == ref_n, (n_out, ref_n)
-    got_hosts = {str(host_dict[i]) for i in top_idx}
+    got_hosts = {str(h) for h in top_hosts}
     assert got_hosts == {f"host_{g}" for g in ref_top}, (got_hosts, ref_top)
 
     _log(f"config4: {num_ssts} SSTs, {len(all_h):,} rows in, {n_out:,} out; "
          f"full-pipeline dev={dev_p50*1e3:.1f}ms cpu-in-mem={cpu_p50*1e3:.1f}ms")
-    return {"metric": f"multi-SST merge-scan top-k, {num_ssts} SSTs {len(all_h)/1e6:.1f}M rows, p50",
+    # NOTE (r5): the timed spec computes which=("max",) — what the
+    # top-k needs — where earlier rounds aggregated all six; numbers
+    # are not comparable across that boundary
+    return {"metric": f"multi-SST merge-scan top-k (max-only agg), {num_ssts} SSTs {len(all_h)/1e6:.1f}M rows, p50",
             "value": round(dev_p50 * 1e3, 3), "unit": "ms",
             "vs_baseline": round(dev_p50 / cpu_p50, 4)}
 

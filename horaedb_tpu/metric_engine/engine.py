@@ -813,8 +813,9 @@ class MetricEngine:
                                                  time_range, field)
         if pred is None:
             return _empty_result()
-        batches = await _collect(self.tables["data"].scan(ScanRequest(
-            range=time_range, predicate=pred)))
+        qp = await self.tables["data"].plan_query(ScanRequest(
+            range=time_range, predicate=pred))
+        batches = await _collect(self.tables["data"].execute_plan(qp))
         if not batches:
             return _empty_result()
         if self.chunked_data:
@@ -831,6 +832,7 @@ class MetricEngine:
         no samples survive the mask."""
         import numpy as np
 
+        from horaedb_tpu import native
         from horaedb_tpu.metric_engine import chunks
 
         out_tsid: list[np.ndarray] = []
@@ -838,8 +840,24 @@ class MetricEngine:
         out_val: list[np.ndarray] = []
         lo, hi = int(time_range.start), int(time_range.end)
         for b in batches:
+            payload_arr = b.column(b.schema.names.index("payload"))
+            # one FFI call decodes EVERY row's chunks (delta-of-delta ts,
+            # XOR/scaled values, per-row dedup) — the numpy twin below
+            # pays ~30 interpreter dispatches per chunk instead
+            got = native.chunk_decode_batch(payload_arr)
+            if got is not None:
+                ts, vals, counts = got
+                tsids = np.repeat(
+                    b.column(b.schema.names.index("tsid")).to_numpy(
+                        zero_copy_only=False), counts)
+                m = (ts >= lo) & (ts < hi)
+                if m.any():
+                    out_ts.append(ts[m])
+                    out_val.append(vals[m])
+                    out_tsid.append(tsids[m])
+                continue
             tsid_col = b.column(b.schema.names.index("tsid")).to_pylist()
-            payloads = b.column(b.schema.names.index("payload")).to_pylist()
+            payloads = payload_arr.to_pylist()
             for tsid, payload in zip(tsid_col, payloads):
                 ts, vals = chunks.decode_chunks(payload)
                 m = (ts >= lo) & (ts < hi)
@@ -919,10 +937,10 @@ class MetricEngine:
 
     async def _scan_downsample(self, pred, time_range: TimeRange,
                                bucket_ms: int, num_buckets: int,
-                               aggs: tuple) -> dict:
+                               aggs: tuple, top_k=None) -> dict:
         """Shared scan + result shaping for the row-layout downsample
         paths (single- and multi-field MUST stay in lockstep — parity
-        -tested)."""
+        -tested).  All aggregate shapes route through one QueryPlan."""
         if pred is None:
             return {"tsids": [], "num_buckets": num_buckets, "aggs": {}}
         spec = AggregateSpec(group_col="tsid", ts_col="timestamp",
@@ -930,11 +948,48 @@ class MetricEngine:
                              range_start=int(time_range.start),
                              bucket_ms=bucket_ms, num_buckets=num_buckets,
                              which=tuple(aggs))
-        group_values, grids = await self.tables["data"].scan_aggregate(
-            ScanRequest(range=time_range, predicate=pred), spec)
+        qp = await self.tables["data"].plan_query(
+            ScanRequest(range=time_range, predicate=pred), spec=spec,
+            top_k=top_k)
+        group_values, grids = await self.tables["data"].execute_plan(qp)
         return {"tsids": [int(t) for t in group_values],
                 "num_buckets": num_buckets,
                 "aggs": grids if len(group_values) else {}}
+
+    async def query_topk(self, metric: str,
+                         filters: list[tuple[str, str]],
+                         time_range: TimeRange, bucket_ms: int, k: int,
+                         by: str = "max", largest: bool = True,
+                         field: str = "value",
+                         aggs: tuple = ALL_AGGS) -> dict:
+        """Top-k series ranked by one aggregate over the window (BASELINE
+        config 4's 'top-k hosts by max(cpu)' shape) — the downsample
+        QueryPlan with a TopK stage on top.  Result rows come back best
+        -first.  Row layout only (chunked tables downsample then rank
+        host-side the same way)."""
+        import numpy as np
+
+        from horaedb_tpu.storage.plan import TopKSpec, apply_top_k
+
+        which = tuple(sorted(set(aggs) | {by}))
+        if self.chunked_data:
+            out = await self.query_downsample(metric, filters, time_range,
+                                              bucket_ms, field=field,
+                                              aggs=which)
+            if out["tsids"]:
+                values, grids = apply_top_k(
+                    np.asarray(out["tsids"], dtype=np.uint64),
+                    out["aggs"], TopKSpec(k=k, by=by, largest=largest))
+                out["tsids"] = [int(t) for t in values]
+                out["aggs"] = grids
+            return out
+        num_buckets, aligned = self._downsample_grid(time_range, bucket_ms)
+        pred = await self._resolve_data_predicate(metric, filters,
+                                                  time_range, field,
+                                                  ts_leaf=not aligned)
+        return await self._scan_downsample(
+            pred, time_range, bucket_ms, num_buckets, which,
+            top_k=TopKSpec(k=k, by=by, largest=largest))
 
     async def query_downsample_multi(self, metric: str,
                                      filters: list[tuple[str, str]],
@@ -1029,6 +1084,47 @@ class MetricEngine:
             self._chunk_cache.put(key, entry, nbytes)
         return out
 
+    @staticmethod
+    def _host_bucket_grids(gid, ts_rel, vals, num_groups: int,
+                           bucket_ms: int, num_buckets: int,
+                           which: tuple) -> dict:
+        """numpy twin of ops.downsample.time_bucket_aggregate for host
+        -bound backends: accumulation cores shared with the reader's
+        window partials (read.host_cell_grids), finished with the
+        device path's empty-cell conventions (count 0, min +inf,
+        max -inf, avg/last NaN), float32 outputs."""
+        import numpy as np
+
+        from horaedb_tpu.storage.read import host_cell_grids
+
+        which = set(which)
+        want = set(which) | ({"sum"} if "avg" in which else set())
+        ncells = num_groups * num_buckets
+        shape = (num_groups, num_buckets)
+        cell = gid.astype(np.int64) * num_buckets + ts_rel // bucket_ms
+        cores = host_cell_grids(cell, np.asarray(vals), ts_rel, ncells,
+                                want)
+        count = cores["count"].astype(np.float32)
+        out = {"count": count.reshape(shape)}
+        empty = count == 0
+        if "sum" in which:
+            out["sum"] = cores["sum"].astype(np.float32).reshape(shape)
+        if "avg" in which:
+            with np.errstate(invalid="ignore"):
+                avg = np.where(empty, np.nan,
+                               cores["sum"] / np.maximum(count, 1.0))
+            out["avg"] = avg.astype(np.float32).reshape(shape)
+        for k in ("min", "max"):
+            if k in which:
+                out[k] = cores[k].astype(np.float32).reshape(shape)
+        if "last" in which:
+            lt, li = cores["last"]
+            last = np.full(ncells, np.nan)
+            has = li >= 0
+            last[has] = np.asarray(vals)[li[has]]
+            out["last"] = last.astype(np.float32).reshape(shape)
+        return out
+
     def _downsample_rows(self, tbl: pa.Table, time_range: TimeRange,
                          bucket_ms: int, num_buckets: int,
                          which: tuple = ALL_AGGS) -> dict:
@@ -1058,22 +1154,52 @@ class MetricEngine:
         n = len(ts_np)
         dev = memo.get("dev") if memo is not None else None
         if dev is None:
-            uniq, gid = np.unique(tsid_np, return_inverse=True)
+            # dense group ids WITHOUT a full-length np.unique: chunk
+            # decode emits long per-row runs of equal tsids, so
+            # dense-ify the run VALUES (~one per chunk row) and repeat
+            # the codes over run lengths — identical output to
+            # np.unique(tsid_np, return_inverse=True) at a fraction of
+            # the cost (the argsort of 10M u64s was the chunked cold
+            # path's largest single op)
+            if n:
+                new_run = np.empty(n, dtype=bool)
+                new_run[0] = True
+                np.not_equal(tsid_np[1:], tsid_np[:-1], out=new_run[1:])
+                run_idx = np.flatnonzero(new_run)
+                uniq, inv = np.unique(tsid_np[run_idx],
+                                      return_inverse=True)
+                run_lens = np.diff(np.append(run_idx, n))
+                gid = np.repeat(inv.astype(np.int32), run_lens)
+            else:
+                uniq = np.empty(0, dtype=np.uint64)
+                gid = np.empty(0, dtype=np.int32)
             ts_rel = ts_np - int(time_range.start)
-            cap = pad_capacity(n)
-            pad = lambda a, d: np.pad(a.astype(d), (0, cap - n))
             dev = {"uniq": uniq, "gid_host": gid, "ts_rel": ts_rel,
-                   "ts": jnp.asarray(pad(ts_rel, np.int32)),
-                   "gid": jnp.asarray(pad(gid, np.int32)),
-                   "val": jnp.asarray(pad(val_np, np.float32))}
+                   "val_host": val_np}
             if memo is not None:
                 memo["dev"] = dev
         uniq = dev["uniq"]
-        aggs = time_bucket_aggregate(
-            dev["ts"], dev["gid"], dev["val"],
-            n, bucket_ms, num_groups=len(uniq), num_buckets=num_buckets,
-            which=which)
-        host = {k: np.asarray(v) for k, v in aggs.items()}
+        from horaedb_tpu.storage.read import host_agg_default
+
+        if host_agg_default():
+            # numpy twin on host-bound backends (same trade-off as the
+            # reader's _host_agg_ok: bincount beats XLA-CPU's segmented
+            # scatters ~20x and there is no transfer to amortize)
+            host = self._host_bucket_grids(dev["gid_host"], dev["ts_rel"],
+                                           dev["val_host"], len(uniq),
+                                           bucket_ms, num_buckets, which)
+        else:
+            if "ts" not in dev:
+                cap = pad_capacity(n)
+                pad = lambda a, d: np.pad(a.astype(d), (0, cap - n))
+                dev["ts"] = jnp.asarray(pad(dev["ts_rel"], np.int32))
+                dev["gid"] = jnp.asarray(pad(dev["gid_host"], np.int32))
+                dev["val"] = jnp.asarray(pad(dev["val_host"], np.float32))
+            aggs = time_bucket_aggregate(
+                dev["ts"], dev["gid"], dev["val"],
+                n, bucket_ms, num_groups=len(uniq),
+                num_buckets=num_buckets, which=which)
+            host = {k: np.asarray(v) for k, v in aggs.items()}
         if "last" in which:
             # match the pushdown path's grid keys (it emits last_ts only
             # alongside last): per-cell max sample time (absolute ms as

@@ -93,6 +93,14 @@ def _bind(lib: ctypes.CDLL) -> None:
         lib.seahash64_batch.restype = None
         lib.seahash64_batch.argtypes = [ctypes.c_char_p, ctypes.c_void_p,
                                         ctypes.c_size_t, ctypes.c_void_p]
+        lib.chunk_batch_capacity.restype = ctypes.c_longlong
+        lib.chunk_batch_capacity.argtypes = [ctypes.c_void_p,
+                                             ctypes.c_void_p,
+                                             ctypes.c_size_t]
+        lib.chunk_batch_decode.restype = ctypes.c_longlong
+        lib.chunk_batch_decode.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                           ctypes.c_size_t, ctypes.c_void_p,
+                                           ctypes.c_void_p, ctypes.c_void_p]
 
 
 def available() -> bool:
@@ -250,3 +258,78 @@ def seahash64_batch(keys: list[bytes]) -> Optional[np.ndarray]:
     lib.seahash64_batch(buf, offsets.ctypes.data_as(ctypes.c_void_p),
                         len(keys), out.ctypes.data_as(ctypes.c_void_p))
     return out
+
+
+# ---------------------------------------------------------------------------
+# chunk codec batch decode (metric_engine/chunks.py is the spec twin)
+# ---------------------------------------------------------------------------
+
+
+def chunk_decode_batch(payloads):
+    """Decode MANY chunk payloads (one per (series, field) row) in one
+    FFI call: per payload, all chunks decode + stable-sort + last-wins
+    timestamp dedup — bit-identical to chunks.decode_chunks.
+
+    `payloads` is a pyarrow binary Array (zero-copy: the C call reads
+    the array's own offsets + data buffers) or a list of bytes.
+    Returns (ts int64, values f64, counts int64-per-payload) with
+    ts/values concatenated in payload order, or None when the native
+    library is unavailable, the input shape is unsupported, or any
+    payload is malformed (callers fall back to the Python decoder,
+    which raises the precise error)."""
+    lib = _load()
+    if lib is None:
+        return None
+    holder, data_ptr, offsets, n = _payload_buffers(payloads)
+    if data_ptr is None:
+        return None  # unsupported input shape: use the Python decoder
+    if n == 0:
+        return (np.empty(0, np.int64), np.empty(0, np.float64),
+                np.empty(0, np.int64))
+    off_ptr = offsets.ctypes.data_as(ctypes.c_void_p)
+    cap = lib.chunk_batch_capacity(data_ptr, off_ptr, n)
+    if cap < 0:
+        return None
+    ts = np.empty(int(cap), dtype=np.int64)
+    vals = np.empty(int(cap), dtype=np.float64)
+    counts = np.empty(n, dtype=np.int64)
+    total = lib.chunk_batch_decode(
+        data_ptr, off_ptr, n, ts.ctypes.data_as(ctypes.c_void_p),
+        vals.ctypes.data_as(ctypes.c_void_p),
+        counts.ctypes.data_as(ctypes.c_void_p))
+    del holder  # keep the source buffer alive through both FFI calls
+    if total < 0:
+        return None
+    return ts[:int(total)], vals[:int(total)], counts
+
+
+def _payload_buffers(payloads):
+    """(holder, data_ptr, int64 offsets (n+1), n) for the C ABI.
+    `holder` keeps the underlying buffer alive; data_ptr is None when
+    the input shape can't be used (caller falls back to Python).  The
+    pyarrow path is zero-copy: the pointer is the array's own data
+    buffer, and slice offsets are honored via the offsets window."""
+    try:
+        import pyarrow as pa
+    except ImportError:  # pragma: no cover
+        pa = None
+    if pa is not None and isinstance(payloads, pa.ChunkedArray):
+        payloads = payloads.combine_chunks()
+    if pa is not None and isinstance(payloads, pa.Array) and \
+            pa.types.is_binary(payloads.type):
+        if payloads.null_count:
+            return None, None, None, 0
+        _validity, off_buf, data_buf = payloads.buffers()
+        offs = np.frombuffer(off_buf, dtype=np.int32)[
+            payloads.offset:payloads.offset + len(payloads) + 1]
+        return (data_buf, ctypes.c_void_p(data_buf.address),
+                np.ascontiguousarray(offs, dtype=np.int64), len(payloads))
+    if isinstance(payloads, (list, tuple)):
+        lens = np.fromiter((len(p) for p in payloads), dtype=np.int64,
+                           count=len(payloads))
+        offsets = np.zeros(len(payloads) + 1, dtype=np.int64)
+        np.cumsum(lens, out=offsets[1:])
+        buf = np.frombuffer(b"".join(payloads) or b"\x00", dtype=np.uint8)
+        return (buf, buf.ctypes.data_as(ctypes.c_void_p), offsets,
+                len(payloads))
+    return None, None, None, 0

@@ -1,0 +1,104 @@
+"""Composable logical query plan over the merge-scan.
+
+The reference plugs its per-segment MergeExec into arbitrary DataFusion
+ExecutionPlan trees (/root/reference/src/storage/src/read.rs:429-494,
+storage.rs:359-368).  This engine's query surface is three shapes —
+row scan (+filter/project), downsample aggregate, top-k — which used to
+be hardwired in their entry points.  `QueryPlan` is the single internal
+currency instead: every entry point builds one, the storage facade
+executes it, and `describe()` renders the plan text the golden tests
+pin (the analogue of the reference's DisplayableExecutionPlan tests,
+read.rs:575-617).
+
+Deliberately NOT a DataFusion clone: the operator set is the closed set
+the TPU execution actually supports (compiled merge + grid aggregation
++ top-k), so there is no generic optimizer — building a plan IS the
+optimization (pushdown/pruning happen in build_plan, aggregation fuses
+in the reader).
+"""
+
+from __future__ import annotations
+
+import textwrap
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from horaedb_tpu.common.error import ensure
+from horaedb_tpu.storage.read import (
+    AggregateSpec,
+    ScanPlan,
+    ScanRequest,
+    describe_plan,
+)
+
+
+@dataclass(frozen=True)
+class TopKSpec:
+    """Rank groups by one aggregate grid and keep the best k.
+
+    `by` names a grid in the aggregate output (it must be in the
+    spec's `which`); a group's score is that grid's best cell across
+    buckets with data (max for largest=True, min otherwise)."""
+
+    k: int
+    by: str = "max"
+    largest: bool = True
+
+
+@dataclass
+class QueryPlan:
+    """scan -> filter (inside scan) -> aggregate? -> top_k?
+
+    `scan` is the physical merge-scan plan captured at build time: it
+    renders in describe() and serves as the FIRST attempt's plan in
+    execute_plan (one manifest lookup per query); compaction races make
+    it stale, in which case execution replans exactly like any raced
+    scan."""
+
+    scan: ScanPlan
+    request: ScanRequest
+    aggregate: Optional[AggregateSpec] = None
+    top_k: Optional[TopKSpec] = None
+
+    def describe(self) -> str:
+        text = describe_plan(self.scan)
+        if self.aggregate is not None:
+            spec = self.aggregate
+            text = (f"Aggregate: group={spec.group_col}, "
+                    f"ts={spec.ts_col}, value={spec.value_col}, "
+                    f"bucket={spec.bucket_ms}ms, "
+                    f"buckets={spec.num_buckets}, "
+                    f"which={tuple(spec.which)}\n"
+                    + textwrap.indent(text, "  "))
+        if self.top_k is not None:
+            tk = self.top_k
+            text = (f"TopK: k={tk.k}, by={tk.by}, largest={tk.largest}\n"
+                    + textwrap.indent(text, "  "))
+        return text
+
+
+def apply_top_k(group_values: np.ndarray, grids: dict,
+                tk: TopKSpec) -> tuple[np.ndarray, dict]:
+    """Host top-k over finalized grids: by the time grids exist the
+    group axis is small (one row per series), so ranking is a numpy
+    argsort — the device's job was reducing rows to grids, not sorting
+    k scores.  Returns (values, grids) sliced to the k best groups,
+    best first."""
+    ensure(tk.by in grids,
+           f"top-k by {tk.by!r} needs that aggregate in the spec's "
+           f"`which`; have {sorted(grids)}")
+    if not len(group_values):
+        return group_values, grids
+    by = np.asarray(grids[tk.by], dtype=np.float64)
+    count = np.asarray(grids["count"])
+    if tk.largest:
+        score = np.where(count > 0, by, -np.inf).max(axis=1)
+        order = np.argsort(-score, kind="stable")
+    else:
+        score = np.where(count > 0, by, np.inf).min(axis=1)
+        order = np.argsort(score, kind="stable")
+    idx = order[:tk.k]
+    return (np.asarray(group_values)[idx],
+            {name: np.asarray(g)[idx] for name, g in grids.items()})

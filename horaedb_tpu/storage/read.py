@@ -1819,12 +1819,7 @@ class ParquetReader:
         of the device parts kernel."""
         if self.mesh is not None:
             return False
-        import os
-
-        forced = os.environ.get("HORAEDB_HOST_AGG", "")
-        if forced in ("0", "1"):
-            return forced == "1"
-        return jax.default_backend() == "cpu"
+        return host_agg_default()
 
     def _window_device_cols(self, w: encode.DeviceBatch,
                             spec: AggregateSpec, plan: ScanPlan,
@@ -2106,6 +2101,48 @@ _ACC_TS_MIN = jnp.int32(-(2**31))
 _HOST_GRID_MAX_CELLS = 64 << 20
 
 
+def host_agg_default() -> bool:
+    """THE host-vs-device aggregation default, shared by every numpy
+    -twin gate (reader windows, engine chunked downsample): host on the
+    CPU backend, device elsewhere; HORAEDB_HOST_AGG=1/0 forces."""
+    import os
+
+    forced = os.environ.get("HORAEDB_HOST_AGG", "")
+    if forced in ("0", "1"):
+        return forced == "1"
+    return jax.default_backend() == "cpu"
+
+
+def host_cell_grids(cell: np.ndarray, vv: np.ndarray, tsv, ncells: int,
+                    want) -> dict:
+    """Shared host accumulation cores over flat grid cells, used by the
+    window partials below and the engine's chunked downsample twin:
+    {"count" int64, "sum"? f64, "min"? (+inf fill), "max"? (-inf fill),
+    "last"? (lt int64 ts-per-cell with _ACC_TS_MIN fill, li int64
+    position-in-vv per cell with -1 fill)} — callers apply their own
+    empty-cell conventions.  `tsv` is only read for "last"."""
+    out = {"count": np.bincount(cell, minlength=ncells)}
+    if "sum" in want:
+        out["sum"] = np.bincount(cell, weights=vv, minlength=ncells)
+    if "min" in want:
+        mn = np.full(ncells, np.inf)
+        np.minimum.at(mn, cell, vv)
+        out["min"] = mn
+    if "max" in want:
+        mx = np.full(ncells, -np.inf)
+        np.maximum.at(mx, cell, vv)
+        out["max"] = mx
+    if "last" in want:
+        lt = np.full(ncells, int(_ACC_TS_MIN), dtype=np.int64)
+        np.maximum.at(lt, cell, tsv)
+        at_max = tsv == lt[cell]
+        pos = np.flatnonzero(at_max)  # later position wins cell ties
+        li = np.full(ncells, -1, dtype=np.int64)
+        np.maximum.at(li, cell[at_max], pos)
+        out["last"] = (lt, li)
+    return out
+
+
 def _host_window_full_grids(w: encode.DeviceBatch, values: np.ndarray,
                             gid: np.ndarray, epoch: int, phase: int,
                             bucket_ms: int, want: frozenset,
@@ -2138,34 +2175,19 @@ def _host_window_full_grids(w: encode.DeviceBatch, values: np.ndarray,
         return "toobig"
     cell = (gid.astype(np.int64) * W + (A - A0))[valid]
     vv = vals[valid]
-    count = np.bincount(cell, minlength=ncells).astype(
-        np.float32).reshape(g, W)
-    grids = {"count": count}
-    if "sum" in want:
-        grids["sum"] = np.bincount(cell, weights=vv, minlength=ncells
-                                   ).astype(np.float32).reshape(g, W)
-    if "min" in want:
-        # +/-inf identities for untouched cells — masked rows land in
-        # the device kernel's overflow segment, so empty cells read the
-        # segmented op's identity, not the F32_MAX row filler
-        mn = np.full(ncells, np.inf)
-        np.minimum.at(mn, cell, vv)
-        grids["min"] = mn.astype(np.float32).reshape(g, W)
-    if "max" in want:
-        mx = np.full(ncells, -np.inf)
-        np.maximum.at(mx, cell, vv)
-        grids["max"] = mx.astype(np.float32).reshape(g, W)
-    if "last" in want:
-        tsv = ts_abs[valid]
-        lt = np.full(ncells, int(_ACC_TS_MIN), dtype=np.int64)
-        np.maximum.at(lt, cell, tsv)
-        at_max = tsv == lt[cell]
-        rows = np.flatnonzero(valid)[at_max]
-        li = np.full(ncells, -1, dtype=np.int64)
-        np.maximum.at(li, cell[at_max], rows)
+    # +/-inf identities for untouched min/max cells — masked rows land
+    # in the device kernel's overflow segment, so empty cells read the
+    # segmented op's identity, not the F32_MAX row filler
+    cores = host_cell_grids(cell, vv, ts_abs[valid], ncells, want)
+    grids = {"count": cores["count"].astype(np.float32).reshape(g, W)}
+    for k in ("sum", "min", "max"):
+        if k in cores:
+            grids[k] = cores[k].astype(np.float32).reshape(g, W)
+    if "last" in cores:
+        lt, li = cores["last"]
         last = np.zeros(ncells)
         has = li >= 0
-        last[has] = vals[li[has]]
+        last[has] = vv[li[has]]
         grids["last"] = last.astype(np.float32).reshape(g, W)
         grids["last_ts"] = lt.reshape(g, W)
     return A0, grids

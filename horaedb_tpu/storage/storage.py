@@ -225,10 +225,16 @@ class CloudObjectStorage(TimeMergeStorage):
     # the not-yet-yielded segments (bounded retries).
     _SCAN_RETRIES = 3
 
-    async def scan(self, req: ScanRequest) -> AsyncIterator[pa.RecordBatch]:
+    async def scan(self, req: ScanRequest,
+                   first_plan: Optional[ScanPlan] = None
+                   ) -> AsyncIterator[pa.RecordBatch]:
         done: set[int] = set()
         for attempt in range(self._SCAN_RETRIES + 1):
-            plan = await self.build_scan_plan(req)
+            # attempt 0 may reuse a caller-built plan (plan_query):
+            # one manifest lookup per query; a stale plan just races
+            # into the NotFoundError replan below like any other scan
+            plan = (first_plan if attempt == 0 and first_plan is not None
+                    else await self.build_scan_plan(req))
             plan.segments = [s for s in plan.segments
                              if s.segment_start not in done]
             try:
@@ -247,14 +253,16 @@ class CloudObjectStorage(TimeMergeStorage):
                 logger.info("scan raced a compaction (sst vanished); "
                             "replanning remaining segments")
 
-    async def scan_aggregate(self, req: ScanRequest, spec):
+    async def scan_aggregate(self, req: ScanRequest, spec,
+                             first_plan: Optional[ScanPlan] = None):
         """Downsample pushdown: merge + GROUP BY group_col, time(bucket)
         on device; returns (group_values, grids).  See read.AggregateSpec.
         The fused path (single-device host_perm) accumulates into one
         query-global device grid and restarts whole on a compaction
         race; the parts path skips segments completed before the race
         on its replan."""
-        first_plan = await self.build_scan_plan(req)
+        if first_plan is None:
+            first_plan = await self.build_scan_plan(req)
         if self.reader.fused_aggregate_ok(first_plan):
             counted: set = set()  # ops metrics survive restarts
             plan = first_plan
@@ -293,6 +301,37 @@ class CloudObjectStorage(TimeMergeStorage):
         ensure(self.manifest is not None, "storage not opened")
         ssts = await self.manifest.find_ssts(req.range)
         return self.reader.build_plan(ssts, req, keep_builtin=keep_builtin)
+
+    async def plan_query(self, req: ScanRequest, spec=None, top_k=None):
+        """Build the composable QueryPlan every query shape routes
+        through (see storage/plan.py): scan -> aggregate? -> top_k?."""
+        from horaedb_tpu.storage.plan import QueryPlan
+
+        ensure(spec is not None or top_k is None,
+               "top-k requires an aggregate stage")
+        scan = await self.build_scan_plan(req)
+        return QueryPlan(scan=scan, request=req, aggregate=spec,
+                         top_k=top_k)
+
+    def execute_plan(self, qp):
+        """Execute a QueryPlan.  Row-scan plans return the async batch
+        iterator; aggregate plans return an awaitable of
+        (group_values, grids), top-k-sliced when the plan has one.
+        The plan built by plan_query is the first attempt's scan plan —
+        one manifest lookup per query, not two."""
+        from horaedb_tpu.storage.plan import apply_top_k
+
+        if qp.aggregate is None:
+            return self.scan(qp.request, first_plan=qp.scan)
+
+        async def agg():
+            values, grids = await self.scan_aggregate(
+                qp.request, qp.aggregate, first_plan=qp.scan)
+            if qp.top_k is not None:
+                values, grids = apply_top_k(values, grids, qp.top_k)
+            return values, grids
+
+        return agg()
 
     async def compact(self) -> None:
         if self.compact_scheduler is not None:

@@ -849,6 +849,45 @@ class TestChunkedDataMode:
 
         asyncio.run(go())
 
+    def test_chunked_host_and_device_aggregation_match(self, monkeypatch):
+        """HORAEDB_HOST_AGG gates _downsample_arrays between the numpy
+        twin (_host_bucket_grids) and the device time_bucket_aggregate;
+        both must produce the same grids — the device branch would
+        otherwise lose all CPU CI coverage (the host twin is the CPU
+        default)."""
+        def run(forced):
+            monkeypatch.setenv("HORAEDB_HOST_AGG", forced)
+
+            async def go():
+                e = await self._open_chunked()
+                try:
+                    rng = np.random.default_rng(3)
+                    samples = [
+                        sample("cpu", [("h", f"h{int(h)}")],
+                               T0 + int(t) * 60_000, float(v))
+                        for h, t, v in zip(rng.integers(0, 5, 600),
+                                           rng.integers(0, 30, 600),
+                                           rng.random(600) * 50)]
+                    await e.write(samples)
+                    return await e.query_downsample(
+                        "cpu", [], TimeRange.new(T0, T0 + 1_800_000),
+                        bucket_ms=300_000)
+                finally:
+                    await e.close()
+
+            return asyncio.run(go())
+
+        host, dev = run("1"), run("0")
+        assert host["tsids"] == dev["tsids"]
+        assert set(host["aggs"]) == set(dev["aggs"])
+        np.testing.assert_array_equal(np.asarray(host["aggs"]["count"]),
+                                      np.asarray(dev["aggs"]["count"]))
+        for k in host["aggs"]:
+            np.testing.assert_allclose(
+                np.asarray(host["aggs"][k], dtype=np.float64),
+                np.asarray(dev["aggs"][k], dtype=np.float64),
+                rtol=2e-5, atol=1e-5, err_msg=k)
+
     def test_chunked_downsample_parity_with_row_layout_no_row_table(self):
         """The chunked fast path must produce the SAME grids as the row
         layout on identical samples, and must never materialize an

@@ -1,0 +1,167 @@
+"""QueryPlan golden-text + routing tests (storage/plan.py): every query
+shape — row scan, downsample aggregate, top-k — builds one QueryPlan
+and its describe() text is pinned, the analogue of the reference's
+DisplayableExecutionPlan assertions (read.rs:575-617)."""
+
+import asyncio
+
+import numpy as np
+import pyarrow as pa
+
+from horaedb_tpu.metric_engine import Label, MetricEngine, Sample, tsid_of
+from horaedb_tpu.objstore import MemoryObjectStore
+from horaedb_tpu.ops.filter import Eq
+from horaedb_tpu.storage.config import StorageConfig, from_dict
+from horaedb_tpu.storage.plan import TopKSpec, apply_top_k
+from horaedb_tpu.storage.read import AggregateSpec, ScanRequest
+from horaedb_tpu.storage.storage import CloudObjectStorage, WriteRequest
+from horaedb_tpu.storage.types import TimeRange
+
+HOUR = 3_600_000
+T0 = 1_700_000_000_000 - 1_700_000_000_000 % (2 * HOUR)
+
+SCHEMA = pa.schema([("host", pa.string()), ("ts", pa.int64()),
+                    ("cpu", pa.float64())])
+
+
+async def open_storage():
+    cfg = from_dict(StorageConfig, {"scheduler": {"schedule_interval": "1h"}})
+    return await CloudObjectStorage.open(
+        "plandb", HOUR, MemoryObjectStore(), SCHEMA, 2, cfg)
+
+
+def batch(rows):
+    return pa.record_batch(
+        [pa.array([r[0] for r in rows]),
+         pa.array([r[1] for r in rows], type=pa.int64()),
+         pa.array([r[2] for r in rows], type=pa.float64())],
+        schema=SCHEMA)
+
+
+class TestGoldenText:
+    def _plans(self):
+        async def go():
+            s = await open_storage()
+            try:
+                await s.write(WriteRequest(
+                    batch([("a", 1000, 1.0), ("b", 2000, 2.0)]),
+                    TimeRange.new(1000, 2001)))
+                req = ScanRequest(range=TimeRange.new(0, 10_000),
+                                  predicate=Eq("host", "a"))
+                scan_qp = await s.plan_query(req)
+                spec = AggregateSpec(group_col="host", ts_col="ts",
+                                     value_col="cpu", range_start=0,
+                                     bucket_ms=1000, num_buckets=10,
+                                     which=("avg", "max"))
+                agg_qp = await s.plan_query(req, spec=spec)
+                topk_qp = await s.plan_query(
+                    req, spec=spec, top_k=TopKSpec(k=3, by="max"))
+                fid = s.reader and [f.id for seg in scan_qp.scan.segments
+                                    for f in seg.ssts][0]
+                return scan_qp, agg_qp, topk_qp, fid
+            finally:
+                await s.close()
+
+        return asyncio.run(go())
+
+    def test_three_shapes(self):
+        scan_qp, agg_qp, topk_qp, fid = self._plans()
+        scan_text = "\n".join([
+            "MergeScan: mode=Overwrite, keep_builtin=False",
+            "  Segment[start=0]: DeviceMergeDedup",
+            "    Filter: Eq(column='host', value='a')",
+            f"    ParquetScan: files=[{fid}.sst], "
+            "columns=['host', 'ts', 'cpu', '__seq__'], pushdown=yes",
+        ])
+        assert scan_qp.describe() == scan_text
+
+        agg_text = (
+            "Aggregate: group=host, ts=ts, value=cpu, bucket=1000ms, "
+            "buckets=10, which=('avg', 'max')\n"
+            + "\n".join("  " + ln for ln in scan_text.splitlines()))
+        assert agg_qp.describe() == agg_text
+
+        topk_text = ("TopK: k=3, by=max, largest=True\n"
+                     + "\n".join("  " + ln
+                                 for ln in agg_text.splitlines()))
+        assert topk_qp.describe() == topk_text
+
+    def test_topk_requires_aggregate(self):
+        async def go():
+            s = await open_storage()
+            try:
+                await s.write(WriteRequest(
+                    batch([("a", 1000, 1.0)]), TimeRange.new(1000, 1001)))
+                try:
+                    await s.plan_query(
+                        ScanRequest(range=TimeRange.new(0, 2000)),
+                        top_k=TopKSpec(k=1))
+                except Exception as exc:
+                    assert "aggregate" in str(exc)
+                else:
+                    raise AssertionError("plan_query accepted top-k "
+                                         "without an aggregate")
+            finally:
+                await s.close()
+
+        asyncio.run(go())
+
+
+class TestApplyTopK:
+    def test_ranking_and_slicing(self):
+        values = np.array([10, 20, 30, 40], dtype=np.uint64)
+        grids = {
+            "count": np.array([[1, 0], [2, 1], [0, 0], [1, 1]],
+                              dtype=np.float32),
+            "max": np.array([[5.0, 99.0],  # bucket 2 empty: 99 ignored
+                             [7.0, 3.0],
+                             [88.0, 88.0],  # no data anywhere
+                             [1.0, 6.0]], dtype=np.float32),
+        }
+        top_v, top_g = apply_top_k(values, grids, TopKSpec(k=2, by="max"))
+        assert top_v.tolist() == [20, 40]  # scores 7, 6; empty rows lose
+        assert top_g["max"].shape == (2, 2)
+        np.testing.assert_array_equal(top_g["count"],
+                                      [[2, 1], [1, 1]])
+
+    def test_smallest(self):
+        values = np.array([1, 2], dtype=np.uint64)
+        grids = {"count": np.ones((2, 1), np.float32),
+                 "min": np.array([[4.0], [2.0]], np.float32)}
+        v, _ = apply_top_k(values, grids,
+                           TopKSpec(k=1, by="min", largest=False))
+        assert v.tolist() == [2]
+
+
+class TestEngineTopK:
+    def test_query_topk_matches_numpy(self):
+        async def go():
+            e = await MetricEngine.open("tk", MemoryObjectStore(),
+                                        segment_ms=2 * HOUR)
+            try:
+                rng = np.random.default_rng(9)
+                hosts = 20
+                samples = []
+                vals = {}
+                for h in range(hosts):
+                    hv = rng.random(30) * 100
+                    vals[h] = hv.max()
+                    for i, v in enumerate(hv):
+                        samples.append(Sample(
+                            name="cpu",
+                            labels=[Label("host", f"h{h:02d}")],
+                            timestamp=T0 + i * 60_000, value=float(v)))
+                await e.write(samples)
+                out = await e.query_topk(
+                    "cpu", [], TimeRange.new(T0, T0 + HOUR),
+                    bucket_ms=300_000, k=5, by="max", aggs=("max",))
+                want = sorted(vals, key=lambda h: -vals[h])[:5]
+                want_tsids = [int(tsid_of("cpu", [Label("host",
+                                                        f"h{h:02d}")]))
+                              for h in want]
+                assert out["tsids"] == want_tsids  # best first
+                assert np.asarray(out["aggs"]["max"]).shape[0] == 5
+            finally:
+                await e.close()
+
+        asyncio.run(go())

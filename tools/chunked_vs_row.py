@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""Chunked-layout vs row-layout cold downsample at matched row counts.
+
+VERDICT r4 item 8's yardstick: with the native batch chunk decoder, the
+chunked cold path should land within 1.5x of the row-layout cold path.
+Prints one JSON line with both cold p50s and the ratio.
+
+Usage: python tools/chunked_vs_row.py [rows] (default 10M)
+"""
+
+import asyncio
+import json
+import sys
+import time
+
+from horaedb_tpu.utils.cpu_mesh import force_cpu_devices
+
+force_cpu_devices(1)
+
+import numpy as np  # noqa: E402
+import pyarrow as pa  # noqa: E402
+
+from horaedb_tpu.metric_engine import MetricEngine  # noqa: E402
+from horaedb_tpu.objstore import MemoryObjectStore  # noqa: E402
+from horaedb_tpu.storage.config import StorageConfig, from_dict  # noqa: E402
+from horaedb_tpu.storage.types import TimeRange  # noqa: E402
+
+HOUR = 3_600_000
+SEGMENT_MS = 2 * HOUR
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+async def run_one(chunked: bool, rows: int) -> float:
+    hosts = 100
+    interval = 10_000
+    per_host = rows // hosts
+    span = per_host * interval
+    T0 = (1_700_000_000_000 // SEGMENT_MS) * SEGMENT_MS
+    rng = np.random.default_rng(0)
+    n = per_host * hosts
+    ts = T0 + np.repeat(np.arange(per_host, dtype=np.int64) * interval,
+                        hosts)
+    host_id = np.tile(np.arange(hosts, dtype=np.int32), per_host)
+    # 1-decimal gauges: the chunk codec's scaled-int sweet spot
+    vals = np.round(rng.random(n) * 100, 1)
+    names = pa.array([f"host_{i:03d}" for i in range(hosts)])
+
+    cfg = from_dict(StorageConfig, {
+        "scheduler": {"schedule_interval": "1h"},
+        "scan": {"cache_max_rows": rows * 4}})
+    e = await MetricEngine.open("cvr", MemoryObjectStore(),
+                                segment_ms=SEGMENT_MS, config=cfg,
+                                chunked_data=chunked)
+    try:
+        t0 = time.perf_counter()
+        chunk = max(1, 1_000_000 // hosts) * hosts
+        for lo in range(0, n, chunk):
+            hi = min(n, lo + chunk)
+            await e.write_arrow("cpu", ["host"], pa.record_batch({
+                "host": pa.DictionaryArray.from_arrays(
+                    pa.array(host_id[lo:hi]), names),
+                "timestamp": pa.array(ts[lo:hi], type=pa.int64()),
+                "value": pa.array(vals[lo:hi], type=pa.float64()),
+            }))
+        log(f"{'chunked' if chunked else 'row'}: ingest {n:,} rows in "
+            f"{time.perf_counter() - t0:.1f}s")
+
+        async def q():
+            return await e.query_downsample(
+                "cpu", [], TimeRange.new(T0, T0 + span),
+                bucket_ms=60_000, aggs=("avg",))
+
+        out = await q()  # compile/warm
+        assert len(out["tsids"]) == hosts
+        times = []
+        for _ in range(3):
+            if chunked:
+                if e._chunk_cache is not None:
+                    e._chunk_cache.clear()
+            else:
+                e.tables["data"].reader.scan_cache.clear()
+            t0 = time.perf_counter()
+            out = await q()
+            times.append(time.perf_counter() - t0)
+        return float(np.percentile(times, 50))
+    finally:
+        await e.close()
+
+
+def main() -> None:
+    rows = int(sys.argv[1]) if len(sys.argv) > 1 else 10_000_000
+    row_p50 = asyncio.run(run_one(False, rows))
+    chunk_p50 = asyncio.run(run_one(True, rows))
+    out = {
+        "metric": f"chunked vs row cold downsample, {rows / 1e6:.0f}M rows",
+        "row_cold_p50_ms": round(row_p50 * 1e3, 1),
+        "chunked_cold_p50_ms": round(chunk_p50 * 1e3, 1),
+        "chunked_vs_row": round(chunk_p50 / row_p50, 2),
+    }
+    log(f"row cold {row_p50 * 1e3:.0f} ms, chunked cold "
+        f"{chunk_p50 * 1e3:.0f} ms -> {out['chunked_vs_row']}x")
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
