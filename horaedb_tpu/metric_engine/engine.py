@@ -971,6 +971,8 @@ class MetricEngine:
 
         from horaedb_tpu.storage.plan import TopKSpec, apply_top_k
 
+        ensure(by in ALL_AGGS,
+               f"unknown top-k aggregate {by!r}; supported: {ALL_AGGS}")
         which = tuple(sorted(set(aggs) | {by}))
         if self.chunked_data:
             out = await self.query_downsample(metric, filters, time_range,

@@ -219,12 +219,11 @@ def build_app(state: ServerState) -> web.Application:
             if bucket_ms:
                 out = await state.engine.query_downsample(
                     metric, filters, rng, bucket_ms, field=field)
-                aggs = {k: _grid_json(v) for k, v in out["aggs"].items()}
+                body_out = _downsample_json(out)
                 if impl is not None and out["tsids"]:
-                    aggs[fn] = _grid_json(impl(out["aggs"], bucket_ms))
-                return web.json_response({
-                    "tsids": [str(t) for t in out["tsids"]],
-                    "num_buckets": out["num_buckets"], "aggs": aggs})
+                    body_out["aggs"][fn] = _grid_json(
+                        impl(out["aggs"], bucket_ms))
+                return web.json_response(body_out)
             tbl = await state.engine.query(metric, filters, rng, field=field)
             return web.json_response({
                 "tsids": [str(t) for t in tbl.column("tsid").to_pylist()],
@@ -232,6 +231,60 @@ def build_app(state: ServerState) -> web.Application:
                 "values": tbl.column("value").to_pylist()})
         except Error as e:
             return web.json_response({"error": str(e)}, status=400)
+
+    @routes.post("/query_topk")
+    async def query_topk(req: web.Request) -> web.Response:
+        """Top-k series by one aggregate over the window (BASELINE
+        config 4's shape), via the engine's TopK QueryPlan stage.  Body:
+        {metric, filters?, start, end, bucket_ms, k, by?, largest?,
+        field?} — results come back best-first."""
+        try:
+            body = await req.json()
+            metric, filters, rng, field, bucket_ms = _parse_query_body(body)
+            if not bucket_ms:
+                raise ValueError("bucket_ms is required")
+            k = int(body["k"])
+            if k < 1:
+                raise ValueError("k must be >= 1")
+            by = str(body.get("by", "max"))
+            largest = bool(body.get("largest", True))
+        except (KeyError, TypeError, ValueError) as e:
+            return web.json_response({"error": f"bad request: {e}"},
+                                     status=400)
+        try:
+            out = await state.engine.query_topk(
+                metric, filters, rng, bucket_ms, k=k, by=by,
+                largest=largest, field=field)
+        except Error as e:
+            return web.json_response({"error": str(e)}, status=400)
+        return web.json_response(_downsample_json(out))
+
+    @routes.post("/query_multi")
+    async def query_multi(req: web.Request) -> web.Response:
+        """Downsample SEVERAL fields of one metric in one request (one
+        resolve, per-field pushdown scans).  Body: {metric, filters?,
+        start, end, bucket_ms, fields: [..]}; response maps field ->
+        the /query downsample shape."""
+        try:
+            body = await req.json()
+            metric, filters, rng, field, bucket_ms = _parse_query_body(body)
+            if not bucket_ms:
+                raise ValueError("bucket_ms is required")
+            fields = body["fields"]
+            if (not isinstance(fields, list) or not fields
+                    or not all(isinstance(f, str) for f in fields)):
+                raise ValueError("fields must be a non-empty list of "
+                                 "strings")
+        except (KeyError, TypeError, ValueError) as e:
+            return web.json_response({"error": f"bad request: {e}"},
+                                     status=400)
+        try:
+            outs = await state.engine.query_downsample_multi(
+                metric, filters, rng, bucket_ms, fields=fields)
+        except Error as e:
+            return web.json_response({"error": str(e)}, status=400)
+        return web.json_response({f: _downsample_json(out)
+                                  for f, out in outs.items()})
 
     @routes.post("/query_arrow")
     async def query_arrow(req: web.Request) -> web.Response:
@@ -323,6 +376,14 @@ def _grid_json(grid) -> list:
         out.append([None if isinstance(x, float) and math.isnan(x) else x
                     for x in row])
     return out
+
+
+def _downsample_json(out: dict) -> dict:
+    """THE wire shape of a downsample result, shared by /query,
+    /query_topk and /query_multi so the endpoints cannot drift."""
+    return {"tsids": [str(t) for t in out["tsids"]],
+            "num_buckets": out["num_buckets"],
+            "aggs": {k: _grid_json(v) for k, v in out["aggs"].items()}}
 
 
 def _build_store(config: ServerConfig):

@@ -110,6 +110,66 @@ class TestEndpoints:
 
         run(go())
 
+    def test_query_topk(self):
+        async def go():
+            client, _state, engine = await make_client()
+            try:
+                samples = []
+                for h, peak in (("a", 10.0), ("b", 50.0), ("c", 30.0)):
+                    samples += [
+                        {"name": "cpu", "labels": {"host": h},
+                         "timestamp": T0 + i * 60_000,
+                         "value": peak - i} for i in range(5)]
+                await client.post("/write", json={"samples": samples})
+                r = await client.post("/query_topk", json={
+                    "metric": "cpu", "filters": {},
+                    "start": T0, "end": T0 + 600_000,
+                    "bucket_ms": 300_000, "k": 2, "by": "max"})
+                body = await r.json()
+                assert len(body["tsids"]) == 2  # best first: b then c
+                assert body["aggs"]["max"][0][0] == 50.0
+                assert body["aggs"]["max"][1][0] == 30.0
+                # missing k -> 400
+                r = await client.post("/query_topk", json={
+                    "metric": "cpu", "start": T0, "end": T0 + 1,
+                    "bucket_ms": 1000})
+                assert r.status == 400
+            finally:
+                await client.close()
+                await engine.close()
+
+        run(go())
+
+    def test_query_multi_field(self):
+        async def go():
+            client, _state, engine = await make_client()
+            try:
+                samples = []
+                for f, base in (("usage_user", 1.0), ("usage_system", 5.0)):
+                    samples += [
+                        {"name": "cpu", "labels": {"host": "a"},
+                         "timestamp": T0 + i * 60_000,
+                         "value": base + i, "field": f} for i in range(4)]
+                await client.post("/write", json={"samples": samples})
+                r = await client.post("/query_multi", json={
+                    "metric": "cpu", "filters": {},
+                    "start": T0, "end": T0 + 600_000,
+                    "bucket_ms": 600_000,
+                    "fields": ["usage_user", "usage_system"]})
+                body = await r.json()
+                assert set(body) == {"usage_user", "usage_system"}
+                assert body["usage_user"]["aggs"]["sum"] == [[10.0]]
+                assert body["usage_system"]["aggs"]["sum"] == [[26.0]]
+                r = await client.post("/query_multi", json={
+                    "metric": "cpu", "start": T0, "end": T0 + 1,
+                    "bucket_ms": 1000, "fields": []})
+                assert r.status == 400
+            finally:
+                await client.close()
+                await engine.close()
+
+        run(go())
+
     def test_bad_requests(self):
         async def go():
             client, _state, engine = await make_client()
