@@ -231,11 +231,21 @@ def conjunct_leaves(pred, allowed: set) -> Optional[list]:
     leaves or columns outside `allowed` — callers then fall back to the
     expression path (exactly the rows the pushdown would keep must be
     kept, so anything not provably equivalent opts out)."""
+    return conjunct_leaves_ex(pred, allowed)[0]
+
+
+def conjunct_leaves_ex(pred, allowed: set) -> tuple[Optional[list], bool]:
+    """conjunct_leaves plus a `complete` flag: True iff EVERY leaf of
+    the predicate was collected (And-of-leaves shape, all columns in
+    `allowed`) — i.e. the pushed conjunction IS the whole predicate.
+    One walker decides both so the leaf-type list cannot drift."""
     from horaedb_tpu.ops import filter as F
 
     leaves: list = []
+    complete = True
 
     def walk(p) -> bool:
+        nonlocal complete
         if isinstance(p, F.And):
             return all(walk(c) for c in p.children)
         if isinstance(p, (F.Eq, F.Lt, F.Le, F.Gt, F.Ge, F.In,
@@ -243,6 +253,7 @@ def conjunct_leaves(pred, allowed: set) -> Optional[list]:
             if p.column not in allowed:
                 # the arrow pushdown DROPS non-allowed leaves (they are
                 # applied post-merge); mirror that by skipping the leaf
+                complete = False
                 return True
             leaves.append(p)
             return True
@@ -251,12 +262,12 @@ def conjunct_leaves(pred, allowed: set) -> Optional[list]:
         return False
 
     if pred is None:
-        return None
+        return None, False
     if not walk(pred) or not leaves:
         # no constraint survives: unfiltered reads stay on pq.read_table
         # (multithreaded column decode), pruning would add nothing
-        return None
-    return leaves
+        return None, False
+    return leaves, complete
 
 
 def _leaf_vs_stats(leaf, stats) -> str:

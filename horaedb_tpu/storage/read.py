@@ -161,6 +161,21 @@ _REPLAY_SLOTS = 8
 _MEMO_LOCK = threading.Lock()
 
 
+_IOTA_CACHE: dict = {}
+
+
+def _iota(cap: int) -> np.ndarray:
+    """Cached arange per (pow2-bounded, so few distinct) capacity — the
+    validity compare runs per window per query and rebuilding the iota
+    was measurable on the cold path.  Callers must not mutate.  Benign
+    under races: colliding threads store identical arrays."""
+    a = _IOTA_CACHE.get(cap)
+    if a is None:
+        a = np.arange(cap)
+        _IOTA_CACHE[cap] = a
+    return a
+
+
 def _memo_store(w, key, value, nbytes: int) -> None:
     """Byte-bounded per-window memo put.  The scan cache charges each
     window MEMO_SLOTS * (capacity*4 + 128) bytes of memo allowance
@@ -240,6 +255,12 @@ class ScanPlan:
     # flattened conjunction of the same pushed subtree for the
     # stats-pruned decode path (None: shape not prunable, use pushdown)
     prune_leaves: Optional[list] = None
+    # True when the pushed subtree IS the whole predicate (every leaf a
+    # PK leaf in an And shape): the read already filtered exactly these
+    # rows, so post-merge re-evaluation is provably a no-op and the
+    # window paths skip it (PK leaves cannot interact with last-value
+    # dedup; value-column leaves — which can — force this False)
+    pushed_complete: bool = False
     # compaction scans set this False: their input SST sets are deleted
     # right after, so caching them only evicts hot query entries
     use_cache: bool = True
@@ -353,11 +374,13 @@ class ParquetReader:
         if request.predicate is not None:
             pushdown, pushdown_key = filter_ops.to_arrow_expression_with_key(
                 request.predicate, allowed)
+        prune_leaves, pushed_complete = parquet_io.conjunct_leaves_ex(
+            request.predicate, allowed)
         return ScanPlan(segments=segments, mode=self.schema.update_mode,
                         predicate=request.predicate, keep_builtin=keep_builtin,
                         pushdown=pushdown, pushdown_key=pushdown_key,
-                        prune_leaves=parquet_io.conjunct_leaves(
-                            request.predicate, allowed),
+                        prune_leaves=prune_leaves,
+                        pushed_complete=pushed_complete,
                         use_cache=use_cache, pool=pool, range=request.range)
 
     # ---- execution ---------------------------------------------------------
@@ -1236,9 +1259,11 @@ class ParquetReader:
                          plan: ScanPlan) -> Optional[pa.RecordBatch]:
         # Predicates apply AFTER dedup: filtering before would break
         # last-value semantics when the predicate touches value columns
-        # (a filtered-out newer row must still shadow an older row).
+        # (a filtered-out newer row must still shadow an older row) —
+        # PK-only predicates can't, so a fully-pushed plan skips the
+        # re-evaluation (the read already filtered exactly these rows).
         k = out_batch.n_valid
-        if plan.predicate is not None:
+        if plan.predicate is not None and not plan.pushed_complete:
             mask = filter_ops.eval_predicate(plan.predicate, out_batch)
             sel = np.flatnonzero(np.asarray(mask)[:k])
             arrow = encode.decode_to_arrow(out_batch, names=out_names)
@@ -1670,13 +1695,13 @@ class ParquetReader:
         cap = out_batch.capacity
         if k == 0:
             return None
-        keep = np.arange(cap) < k
+        keep = _iota(cap) < k
         mask_all = True
-        if plan.predicate is not None:
+        if plan.predicate is not None and not plan.pushed_complete:
             mask = np.asarray(
                 filter_ops.eval_predicate(plan.predicate, out_batch))
             mask_all = bool(mask[:k].all())
-            keep &= mask
+            keep = keep & mask
             # fully-filtered window: empty result, NOT an encoding error
             # (the ensure below must only fire for windows with rows)
             if not mask_all and not keep.any():
@@ -2086,7 +2111,9 @@ class ParquetReader:
         # explicit indices: a projection may have reordered columns
         merged = op.merge_sorted_batch(
             batch, pk_indices=[names.index(n) for n in pk_names])
-        if plan.predicate is not None:
+        # fully-pushed PK-only predicates were applied at read time and
+        # cannot interact with the merge — same skip as the window paths
+        if plan.predicate is not None and not plan.pushed_complete:
             mask = _eval_predicate_host(plan.predicate, merged)
             merged = merged.filter(pa.array(mask))
         return merged

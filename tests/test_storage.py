@@ -255,6 +255,78 @@ class TestPlanShape:
         asyncio.run(go())
 
 
+class TestPushedComplete:
+    """A fully-pushed (PK-only And) predicate skips the post-merge
+    re-evaluation; anything else must not.  The skip is provably a
+    no-op only while build_plan, conjunct_leaves_ex and the read paths
+    agree on the pushed leaf set — these tests pin that agreement."""
+
+    def test_flag_shapes(self):
+        from horaedb_tpu.ops.filter import And, Ge, Or
+
+        async def go():
+            s = await open_storage()
+            try:
+                await s.write(WriteRequest(
+                    make_batch([("a", 1000, 1.0)]), TimeRange.new(1000, 1001)))
+                pk_only = await s.build_scan_plan(ScanRequest(
+                    range=TimeRange.new(0, 10_000),
+                    predicate=And((Eq("host", "a"),
+                                   TimeRangePred("ts", 0, 10_000)))))
+                assert pk_only.pushed_complete
+                with_value = await s.build_scan_plan(ScanRequest(
+                    range=TimeRange.new(0, 10_000),
+                    predicate=And((Eq("host", "a"), Ge("cpu", 1.0)))))
+                assert not with_value.pushed_complete
+                disjunct = await s.build_scan_plan(ScanRequest(
+                    range=TimeRange.new(0, 10_000),
+                    predicate=Or((Eq("host", "a"), Eq("host", "b")))))
+                assert not disjunct.pushed_complete
+                no_pred = await s.build_scan_plan(ScanRequest(
+                    range=TimeRange.new(0, 10_000)))
+                assert not no_pred.pushed_complete
+            finally:
+                await s.close()
+
+        asyncio.run(go())
+
+    def test_skip_returns_identical_rows(self):
+        import dataclasses
+
+        async def go():
+            s = await open_storage()
+            try:
+                # overlapping writes: dedup actually has work to do
+                await s.write(WriteRequest(
+                    make_batch([("a", 1000, 1.0), ("b", 2000, 2.0)]),
+                    TimeRange.new(1000, 2001)))
+                await s.write(WriteRequest(
+                    make_batch([("a", 1000, 9.0), ("c", 1500, 3.0)]),
+                    TimeRange.new(1000, 1501)))
+                req = ScanRequest(range=TimeRange.new(0, 10_000),
+                                  predicate=Eq("host", "a"))
+                plan = await s.build_scan_plan(req)
+                assert plan.pushed_complete
+                forced = dataclasses.replace(plan, pushed_complete=False)
+
+                async def rows(p):
+                    out = []
+                    async for _seg, b in s.reader.execute_segments(p):
+                        if b is not None:
+                            out.extend(zip(b.column("host").to_pylist(),
+                                           b.column("ts").to_pylist(),
+                                           b.column("cpu").to_pylist()))
+                    return sorted(out)
+
+                got_skip = await rows(plan)
+                got_eval = await rows(forced)
+                assert got_skip == got_eval == [("a", 1000, 9.0)]
+            finally:
+                await s.close()
+
+        asyncio.run(go())
+
+
 def mkfile(fid, start, end, size=100):
     f = SstFile(fid, FileMeta(max_sequence=fid, num_rows=10, size=size,
                               time_range=TimeRange.new(start, end)))
