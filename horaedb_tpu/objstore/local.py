@@ -91,8 +91,14 @@ class LocalObjectStore(ObjectStore):
         def _get_range() -> bytes:
             try:
                 with open(self._fs_path(path), "rb") as f:
+                    # clamp to the file size: read(count) PREALLOCATES
+                    # count bytes, so a past-EOF range (callers use it
+                    # for "the rest of the object") must not allocate
+                    # the nominal span
+                    f.seek(0, 2)
+                    size = f.tell()
                     f.seek(start)
-                    return f.read(max(0, end - start))
+                    return f.read(max(0, min(end, size) - start))
             except FileNotFoundError:
                 raise NotFoundError(f"object not found: {path}") from None
 

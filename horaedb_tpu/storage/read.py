@@ -806,10 +806,18 @@ class ParquetReader:
         None (→ parquet fallback) when any SST lacks a valid sidecar."""
         if any(f.id in self._sidecar_missing for f in seg.ssts):
             return None  # known-missing sidecar: skip the GETs entirely
+        leaves = plan.prune_leaves
+        want = set(seg.columns) | {lf.column for lf in leaves or []}
+
+        def runner(fn, *args):  # CPU-bound deserialize off the loop
+            return self._run_pool(plan.pool, fn, *args)
+
         got = await asyncio.gather(*(
-            self.store.get(sidecar.sidecar_path(self.root_path, f.id))
+            sidecar.load_sst_encoded(
+                self.store, sidecar.sidecar_path(self.root_path, f.id),
+                want, leaves, runner=runner)
             for f in seg.ssts), return_exceptions=True)
-        bufs = []
+        parts = []
         for f, res in zip(seg.ssts, got):
             if isinstance(res, NotFoundError):
                 # permanent for this id (SSTs/ids are immutable and the
@@ -824,13 +832,18 @@ class ParquetReader:
                 logger.warning("sidecar fetch failed for sst %s: %s",
                                f.id, res)
                 return None
-            bufs.append(res)
+            if res is None:
+                self._memo_sidecar_missing((f.id,))
+                logger.warning("invalid sidecar for sst %s; using "
+                               "parquet", f.id)
+                return None
+            parts.append(res)
         try:
             es = await self._run_pool(
-                plan.pool, sidecar.assemble_segment, bufs,
-                list(seg.columns), plan.prune_leaves)
+                plan.pool, sidecar.assemble_parts, parts,
+                list(seg.columns), leaves)
         except Exception as exc:  # noqa: BLE001 — cache read only
-            # a blob that parses but is internally inconsistent can blow
+            # a part that parses but is internally inconsistent can blow
             # up deep in eval/concat; the contract is fallback, not
             # failure
             logger.warning("sidecar assembly raised for segment %s: %s",

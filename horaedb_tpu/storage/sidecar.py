@@ -41,12 +41,19 @@ from typing import Optional
 import numpy as np
 import pyarrow as pa
 
+from horaedb_tpu.objstore import NotFoundError
 from horaedb_tpu.ops import encode
 from horaedb_tpu.storage.types import RESERVED_COLUMN_NAME
 
 _MAGIC = b"HDTPENC1"
 _VERSION = 1
 _ALIGN = 16
+
+# per-column block statistics granularity: each int32 column records
+# min/max per block of this many rows, enabling the loader to fetch
+# only candidate byte ranges for selective (point-query) leaf sets —
+# the sidecar's analogue of parquet row-group pruning
+BLOCK_ROWS = 65536
 
 SIDECAR_SUFFIX = ".enc"
 
@@ -127,6 +134,22 @@ def serialize(columns: dict, n_rows: int) -> Optional[bytes]:
                 "arrow": str(enc.arrow_type), "epoch": int(enc.epoch),
                 "section": len(sections)}
         sections.append(np.ascontiguousarray(arr).tobytes())
+        if arr.dtype == np.int32 and n_rows:
+            # per-block min/max over the ENCODED values (codes/offsets
+            # are order-preserving, so leaf constants translate into
+            # this space); one i32 section [mins..., maxes...]
+            nblocks = -(-n_rows // BLOCK_ROWS)
+            pad_to = nblocks * BLOCK_ROWS
+            # pad the tail block with its own LAST value: the pad then
+            # lies inside the tail's true [min, max], keeping its stats
+            # exact (padding with arr[0] would widen a sorted column's
+            # tail to the whole value range and defeat pruning)
+            blk = np.full(pad_to, arr[n_rows - 1], dtype=np.int32)
+            blk[:n_rows] = arr
+            blk = blk.reshape(nblocks, BLOCK_ROWS)
+            stats = np.concatenate([blk.min(axis=1), blk.max(axis=1)])
+            meta["bstats_section"] = len(sections)
+            sections.append(stats.astype(np.int32).tobytes())
         if enc.kind == "dict":
             ds = _dict_sections(enc.dictionary)
             if ds is None:
@@ -173,6 +196,33 @@ def build(batch: pa.RecordBatch) -> Optional[bytes]:
 # ---------------------------------------------------------------------------
 
 
+def _parse_header(buf) -> Optional[tuple[dict, int]]:
+    """(header, data_start) or None.  `buf` must contain at least the
+    whole header (magic + length + JSON)."""
+    try:
+        if len(buf) < 12 or buf[:8] != _MAGIC:
+            return None
+        (header_len,) = struct.unpack_from("<I", buf, 8)
+        if len(buf) < 12 + header_len:
+            return None
+        header = json.loads(bytes(buf[12:12 + header_len]).decode("utf-8"))
+        if header.get("version") != _VERSION:
+            return None
+        data_start = -(-(12 + header_len) // _ALIGN) * _ALIGN
+        return header, data_start
+    except (KeyError, ValueError, struct.error, UnicodeDecodeError):
+        return None
+
+
+def header_span(buf_head: bytes) -> Optional[int]:
+    """Total header bytes (magic + length + JSON) from the blob's first
+    bytes, or None when they aren't a sidecar prefix."""
+    if len(buf_head) < 12 or buf_head[:8] != _MAGIC:
+        return None
+    (header_len,) = struct.unpack_from("<I", buf_head, 8)
+    return 12 + header_len
+
+
 def deserialize(buf: bytes,
                 want: Optional[set] = None) -> Optional[tuple[dict, int]]:
     """Parse a sidecar blob into ({name: (np view, ColumnEncoding)},
@@ -180,14 +230,11 @@ def deserialize(buf: bytes,
     which columns materialize (None = all); a wanted column missing from
     the file returns None (caller falls back to parquet)."""
     try:
-        if len(buf) < 12 or buf[:8] != _MAGIC:
+        parsed = _parse_header(buf)
+        if parsed is None:
             return None
-        (header_len,) = struct.unpack_from("<I", buf, 8)
-        header = json.loads(buf[12:12 + header_len].decode("utf-8"))
-        if header.get("version") != _VERSION:
-            return None
+        header, data_start = parsed
         n_rows = int(header["n_rows"])
-        data_start = -(-(12 + header_len) // _ALIGN) * _ALIGN
         offsets = header["sections"]
         by_name = {m["name"]: m for m in header["columns"]}
         names = list(by_name) if want is None else [n for n in want]
@@ -381,12 +428,9 @@ class EncodedSegment:
 
 def assemble_segment(bufs: list[bytes], columns: list,
                      leaves: Optional[list]) -> Optional[EncodedSegment]:
-    """Parse one segment's sidecar blobs, apply the pruned-read leaf
-    conjunction per SST (row-level equivalent to the parquet path's
-    read_pruned / filters=pushdown), and concatenate the runs.  None on
-    any parse/shape problem — the caller falls back to parquet."""
-    from horaedb_tpu.ops import filter as filter_ops
-
+    """Parse one segment's sidecar blobs and assemble (see
+    assemble_parts).  None on any parse/shape problem — the caller
+    falls back to parquet."""
     leaves = leaves or []
     want = set(columns) | {lf.column for lf in leaves}
     parts = []
@@ -394,7 +438,21 @@ def assemble_segment(bufs: list[bytes], columns: list,
         got = deserialize(buf, want)
         if got is None:
             return None
-        cols, n = got
+        parts.append(got)
+    return assemble_parts(parts, columns, leaves)
+
+
+def assemble_parts(parts: list, columns: list,
+                   leaves: Optional[list]) -> Optional[EncodedSegment]:
+    """Apply the pruned-read leaf conjunction per SST part (row-level
+    equivalent to the parquet path's read_pruned / filters=pushdown) and
+    concatenate the runs in SST order.  `parts` are (cols, n) pairs as
+    returned by deserialize()/load_sst_encoded()."""
+    from horaedb_tpu.ops import filter as filter_ops
+
+    leaves = leaves or []
+    out_parts = []
+    for cols, n in parts:
         if leaves and n:
             batch = encode.DeviceBatch(
                 columns={nm: a for nm, (a, _) in cols.items()},
@@ -405,10 +463,281 @@ def assemble_segment(bufs: list[bytes], columns: list,
             if not mask.all():
                 idx = np.flatnonzero(mask)
                 cols = {nm: (a[idx], e) for nm, (a, e) in cols.items()}
-        parts.append({nm: cols[nm] for nm in columns})
-    cc = concat_encoded(parts, list(columns))
+        out_parts.append({nm: cols[nm] for nm in columns})
+    cc = concat_encoded(out_parts, list(columns))
     if cc is None:
         return None
     out_cols, out_encs, n_total = cc
     return EncodedSegment(columns=out_cols, encodings=out_encs,
                           n=n_total, names=list(columns))
+
+
+# ---------------------------------------------------------------------------
+# selective fetch (block pruning) — the sidecar's analogue of parquet
+# row-group pruning for point queries on remote stores
+# ---------------------------------------------------------------------------
+
+_HEAD_BYTES = 64 << 10
+# below this object size a whole-object GET beats extra round trips
+_PARTIAL_MIN_BYTES = 1 << 20
+# above this surviving-row fraction the partial fetch saves too little
+# (range reads cost extra round trips; at half the bytes they still
+# win — a point-query run straddling a block boundary keeps 2 blocks,
+# which must stay under this at the common 4-8 block SST sizes)
+_PARTIAL_MAX_FRAC = 0.5
+
+
+def _block_mask_for_leaf(leaf, enc, mins: np.ndarray,
+                         maxs: np.ndarray) -> Optional[np.ndarray]:
+    """Conservative per-block MAY-match mask for one leaf over encoded
+    -space block stats; None = this leaf cannot prune.  The inequality
+    forms mirror ops.filter.eval_predicate exactly (dict codes have no
+    '<=' constant, hence the side-specific thresholds)."""
+    from horaedb_tpu.ops import filter as F
+    from horaedb_tpu.ops.filter import (
+        _const_code_exact,
+        _const_code_lower,
+        _const_code_upper,
+    )
+
+    if isinstance(leaf, F.Eq):
+        c = _const_code_exact(enc, leaf.value)
+        if c is None:
+            return np.zeros(len(mins), dtype=bool)
+        return (mins <= c) & (c <= maxs)
+    if isinstance(leaf, F.In):
+        codes = sorted(c for c in (_const_code_exact(enc, v)
+                                   for v in leaf.values) if c is not None)
+        if not codes:
+            return np.zeros(len(mins), dtype=bool)
+        arr = np.asarray(codes)
+        idx = np.searchsorted(arr, mins)
+        ok = idx < len(arr)
+        out = np.zeros(len(mins), dtype=bool)
+        out[ok] = arr[np.minimum(idx[ok], len(arr) - 1)] <= maxs[ok]
+        return out
+    if isinstance(leaf, F.Lt):
+        return mins < _const_code_lower(enc, leaf.value)
+    if isinstance(leaf, F.Le):
+        t = _const_code_upper(enc, leaf.value)
+        return mins < t if enc.kind == "dict" else mins <= t
+    if isinstance(leaf, F.Gt):
+        if enc.kind == "dict":
+            return maxs >= _const_code_upper(enc, leaf.value)
+        return maxs > _const_code_lower(enc, leaf.value)
+    if isinstance(leaf, F.Ge):
+        return maxs >= _const_code_lower(enc, leaf.value)
+    if isinstance(leaf, F.TimeRangePred):
+        lo = _const_code_lower(enc, leaf.start)
+        hi = _const_code_lower(enc, leaf.end)
+        return (maxs >= lo) & (mins < hi)
+    return None
+
+
+class _Sections:
+    """Byte-range reader over one sidecar object with a tiny per-query
+    cache, so a dictionary needed by both the pruning loop and the
+    column load downloads once."""
+
+    def __init__(self, store, path: str, data_start: int):
+        self.store = store
+        self.path = path
+        self.data_start = data_start
+        self._cache: dict = {}
+        # decoded ColumnEncoding per column name — a leaf column that is
+        # also a wanted column builds its (possibly large) dictionary
+        # exactly once per SST load
+        self.enc_cache: dict = {}
+
+    async def fetch(self, offset: int, nbytes: int) -> bytes:
+        key = (offset, nbytes)
+        got = self._cache.get(key)
+        if got is None:
+            lo = self.data_start + offset
+            got = await self.store.get_range(self.path, lo, lo + nbytes)
+            if nbytes <= (4 << 20):  # don't pin column-sized ranges
+                self._cache[key] = got
+        return got
+
+
+def _decode_blob_dict(offs: np.ndarray, blob: bytes,
+                      is_binary: bool) -> np.ndarray:
+    out = np.empty(len(offs) - 1, dtype=object)
+    for i in range(len(out)):
+        piece = blob[int(offs[i]):int(offs[i + 1])]
+        out[i] = piece if is_binary else piece.decode("utf-8")
+    return out
+
+
+async def _dict_for(meta: dict, header: dict, secs: _Sections,
+                    runner=None) -> Optional[np.ndarray]:
+    offsets = header["sections"]
+    dlen = int(meta.get("dict_len", -1))
+    sec = meta.get("dict_section")
+    if sec is None or dlen < 0:
+        return None
+    if meta.get("dict_kind") == "i64":
+        raw = await secs.fetch(offsets[sec], dlen * 8)
+        return np.frombuffer(raw, dtype=np.int64, count=dlen)
+    if meta.get("dict_kind") == "blob":
+        raw = await secs.fetch(offsets[sec], (dlen + 1) * 4)
+        offs = np.frombuffer(raw, dtype=np.int32, count=dlen + 1)
+        blob = await secs.fetch(offsets[sec + 1], int(offs[-1]))
+        is_binary = meta["arrow"] == "binary"
+        if runner is not None:
+            # per-entry Python decode loop: CPU-bound, off the loop
+            return await runner(_decode_blob_dict, offs, blob, is_binary)
+        return _decode_blob_dict(offs, blob, is_binary)
+    return None
+
+
+async def _encoding_for(meta: dict, header: dict, secs: _Sections,
+                        runner=None):
+    cached = secs.enc_cache.get(meta["name"])
+    if cached is not None:
+        return cached
+    arrow_t = _ARROW_TYPES.get(meta["arrow"])
+    if arrow_t is None:
+        return None
+    if meta["kind"] == "offset":
+        enc = encode.ColumnEncoding("offset", arrow_t,
+                                    epoch=int(meta["epoch"]))
+    elif meta["kind"] == "numeric":
+        enc = encode.ColumnEncoding("numeric", arrow_t)
+    else:
+        dictionary = await _dict_for(meta, header, secs, runner)
+        if dictionary is None:
+            return None
+        enc = encode.ColumnEncoding("dict", arrow_t,
+                                    dictionary=dictionary)
+    secs.enc_cache[meta["name"]] = enc
+    return enc
+
+
+async def load_sst_encoded(store, path: str, want: set,
+                           leaves: Optional[list], runner=None):
+    """Fetch one SST's sidecar columns as ({name: (arr, enc)}, n_rows).
+
+    When the leaf conjunction is selective, per-block stats narrow the
+    fetch to candidate ROW ranges via store.get_range — whole columns
+    are never downloaded for a point query over a big SST.  Pruning is
+    conservative (block granularity); assemble_parts' exact leaf mask
+    still applies after.  Falls back to a whole-object read (reusing
+    the probed head bytes) when pruning cannot help.  `runner`
+    (async callable(fn, *args), e.g. a worker-pool dispatch) carries
+    the CPU-bound deserialize so callers keep it off the event loop.
+    None = invalid sidecar (caller falls back to parquet);
+    NotFoundError propagates."""
+    async def _des(buf):
+        if runner is None:
+            return deserialize(buf, want)
+        return await runner(deserialize, buf, want)
+
+    async def _rest(head_bytes):
+        # reuse the probed head: fetch only the remainder.  Memory/local
+        # stores clamp past-EOF ranges; S3 rejects start==size with 416,
+        # so any range error degrades to one whole GET (correctness
+        # first, the saved head is merely an optimization)
+        try:
+            rest = await store.get_range(path, len(head_bytes),
+                                         len(head_bytes) + (1 << 40))
+        except NotFoundError:
+            raise
+        except Exception:
+            return await store.get(path)
+        return bytes(head_bytes) + bytes(rest)
+
+    leaves = leaves or []
+    if not leaves:
+        # nothing to prune with: one whole-object GET, no header probe
+        return await _des(await store.get(path))
+    head = await store.get_range(path, 0, _HEAD_BYTES)
+    if len(head) < _HEAD_BYTES:
+        # short read = the WHOLE object is already in hand
+        return await _des(head)
+    span = header_span(head)
+    if span is not None and span > len(head):
+        head = bytes(head) + bytes(
+            await store.get_range(path, len(head), span))
+    parsed = _parse_header(head)
+    if parsed is None:
+        # not a (readable) header: a full read preserves the corrupt
+        # -blob fallback semantics
+        return await _des(await _rest(head))
+    header, data_start = parsed
+    n_rows = int(header["n_rows"])
+    by_name = {m["name"]: m for m in header["columns"]}
+    if any(nm not in by_name for nm in want):
+        return None
+    offsets = header["sections"]
+    approx_bytes = data_start + (max(offsets) if offsets else 0)
+    nblocks = -(-n_rows // BLOCK_ROWS) if n_rows else 0
+    # leaf columns are always in `want` (callers build it that way), so
+    # their presence was already vetted by the want check above
+    prunable = (leaves and nblocks > 1
+                and approx_bytes >= _PARTIAL_MIN_BYTES)
+    if not prunable:
+        return await _des(await _rest(head))
+
+    secs = _Sections(store, path, data_start)
+    mask = np.ones(nblocks, dtype=bool)
+    pruned_any = False
+    for leaf in leaves:
+        meta = by_name[leaf.column]
+        if "bstats_section" not in meta:
+            continue
+        enc = await _encoding_for(meta, header, secs, runner)
+        if enc is None:
+            return await _des(await _rest(head))
+        raw = await secs.fetch(offsets[meta["bstats_section"]],
+                               nblocks * 8)
+        stats = np.frombuffer(raw, dtype=np.int32, count=2 * nblocks)
+        lm = _block_mask_for_leaf(leaf, enc, stats[:nblocks],
+                                  stats[nblocks:])
+        if lm is not None:
+            mask &= lm
+            pruned_any = True
+    kept = int(mask.sum())
+    if (not pruned_any or kept == nblocks
+            or kept * BLOCK_ROWS > _PARTIAL_MAX_FRAC * n_rows):
+        return await _des(await _rest(head))
+
+    # contiguous surviving-block runs -> row ranges
+    ranges: list[tuple[int, int]] = []
+    b = 0
+    while b < nblocks:
+        if not mask[b]:
+            b += 1
+            continue
+        b0 = b
+        while b < nblocks and mask[b]:
+            b += 1
+        ranges.append((b0 * BLOCK_ROWS, min(b * BLOCK_ROWS, n_rows)))
+    total = sum(hi - lo for lo, hi in ranges)
+
+    import asyncio
+
+    async def load_col(name: str):
+        meta = by_name[name]
+        dtype = _NP_DTYPES.get(meta["dtype"])
+        enc = await _encoding_for(meta, header, secs, runner)
+        if dtype is None or enc is None:
+            return name, None
+        base = offsets[meta["section"]]
+        chunks = await asyncio.gather(*(
+            secs.fetch(base + 4 * lo, 4 * (hi - lo)) for lo, hi in ranges))
+        arrs = [np.frombuffer(c, dtype=dtype) for c in chunks]
+        if not arrs:
+            # every block pruned (key absent from this SST): a valid
+            # EMPTY part, not an error — concat/assemble handle it
+            return name, (np.empty(0, dtype=dtype), enc)
+        return name, (np.concatenate(arrs) if len(arrs) > 1 else arrs[0],
+                      enc)
+
+    loaded = await asyncio.gather(*(load_col(nm) for nm in want))
+    cols = {}
+    for name, got in loaded:
+        if got is None:
+            return None
+        cols[name] = got
+    return cols, total

@@ -262,3 +262,136 @@ class TestEngineParity:
                 await e.close()
 
         asyncio.run(go())
+
+
+class TestBlockPruning:
+    """load_sst_encoded must fetch only candidate row blocks for
+    selective leaves — and stay row-level equivalent to the full load
+    (the exact leaf mask still applies in assemble_parts)."""
+
+    def _make(self, n=450_000, groups=500):
+        rng = np.random.default_rng(13)
+        tsid = np.sort(rng.integers(0, 1 << 62, groups).astype(np.uint64)
+                       [rng.integers(0, groups, n)])
+        ts = np.empty(n, dtype=np.int64)
+        # ts ascending within each tsid run (PK order), global walk
+        ts[:] = T0 + np.arange(n, dtype=np.int64) % (4 * HOUR)
+        order = np.lexsort((ts, tsid))
+        batch = pa.record_batch({
+            "tsid": pa.array(tsid[order], type=pa.uint64()),
+            "timestamp": pa.array(np.sort(ts)[order] % (4 * HOUR) + T0,
+                                  type=pa.int64()),
+            "value": pa.array(rng.random(n), type=pa.float64()),
+            "__seq__": pa.array(np.full(n, 9, dtype=np.uint64)),
+        })
+        blob = sidecar.build(batch)
+        assert blob is not None and len(blob) > 1 << 20
+        return batch, blob
+
+    def _store(self, blob):
+        import asyncio
+
+        from horaedb_tpu.objstore import MemoryObjectStore
+
+        class CountingStore(MemoryObjectStore):
+            def __init__(self):
+                super().__init__()
+                self.get_bytes = 0
+                self.range_bytes = 0
+                self.full_gets = 0
+
+            async def get(self, path):
+                b = await super().get(path)
+                self.get_bytes += len(b)
+                self.full_gets += 1
+                return b
+
+            async def get_range(self, path, start, end):
+                # bypass MemoryObjectStore's get()-based range impl so
+                # range reads don't count as full GETs
+                data = await MemoryObjectStore.get(self, path)
+                b = data[start:end]
+                self.range_bytes += len(b)
+                return b
+
+        store = CountingStore()
+        asyncio.run(store.put("s/data/1.enc", blob))
+        return store
+
+    def _load(self, store, leaves):
+        import asyncio
+
+        want = {"tsid", "timestamp", "value", "__seq__"}
+        return asyncio.run(sidecar.load_sst_encoded(
+            store, "s/data/1.enc", want, leaves))
+
+    def test_point_leaf_parity_and_fewer_bytes(self):
+        from horaedb_tpu.ops.filter import In
+
+        batch, blob = self._make()
+        full = sidecar.deserialize(blob)
+        assert full is not None
+        # pick a tsid from the middle of the file
+        target = int(batch.column("tsid")[len(batch) // 2].as_py())
+        leaves = [In("tsid", [target])]
+        store = self._store(blob)
+        got = self._load(store, leaves)
+        assert got is not None
+        cols, n = got
+        assert 0 < n < batch.num_rows  # pruned, conservatively
+        # exact equivalence AFTER the leaf mask
+        es_pruned = sidecar.assemble_parts(
+            [got], ["tsid", "timestamp", "value", "__seq__"], leaves)
+        es_full = sidecar.assemble_parts(
+            [full], ["tsid", "timestamp", "value", "__seq__"], leaves)
+        assert es_pruned.n == es_full.n > 0
+        for nm in es_full.names:
+            a, b = es_pruned.columns[nm], es_full.columns[nm]
+            ea, eb = es_pruned.encodings[nm], es_full.encodings[nm]
+            if ea.kind == "dict":
+                np.testing.assert_array_equal(ea.dictionary[a],
+                                              eb.dictionary[b])
+            elif ea.kind == "offset":
+                np.testing.assert_array_equal(
+                    a.astype(np.int64) + ea.epoch,
+                    b.astype(np.int64) + eb.epoch)
+            else:
+                np.testing.assert_array_equal(a, b)
+        # the point query must NOT download the whole object
+        assert store.full_gets == 0
+        assert store.range_bytes < len(blob) // 2
+
+    def test_unselective_leaf_falls_back_to_whole_read(self):
+        from horaedb_tpu.ops.filter import Ge
+
+        batch, blob = self._make()
+        store = self._store(blob)
+        got = self._load(store, [Ge("timestamp", T0)])  # matches all
+        assert got is not None and got[1] == batch.num_rows
+        # pruning saved nothing -> whole object read, reusing the
+        # probed head (no separate full GET)
+        assert store.full_gets == 0
+        assert store.range_bytes >= len(blob)
+
+    def test_absent_key_returns_empty_part(self):
+        from horaedb_tpu.ops.filter import Eq
+
+        batch, blob = self._make()
+        store = self._store(blob)
+        # a tsid NOT in this SST's dictionary: every block prunes away
+        # and the loader returns a valid EMPTY part, not an error
+        got = self._load(store, [Eq("tsid", 12345)])
+        assert got is not None and got[1] == 0
+        es = sidecar.assemble_parts(
+            [got], ["tsid", "timestamp", "value", "__seq__"],
+            [Eq("tsid", 12345)])
+        assert es is not None and es.n == 0
+        assert store.full_gets == 0
+        assert store.range_bytes < len(blob) // 4
+
+    def test_no_leaves_full_get(self):
+        _batch, blob = self._make()
+        store = self._store(blob)
+        got = self._load(store, [])
+        assert got is not None and got[1] == _batch.num_rows
+        assert store.full_gets == 1
