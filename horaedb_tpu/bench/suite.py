@@ -170,9 +170,102 @@ def run_config2(rows: int, iters: int) -> dict:
     np.testing.assert_allclose(np.asarray(out["max"])[0][occ], maxs[occ],
                                rtol=1e-5)
     _log(f"config2: n={n:,} dev={dev_p50*1e3:.2f}ms cpu={cpu_p50*1e3:.2f}ms")
+    point = _config2_engine_point(rows)
     return {"metric": f"TSBS cpu-only WHERE host + min/max/avg, {n/1e6:.1f}M rows, p50",
             "value": round(dev_p50 * 1e3, 3), "unit": "ms",
-            "vs_baseline": round(dev_p50 / cpu_p50, 4)}
+            "vs_baseline": round(dev_p50 / cpu_p50, 4),
+            **point}
+
+
+def _config2_engine_point(rows: int) -> dict:
+    """ENGINE leg of config 2: the WHERE host=? point query COLD through
+    MetricEngine on a filesystem store — the shape sidecar block pruning
+    exists for.  Reports the cold p50 and the fraction of sidecar BYTES
+    the scan actually fetched (1.0 = whole objects, i.e. no pruning —
+    measured at the store, so a broken pruner cannot fake it)."""
+    import asyncio
+    import tempfile
+    import time as _t
+
+    import pyarrow as pa
+
+    from horaedb_tpu.metric_engine import MetricEngine
+    from horaedb_tpu.objstore import LocalObjectStore
+    from horaedb_tpu.storage.types import TimeRange
+
+    class MeteredStore(LocalObjectStore):
+        """Counts bytes served for .enc objects (get + get_range)."""
+
+        enc_bytes = 0
+
+        async def get(self, path):
+            b = await super().get(path)
+            if path.endswith(".enc"):
+                MeteredStore.enc_bytes += len(b)
+            return b
+
+        async def get_range(self, path, start, end):
+            b = await super().get_range(path, start, end)
+            if path.endswith(".enc"):
+                MeteredStore.enc_bytes += len(b)
+            return b
+
+    hosts = 100
+    n = min(rows, 2_000_000)
+    segment_ms = 2 * 3600 * 1000
+    T0 = (1_700_000_000_000 // segment_ms) * segment_ms
+    span = segment_ms  # one big single-segment SST: the pruning shape
+    rng = np.random.default_rng(2)
+    names = pa.array([f"host_{i:03d}" for i in range(hosts)])
+
+    async def go():
+        import glob
+        import os
+
+        with tempfile.TemporaryDirectory() as root:
+            e = await MetricEngine.open("cfg2", MeteredStore(root),
+                                        segment_ms=segment_ms)
+            try:
+                await e.write_arrow("cpu", ["host"], pa.record_batch({
+                    "host": pa.DictionaryArray.from_arrays(
+                        pa.array(rng.integers(0, hosts, n).astype(np.int32)),
+                        names),
+                    "timestamp": pa.array(
+                        T0 + rng.integers(0, span, n), type=pa.int64()),
+                    "value": pa.array(rng.random(n), type=pa.float64()),
+                }))
+                enc_total = sum(
+                    os.path.getsize(p) for p in glob.glob(
+                        os.path.join(root, "cfg2", "data", "data",
+                                     "*.enc")))
+
+                async def q():
+                    return await e.query_downsample(
+                        "cpu", [("host", "host_042")],
+                        TimeRange.new(T0, T0 + span), bucket_ms=60_000,
+                        aggs=("min", "max", "avg"))
+
+                out = await q()  # warm/compile
+                assert len(out["tsids"]) == 1
+                times = []
+                bytes0 = MeteredStore.enc_bytes
+                for _ in range(5):
+                    e.tables["data"].reader.scan_cache.clear()
+                    t0 = _t.perf_counter()
+                    out = await q()
+                    times.append(_t.perf_counter() - t0)
+                fetched = (MeteredStore.enc_bytes - bytes0) / 5
+                return (float(np.percentile(times, 50)), fetched,
+                        max(1, enc_total))
+            finally:
+                await e.close()
+
+    p50, fetched, enc_total = asyncio.run(go())
+    frac = fetched / enc_total
+    _log(f"config2 engine point query: cold p50 {p50 * 1e3:.1f} ms, "
+         f"fetched {frac:.2f} of sidecar bytes (block pruning)")
+    return {"engine_point_cold_ms": round(p50 * 1e3, 3),
+            "engine_point_bytes_fetched_frac": round(frac, 4)}
 
 
 # ---------------------------------------------------------------------------

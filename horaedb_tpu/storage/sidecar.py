@@ -477,9 +477,12 @@ def assemble_parts(parts: list, columns: list,
 # row-group pruning for point queries on remote stores
 # ---------------------------------------------------------------------------
 
-_HEAD_BYTES = 64 << 10
-# below this object size a whole-object GET beats extra round trips
+# the header probe doubles as the whole-object read for small blobs:
+# probing exactly the partial-fetch threshold means any object too
+# small to prune arrives complete in ONE request (a short read), so
+# sub-threshold sidecars never pay a second round trip
 _PARTIAL_MIN_BYTES = 1 << 20
+_HEAD_BYTES = _PARTIAL_MIN_BYTES
 # above this surviving-row fraction the partial fetch saves too little
 # (range reads cost extra round trips; at half the bytes they still
 # win — a point-query run straddling a block boundary keeps 2 blocks,
@@ -653,32 +656,49 @@ async def load_sst_encoded(store, path: str, want: set,
         return await _des(await store.get(path))
     head = await store.get_range(path, 0, _HEAD_BYTES)
     if len(head) < _HEAD_BYTES:
-        # short read = the WHOLE object is already in hand
+        # short read = the WHOLE object is already in hand (also the
+        # only way a sub-threshold object is read: one request)
         return await _des(head)
-    span = header_span(head)
-    if span is not None and span > len(head):
-        head = bytes(head) + bytes(
-            await store.get_range(path, len(head), span))
-    parsed = _parse_header(head)
-    if parsed is None:
-        # not a (readable) header: a full read preserves the corrupt
-        # -blob fallback semantics
-        return await _des(await _rest(head))
-    header, data_start = parsed
-    n_rows = int(header["n_rows"])
-    by_name = {m["name"]: m for m in header["columns"]}
-    if any(nm not in by_name for nm in want):
+    try:
+        span = header_span(head)
+        if span is not None and span > len(head):
+            head = bytes(head) + bytes(
+                await store.get_range(path, len(head), span))
+        parsed = _parse_header(head)
+        if parsed is None:
+            # not a (readable) header: a full read preserves the
+            # corrupt-blob fallback semantics
+            return await _des(await _rest(head))
+        header, data_start = parsed
+        n_rows = int(header["n_rows"])
+        by_name = {m["name"]: m for m in header["columns"]}
+        if any(nm not in by_name for nm in want):
+            return None
+        offsets = header["sections"]
+        approx_bytes = data_start + (max(offsets) if offsets else 0)
+        nblocks = -(-n_rows // BLOCK_ROWS) if n_rows else 0
+        # leaf columns are always in `want` (callers build it that
+        # way), so their presence was vetted by the want check above
+        prunable = (leaves and nblocks > 1
+                    and approx_bytes >= _PARTIAL_MIN_BYTES)
+        if not prunable:
+            return await _des(await _rest(head))
+        return await _load_pruned(store, path, want, leaves, runner,
+                                  header, data_start, n_rows, nblocks,
+                                  _des, _rest, head)
+    except NotFoundError:
+        raise
+    except Exception:
+        # a magic-valid but malformed header (bad indices, truncated
+        # sections) must read as INVALID — the caller memoizes the miss
+        # permanently, same as an unparseable blob
         return None
-    offsets = header["sections"]
-    approx_bytes = data_start + (max(offsets) if offsets else 0)
-    nblocks = -(-n_rows // BLOCK_ROWS) if n_rows else 0
-    # leaf columns are always in `want` (callers build it that way), so
-    # their presence was already vetted by the want check above
-    prunable = (leaves and nblocks > 1
-                and approx_bytes >= _PARTIAL_MIN_BYTES)
-    if not prunable:
-        return await _des(await _rest(head))
 
+
+async def _load_pruned(store, path, want, leaves, runner, header,
+                       data_start, n_rows, nblocks, _des, _rest, head):
+    by_name = {m["name"]: m for m in header["columns"]}
+    offsets = header["sections"]
     secs = _Sections(store, path, data_start)
     mask = np.ones(nblocks, dtype=bool)
     pruned_any = False
@@ -724,8 +744,10 @@ async def load_sst_encoded(store, path: str, want: set,
         if dtype is None or enc is None:
             return name, None
         base = offsets[meta["section"]]
+        isz = np.dtype(dtype).itemsize
         chunks = await asyncio.gather(*(
-            secs.fetch(base + 4 * lo, 4 * (hi - lo)) for lo, hi in ranges))
+            secs.fetch(base + isz * lo, isz * (hi - lo))
+            for lo, hi in ranges))
         arrs = [np.frombuffer(c, dtype=dtype) for c in chunks]
         if not arrs:
             # every block pruned (key absent from this SST): a valid
