@@ -556,11 +556,29 @@ class ParquetReader:
                 assert read_seg is seg
                 if kind == "stream":
                     t0 = time.perf_counter()
-                    async for batch in self._stream_window_batches(seg,
-                                                                   plan):
-                        dispatched.extend(await self._run_pool(
-                            plan.pool, self._dispatch_merged_windows,
-                            batch))
+                    es_iter = await self._open_sidecar_stream(seg, plan)
+                    if es_iter is not None:
+                        try:
+                            async for es in es_iter:
+                                dispatched.extend(await self._run_pool(
+                                    plan.pool,
+                                    self._dispatch_encoded_windows, es))
+                        except Exception as exc:  # noqa: BLE001
+                            # nothing has been yielded for this segment
+                            # yet (windows buffer here), so a clean
+                            # whole-segment fallback is safe
+                            logger.warning(
+                                "sidecar stream failed for segment %s "
+                                "(%s); falling back to parquet",
+                                seg.segment_start, exc)
+                            dispatched = []
+                            es_iter = None
+                    if es_iter is None:
+                        async for batch in self._stream_window_batches(
+                                seg, plan):
+                            dispatched.extend(await self._run_pool(
+                                plan.pool,
+                                self._dispatch_merged_windows, batch))
                     read_s = time.perf_counter() - t0
                 windows = await self._run_pool(
                     plan.pool, self._finalize_windows, dispatched)
@@ -665,10 +683,31 @@ class ParquetReader:
                         t0 = time.perf_counter()
                         entry = [seg, [], 0, 0.0]
                         buffer.append(entry)
-                        async for batch in self._stream_window_batches(seg, plan):
-                            await enqueue(entry, await self._run_pool(
-                                plan.pool, self._prepare_merge_windows, batch,
-                                scan_host_perm))
+                        es_iter = await self._open_sidecar_stream(seg,
+                                                                  plan)
+                        if es_iter is not None:
+                            try:
+                                async for es in es_iter:
+                                    await enqueue(entry, await
+                                                  self._run_pool(
+                                        plan.pool,
+                                        self._prepare_encoded_windows,
+                                        es, scan_host_perm))
+                            except Exception as exc:  # noqa: BLE001
+                                # windows already enqueued into mesh
+                                # rounds can't be retracted: fail to the
+                                # outer replan (same as a mid-stream
+                                # compaction race), not a silent retry
+                                raise Error(
+                                    "sidecar stream failed mid-mesh-"
+                                    f"round: {exc}") from exc
+                        else:
+                            async for batch in self._stream_window_batches(
+                                    seg, plan):
+                                await enqueue(entry, await self._run_pool(
+                                    plan.pool,
+                                    self._prepare_merge_windows, batch,
+                                    scan_host_perm))
                         entry[3] = time.perf_counter() - t0
                     else:
                         descs = []
@@ -857,6 +896,77 @@ class ParquetReader:
             logger.warning("invalid sidecar(s) for segment %s; using "
                            "parquet", seg.segment_start)
         return es
+
+    async def _open_sidecar_stream(self, seg: SegmentPlan, plan: ScanPlan):
+        """Streamed-segment windows straight from sidecars: PK-value
+        -range windows planned from per-block stats, each window loaded
+        via the pruned loader with synthetic range leaves (see
+        sidecar.SstStreamSession / plan_stream_windows) — no parquet
+        two-pass, no Arrow.  Returns an async generator of
+        EncodedSegments, or None when any SST lacks a plannable sidecar
+        (the parquet streamer serves the segment instead)."""
+        if not self._sidecar_plan_ok(plan):
+            return None
+        if any(f.id in self._sidecar_missing for f in seg.ssts):
+            return None
+        leaves = plan.prune_leaves or []
+        want = set(seg.columns) | {lf.column for lf in leaves}
+
+        def runner(fn, *args):
+            return self._run_pool(plan.pool, fn, *args)
+
+        got = await asyncio.gather(*(
+            sidecar.SstStreamSession.open(
+                self.store, sidecar.sidecar_path(self.root_path, f.id),
+                want, runner=runner)
+            for f in seg.ssts), return_exceptions=True)
+        sessions = []
+        for f, res in zip(seg.ssts, got):
+            if isinstance(res, NotFoundError) or res is None:
+                # permanent per immutable id — same memo as the bulk
+                # path, so later streamed scans skip the probes
+                self._memo_sidecar_missing((f.id,))
+                return None
+            if isinstance(res, BaseException):
+                logger.warning("sidecar stream open failed for sst "
+                               "%s: %s", f.id, res)
+                return None
+            sessions.append(res)
+        planned = await sidecar.plan_stream_windows(
+            sessions, self._pk_names_in(list(seg.columns)),
+            self.config.scan.max_window_rows)
+        if planned is None:
+            return None
+        part_col, ranges = planned
+
+        async def gen():
+            rows = nbytes = 0
+            for lo, hi in ranges:
+                wleaves = list(leaves)
+                if lo is not None:
+                    wleaves.append(filter_ops.Ge(part_col, lo))
+                if hi is not None:
+                    wleaves.append(filter_ops.Lt(part_col, hi))
+                parts = await asyncio.gather(*(
+                    s.load_window(wleaves) for s in sessions))
+                if any(p is None for p in parts):
+                    raise Error("sidecar stream window failed")
+                es = await self._run_pool(
+                    plan.pool, sidecar.assemble_parts, list(parts),
+                    list(seg.columns), wleaves)
+                if es is None:
+                    raise Error("sidecar stream assembly failed")
+                if es.n:
+                    rows += es.n
+                    nbytes += es.nbytes
+                    yield es
+            # counters commit only on a COMPLETE stream: a mid-stream
+            # failure re-serves the segment via parquet, which would
+            # otherwise double-count the already-yielded windows
+            _STAGE_ROWS["sidecar_read"].inc(rows)
+            _STAGE_BYTES["sidecar_read"].inc(nbytes)
+
+        return gen()
 
     def _memo_sidecar_missing(self, ids) -> None:
         """Record permanently-sidecar-less SST ids, bounded (clear-all on

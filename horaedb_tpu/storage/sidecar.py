@@ -552,13 +552,18 @@ class _Sections:
         # exactly once per SST load
         self.enc_cache: dict = {}
 
-    async def fetch(self, offset: int, nbytes: int) -> bytes:
+    async def fetch(self, offset: int, nbytes: int,
+                    cache: bool = True) -> bytes:
         key = (offset, nbytes)
         got = self._cache.get(key)
         if got is None:
             lo = self.data_start + offset
             got = await self.store.get_range(self.path, lo, lo + nbytes)
-            if nbytes <= (4 << 20):  # don't pin column-sized ranges
+            # data-column chunks pass cache=False: a streamed session
+            # reads each window's disjoint ranges exactly once, and
+            # pinning them would re-materialize the whole segment —
+            # the residency streaming exists to avoid
+            if cache and nbytes <= (4 << 20):
                 self._cache[key] = got
         return got
 
@@ -695,11 +700,11 @@ async def load_sst_encoded(store, path: str, want: set,
         return None
 
 
-async def _load_pruned(store, path, want, leaves, runner, header,
-                       data_start, n_rows, nblocks, _des, _rest, head):
-    by_name = {m["name"]: m for m in header["columns"]}
+async def _leaf_block_mask(leaves, by_name, header, secs, nblocks,
+                           runner):
+    """(mask, pruned_any) over blocks for a leaf conjunction, or None
+    when an encoding can't be built (caller falls back)."""
     offsets = header["sections"]
-    secs = _Sections(store, path, data_start)
     mask = np.ones(nblocks, dtype=bool)
     pruned_any = False
     for leaf in leaves:
@@ -708,7 +713,7 @@ async def _load_pruned(store, path, want, leaves, runner, header,
             continue
         enc = await _encoding_for(meta, header, secs, runner)
         if enc is None:
-            return await _des(await _rest(head))
+            return None
         raw = await secs.fetch(offsets[meta["bstats_section"]],
                                nblocks * 8)
         stats = np.frombuffer(raw, dtype=np.int32, count=2 * nblocks)
@@ -717,14 +722,14 @@ async def _load_pruned(store, path, want, leaves, runner, header,
         if lm is not None:
             mask &= lm
             pruned_any = True
-    kept = int(mask.sum())
-    if (not pruned_any or kept == nblocks
-            or kept * BLOCK_ROWS > _PARTIAL_MAX_FRAC * n_rows):
-        return await _des(await _rest(head))
+    return mask, pruned_any
 
-    # contiguous surviving-block runs -> row ranges
+
+def _mask_to_ranges(mask: np.ndarray, n_rows: int) -> list[tuple[int, int]]:
+    """Contiguous surviving-block runs -> row ranges."""
     ranges: list[tuple[int, int]] = []
     b = 0
+    nblocks = len(mask)
     while b < nblocks:
         if not mask[b]:
             b += 1
@@ -733,9 +738,16 @@ async def _load_pruned(store, path, want, leaves, runner, header,
         while b < nblocks and mask[b]:
             b += 1
         ranges.append((b0 * BLOCK_ROWS, min(b * BLOCK_ROWS, n_rows)))
-    total = sum(hi - lo for lo, hi in ranges)
+    return ranges
 
+
+async def _load_columns(by_name, header, secs, want, ranges, runner):
+    """Fetch each wanted column's bytes for the row ranges; ({name:
+    (arr, enc)}, total_rows) or None on an unsupported column."""
     import asyncio
+
+    offsets = header["sections"]
+    total = sum(hi - lo for lo, hi in ranges)
 
     async def load_col(name: str):
         meta = by_name[name]
@@ -746,7 +758,7 @@ async def _load_pruned(store, path, want, leaves, runner, header,
         base = offsets[meta["section"]]
         isz = np.dtype(dtype).itemsize
         chunks = await asyncio.gather(*(
-            secs.fetch(base + isz * lo, isz * (hi - lo))
+            secs.fetch(base + isz * lo, isz * (hi - lo), cache=False)
             for lo, hi in ranges))
         arrs = [np.frombuffer(c, dtype=dtype) for c in chunks]
         if not arrs:
@@ -763,3 +775,185 @@ async def _load_pruned(store, path, want, leaves, runner, header,
             return None
         cols[name] = got
     return cols, total
+
+
+async def _load_pruned(store, path, want, leaves, runner, header,
+                       data_start, n_rows, nblocks, _des, _rest, head):
+    by_name = {m["name"]: m for m in header["columns"]}
+    secs = _Sections(store, path, data_start)
+    got = await _leaf_block_mask(leaves, by_name, header, secs, nblocks,
+                                 runner)
+    if got is None:
+        return await _des(await _rest(head))
+    mask, pruned_any = got
+    kept = int(mask.sum())
+    if (not pruned_any or kept == nblocks
+            or kept * BLOCK_ROWS > _PARTIAL_MAX_FRAC * n_rows):
+        return await _des(await _rest(head))
+    ranges = _mask_to_ranges(mask, n_rows)
+    return await _load_columns(by_name, header, secs, want, ranges,
+                               runner)
+
+
+# ---------------------------------------------------------------------------
+# streamed-segment serving: PK-value-range windows from block stats
+# ---------------------------------------------------------------------------
+
+
+class SstStreamSession:
+    """Prepared per-SST sidecar session for STREAMED segments: the
+    header (and, lazily, dictionaries) probe once; each window then
+    loads only the blocks intersecting its PK value range.  Small
+    objects that fit the probe parse once and serve every window from
+    memory."""
+
+    @classmethod
+    async def open(cls, store, path: str, want: set, runner=None):
+        """None = no usable sidecar (caller falls back to the parquet
+        streamer); NotFoundError propagates."""
+        head = await store.get_range(path, 0, _HEAD_BYTES)
+        self = cls()
+        self.store, self.path, self.runner = store, path, runner
+        self.want = set(want)
+        self._full = None
+        try:
+            if len(head) < _HEAD_BYTES:
+                full = deserialize(head, self.want)
+                if full is None:
+                    return None
+                self._full = full
+                return self
+            span = header_span(head)
+            if span is not None and span > len(head):
+                head = bytes(head) + bytes(
+                    await store.get_range(path, len(head), span))
+            parsed = _parse_header(head)
+            if parsed is None:
+                return None
+            self.header, self.data_start = parsed
+            self.n_rows = int(self.header["n_rows"])
+            self.by_name = {m["name"]: m for m in self.header["columns"]}
+            if any(nm not in self.by_name for nm in self.want):
+                return None
+            self.nblocks = -(-self.n_rows // BLOCK_ROWS) \
+                if self.n_rows else 0
+            self.secs = _Sections(store, path, self.data_start)
+            return self
+        except NotFoundError:
+            raise
+        except Exception:
+            return None
+
+    async def _dict_values(self, meta, codes: np.ndarray):
+        """Dictionary entries for `codes` WITHOUT downloading the whole
+        dictionary: ONE ranged read spanning [min(code), max(code)] for
+        i64 dicts (tsid's case — ~8 B/entry over the needed span); blob
+        dicts load whole via the enc cache (tag dictionaries are
+        small).  Returns an array aligned with `codes`, or None."""
+        if meta.get("dict_kind") == "i64":
+            lo_c, hi_c = int(codes.min()), int(codes.max())
+            off = self.header["sections"][meta["dict_section"]]
+            raw = await self.secs.fetch(off + 8 * lo_c,
+                                        8 * (hi_c - lo_c + 1))
+            span = np.frombuffer(raw, dtype=np.int64,
+                                 count=hi_c - lo_c + 1)
+            return span[codes.astype(np.int64) - lo_c]
+        enc = await _encoding_for(meta, self.header, self.secs,
+                                  self.runner)
+        if enc is None or enc.dictionary is None:
+            return None
+        return enc.dictionary[codes.astype(np.int64)]
+
+    async def block_value_ranges(self, column: str):
+        """Per-block (min_value, max_value, rows) of `column`, or None
+        when stats/encodings can't support window planning."""
+        if self._full is not None:
+            cols = self._full[0]
+            if column not in cols:
+                return None
+            arr, enc = cols[column]
+            n = self._full[1]
+            if n == 0:
+                return []
+            vals = encode.decode_column(arr, enc, n).to_numpy(
+                zero_copy_only=False)
+            return [(vals.min(), vals.max(), n)]
+        meta = self.by_name.get(column)
+        if meta is None or "bstats_section" not in meta:
+            return None
+        raw = await self.secs.fetch(
+            self.header["sections"][meta["bstats_section"]],
+            self.nblocks * 8)
+        stats = np.frombuffer(raw, dtype=np.int32, count=2 * self.nblocks)
+        mins_c, maxs_c = stats[:self.nblocks], stats[self.nblocks:]
+        if meta["kind"] == "offset":
+            mins_v = mins_c.astype(np.int64) + int(meta["epoch"])
+            maxs_v = maxs_c.astype(np.int64) + int(meta["epoch"])
+        elif meta["kind"] == "numeric":
+            mins_v, maxs_v = mins_c, maxs_c
+        elif meta["kind"] == "dict":
+            mins_v = await self._dict_values(meta, mins_c)
+            maxs_v = await self._dict_values(meta, maxs_c)
+            if mins_v is None or maxs_v is None:
+                return None
+        else:
+            return None
+        out = []
+        for b in range(self.nblocks):
+            rows = min(BLOCK_ROWS, self.n_rows - b * BLOCK_ROWS)
+            out.append((mins_v[b], maxs_v[b], rows))
+        return out
+
+    async def load_window(self, leaves: list):
+        """(cols, n) of the blocks intersecting the leaf conjunction
+        (window range leaves + the plan's own pushed leaves); the exact
+        mask applies later in assemble_parts.  None on malformed."""
+        if self._full is not None:
+            return self._full
+        got = await _leaf_block_mask(leaves, self.by_name, self.header,
+                                     self.secs, self.nblocks, self.runner)
+        if got is None:
+            return None
+        mask, _pruned = got
+        ranges = _mask_to_ranges(mask, self.n_rows)
+        return await _load_columns(self.by_name, self.header, self.secs,
+                                   self.want, ranges, self.runner)
+
+
+async def plan_stream_windows(sessions: list, pk_names: list,
+                              max_window_rows: int):
+    """(partition_column, [(lo, hi), ...]) value-range windows over the
+    first PK column whose values vary, sized so the blocks intersecting
+    each range hold ~max_window_rows rows (soft bound: straddling
+    blocks count toward both sides).  Ranges are [lo, hi) with None as
+    -inf/+inf; equal-PK rows always land in exactly one window, which
+    is what cross-SST dedup requires.  None = planning impossible
+    (missing stats): fall back to the parquet streamer."""
+    import asyncio
+
+    for col in pk_names:
+        infos = await asyncio.gather(*(
+            s.block_value_ranges(col) for s in sessions))
+        if any(info is None for info in infos):
+            return None
+        blocks = [blk for info in infos for blk in info]
+        if not blocks:
+            return col, [(None, None)]
+        lo = min(b[0] for b in blocks)
+        hi = max(b[1] for b in blocks)
+        if lo == hi:
+            continue  # constant column cannot bound anything
+        blocks.sort(key=lambda b: (b[0], b[1]))
+        bounds: list = []
+        acc = 0
+        for bmin, _bmax, rows in blocks:
+            if acc >= max_window_rows and (not bounds
+                                           or bmin > bounds[-1]):
+                # cut BETWEEN blocks at this block's min value: works
+                # for ints and strings alike, no +1 arithmetic
+                bounds.append(bmin)
+                acc = 0
+            acc += rows
+        edges = [None] + bounds + [None]
+        return col, list(zip(edges[:-1], edges[1:]))
+    return None  # every PK constant: nothing to window on

@@ -395,3 +395,147 @@ class TestBlockPruning:
         got = self._load(store, [])
         assert got is not None and got[1] == _batch.num_rows
         assert store.full_gets == 1
+
+
+class TestStreamedSidecar:
+    """Segments over the stream threshold must serve from sidecar
+    value-range windows — row-level identical to the parquet two-pass
+    streamer, including cross-SST dedup inside windows."""
+
+    def _run(self, use_sidecar, mutate=None):
+        from horaedb_tpu.metric_engine import MetricEngine
+        from horaedb_tpu.objstore import MemoryObjectStore
+        from horaedb_tpu.storage.config import StorageConfig, from_dict
+        from horaedb_tpu.storage.read import _STAGE_ROWS
+        from horaedb_tpu.storage.types import TimeRange
+
+        cfg_d = {"scan": {"stream_read_min_rows": 4096,
+                          "max_window_rows": 2048,
+                          "use_sidecar": use_sidecar}}
+
+        async def go():
+            rng = np.random.default_rng(17)
+            n, hosts = 30_000, 20
+            names = np.array([f"h{i:02d}" for i in range(hosts)],
+                             dtype=object)
+            batch = pa.record_batch({
+                "host": pa.array(names[rng.integers(0, hosts, n)]),
+                "timestamp": pa.array(
+                    T0 + rng.integers(0, 2 * HOUR - 1, n),
+                    type=pa.int64()),
+                "value": pa.array(rng.random(n) * 9, type=pa.float64()),
+            })
+            store = MemoryObjectStore()
+            cfg = from_dict(StorageConfig, cfg_d)
+            e = await MetricEngine.open("ss", store, segment_ms=2 * HOUR,
+                                        config=cfg)
+            try:
+                # two overlapping writes: dedup must work ACROSS the
+                # streamed windows' SST runs
+                await e.write_arrow("cpu", ["host"], batch)
+                await e.write_arrow("cpu", ["host"], batch.slice(0, 9000))
+            finally:
+                await e.close()
+            if mutate is not None:
+                await mutate(store)
+            e = await MetricEngine.open("ss", store, segment_ms=2 * HOUR,
+                                        config=cfg)
+            try:
+                side0 = _STAGE_ROWS["sidecar_read"].value
+                out = await e.query_downsample(
+                    "cpu", [], TimeRange.new(T0, T0 + 2 * HOUR),
+                    bucket_ms=600_000)
+                rows = await e.query(
+                    "cpu", [("host", "h07")],
+                    TimeRange.new(T0, T0 + HOUR))
+                side_rows = _STAGE_ROWS["sidecar_read"].value - side0
+                return (out, rows.sort_by([("tsid", "ascending"),
+                                           ("timestamp", "ascending")]),
+                        side_rows)
+            finally:
+                await e.close()
+
+        return asyncio.run(go())
+
+    def test_streamed_parity_with_parquet_streamer(self):
+        a_out, a_rows, a_side = self._run(True)
+        b_out, b_rows, b_side = self._run(False)
+        assert a_side > 0        # the sidecar stream actually served
+        assert b_side == 0       # and the parquet leg really didn't
+        assert a_out["tsids"] == b_out["tsids"]
+        for k in a_out["aggs"]:
+            np.testing.assert_array_equal(
+                np.asarray(a_out["aggs"][k]),
+                np.asarray(b_out["aggs"][k]), err_msg=k)
+        assert a_rows.equals(b_rows) and a_rows.num_rows > 0
+
+    def test_streamed_falls_back_on_corrupt_sidecar(self):
+        async def corrupt(store):
+            for meta in await store.list("ss/data/data/"):
+                if meta.path.endswith(".enc"):
+                    await store.put(meta.path, b"junk")
+
+        a_out, a_rows, _ = self._run(True, mutate=corrupt)
+        b_out, b_rows, _ = self._run(False)
+        assert a_out["tsids"] == b_out["tsids"]
+        for k in a_out["aggs"]:
+            np.testing.assert_array_equal(
+                np.asarray(a_out["aggs"][k]),
+                np.asarray(b_out["aggs"][k]), err_msg=k)
+        assert a_rows.equals(b_rows)
+
+    def test_streamed_meshed_matches_single_device(self):
+        """The mesh twin streams sidecar windows too; grids must match
+        the single-device run (counts exact, sums to f32 ulp)."""
+        a_out, _a_rows, a_side = self._run(True)
+        m_out, _m_rows, m_side = self._run_meshed()
+        assert a_side > 0 and m_side > 0
+        assert a_out["tsids"] == m_out["tsids"]
+        np.testing.assert_array_equal(
+            np.asarray(a_out["aggs"]["count"]),
+            np.asarray(m_out["aggs"]["count"]))
+        for k in a_out["aggs"]:
+            np.testing.assert_allclose(
+                np.asarray(a_out["aggs"][k], dtype=np.float64),
+                np.asarray(m_out["aggs"][k], dtype=np.float64),
+                rtol=2e-5, atol=1e-5, err_msg=k)
+
+    def _run_meshed(self):
+        from horaedb_tpu.metric_engine import MetricEngine
+        from horaedb_tpu.objstore import MemoryObjectStore
+        from horaedb_tpu.storage.config import StorageConfig, from_dict
+        from horaedb_tpu.storage.read import _STAGE_ROWS
+        from horaedb_tpu.storage.types import TimeRange
+
+        async def go():
+            rng = np.random.default_rng(17)
+            n, hosts = 30_000, 20
+            names = np.array([f"h{i:02d}" for i in range(hosts)],
+                             dtype=object)
+            batch = pa.record_batch({
+                "host": pa.array(names[rng.integers(0, hosts, n)]),
+                "timestamp": pa.array(
+                    T0 + rng.integers(0, 2 * HOUR - 1, n),
+                    type=pa.int64()),
+                "value": pa.array(rng.random(n) * 9, type=pa.float64()),
+            })
+            store = MemoryObjectStore()
+            cfg = from_dict(StorageConfig, {
+                "scan": {"stream_read_min_rows": 4096,
+                         "max_window_rows": 2048,
+                         "mesh_devices": 4}})
+            e = await MetricEngine.open("ssm", store, segment_ms=2 * HOUR,
+                                        config=cfg)
+            try:
+                await e.write_arrow("cpu", ["host"], batch)
+                await e.write_arrow("cpu", ["host"], batch.slice(0, 9000))
+                side0 = _STAGE_ROWS["sidecar_read"].value
+                out = await e.query_downsample(
+                    "cpu", [], TimeRange.new(T0, T0 + 2 * HOUR),
+                    bucket_ms=600_000)
+                return out, _STAGE_ROWS["sidecar_read"].value - side0
+            finally:
+                await e.close()
+
+        out, side = asyncio.run(go())
+        return out, None, side

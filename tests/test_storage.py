@@ -655,8 +655,12 @@ class TestStreamedRead:
 
     def test_streamed_equals_bulk_with_bounded_windows(self):
         spy: list = []
+        # use_sidecar off: this test pins the PARQUET two-pass
+        # streamer's windowing contract (the sidecar stream has its own
+        # parity tests in test_sidecar.TestStreamedSidecar)
         streamed = self._run(
-            {"stream_read_min_rows": 2000, "max_window_rows": 1024},
+            {"stream_read_min_rows": 2000, "max_window_rows": 1024,
+             "use_sidecar": False},
             spy=spy)
         bulk = self._run({"stream_read_min_rows": 0,
                           "max_window_rows": 1 << 20})
@@ -673,8 +677,10 @@ class TestStreamedRead:
         spy: list = []
         streamed = self._run(
             # row knob far above the data; byte knob far below it
+            # (use_sidecar off: pins the parquet streamer specifically)
             {"stream_read_min_rows": 1 << 30,
-             "stream_read_min_bytes": 4096, "max_window_rows": 1024},
+             "stream_read_min_bytes": 4096, "max_window_rows": 1024,
+             "use_sidecar": False},
             spy=spy)
         bulk = self._run({"stream_read_min_rows": 0,
                           "stream_read_min_bytes": 0,
