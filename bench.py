@@ -75,15 +75,19 @@ def _tpu_verified_path() -> str:
                         "bench_results", "tpu_verified.json")
 
 
+def _load_json(path: str) -> dict:
+    try:
+        with open(path, encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
 def load_tpu_verified() -> dict:
     """Latest REAL-hardware numbers, carried inline in every emitted
     JSON (even CPU-fallback runs) so the driver sees the hardware story
     in the parsed payload, not behind a file pointer."""
-    try:
-        with open(_tpu_verified_path(), encoding="utf-8") as f:
-            return json.load(f)
-    except (OSError, ValueError):
-        return {}
+    return _load_json(_tpu_verified_path())
 
 
 def record_tpu_verified(result: dict) -> None:
@@ -111,13 +115,9 @@ def record_tpu_verified(result: dict) -> None:
 def load_scale_proven() -> dict:
     """Largest row count the engine has been soak-proven at (written by
     tools/scale_run.py), surfaced as max_rows_proven in every payload."""
-    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "bench_results", "scale_proven.json")
-    try:
-        with open(path, encoding="utf-8") as f:
-            return json.load(f)
-    except (OSError, ValueError):
-        return {}
+    return _load_json(os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "bench_results",
+        "scale_proven.json"))
 
 
 def latest_tpu_evidence() -> dict:

@@ -33,6 +33,7 @@ int64.
 
 from __future__ import annotations
 
+import asyncio
 import json
 import struct
 from dataclasses import dataclass
@@ -137,17 +138,13 @@ def serialize(columns: dict, n_rows: int) -> Optional[bytes]:
         if arr.dtype == np.int32 and n_rows:
             # per-block min/max over the ENCODED values (codes/offsets
             # are order-preserving, so leaf constants translate into
-            # this space); one i32 section [mins..., maxes...]
-            nblocks = -(-n_rows // BLOCK_ROWS)
-            pad_to = nblocks * BLOCK_ROWS
-            # pad the tail block with its own LAST value: the pad then
-            # lies inside the tail's true [min, max], keeping its stats
-            # exact (padding with arr[0] would widen a sorted column's
-            # tail to the whole value range and defeat pruning)
-            blk = np.full(pad_to, arr[n_rows - 1], dtype=np.int32)
-            blk[:n_rows] = arr
-            blk = blk.reshape(nblocks, BLOCK_ROWS)
-            stats = np.concatenate([blk.min(axis=1), blk.max(axis=1)])
+            # this space); one i32 section [mins..., maxes...].
+            # reduceat handles the ragged tail block exactly, with no
+            # padded copy of the column
+            starts = np.arange(0, n_rows, BLOCK_ROWS)
+            stats = np.concatenate([
+                np.minimum.reduceat(arr, starts),
+                np.maximum.reduceat(arr, starts)])
             meta["bstats_section"] = len(sections)
             sections.append(stats.astype(np.int32).tobytes())
         if enc.kind == "dict":
@@ -477,12 +474,15 @@ def assemble_parts(parts: list, columns: list,
 # row-group pruning for point queries on remote stores
 # ---------------------------------------------------------------------------
 
-# the header probe doubles as the whole-object read for small blobs:
-# probing exactly the partial-fetch threshold means any object too
-# small to prune arrives complete in ONE request (a short read), so
-# sub-threshold sidecars never pay a second round trip
+# below this object size a whole-object GET beats extra round trips
 _PARTIAL_MIN_BYTES = 1 << 20
-_HEAD_BYTES = _PARTIAL_MIN_BYTES
+# the header probe: big enough for any realistic header JSON, small
+# enough that the probe's byte copy is noise.  Objects smaller than
+# this arrive complete in the probe (short read, one request);
+# unprunable larger objects pay probe + ONE plain GET — measured
+# cheaper than a probe-sized head reused via range-read + concat,
+# which copied the whole object twice on host-backed stores
+_HEAD_BYTES = 64 << 10
 # above this surviving-row fraction the partial fetch saves too little
 # (range reads cost extra round trips; at half the bytes they still
 # win — a point-query run straddling a block boundary keeps 2 blocks,
@@ -641,28 +641,16 @@ async def load_sst_encoded(store, path: str, want: set,
             return deserialize(buf, want)
         return await runner(deserialize, buf, want)
 
-    async def _rest(head_bytes):
-        # reuse the probed head: fetch only the remainder.  Memory/local
-        # stores clamp past-EOF ranges; S3 rejects start==size with 416,
-        # so any range error degrades to one whole GET (correctness
-        # first, the saved head is merely an optimization)
-        try:
-            rest = await store.get_range(path, len(head_bytes),
-                                         len(head_bytes) + (1 << 40))
-        except NotFoundError:
-            raise
-        except Exception:
-            return await store.get(path)
-        return bytes(head_bytes) + bytes(rest)
-
     leaves = leaves or []
     if not leaves:
         # nothing to prune with: one whole-object GET, no header probe
         return await _des(await store.get(path))
     head = await store.get_range(path, 0, _HEAD_BYTES)
     if len(head) < _HEAD_BYTES:
-        # short read = the WHOLE object is already in hand (also the
-        # only way a sub-threshold object is read: one request)
+        # short read = the WHOLE object is already in hand; larger
+        # objects that turn out unprunable pay probe + one plain GET
+        # (the deliberate trade documented at _HEAD_BYTES — a plain
+        # GET is zero-copy on host-backed stores)
         return await _des(head)
     try:
         span = header_span(head)
@@ -673,7 +661,7 @@ async def load_sst_encoded(store, path: str, want: set,
         if parsed is None:
             # not a (readable) header: a full read preserves the
             # corrupt-blob fallback semantics
-            return await _des(await _rest(head))
+            return await _des(await store.get(path))
         header, data_start = parsed
         n_rows = int(header["n_rows"])
         by_name = {m["name"]: m for m in header["columns"]}
@@ -687,16 +675,16 @@ async def load_sst_encoded(store, path: str, want: set,
         prunable = (leaves and nblocks > 1
                     and approx_bytes >= _PARTIAL_MIN_BYTES)
         if not prunable:
-            return await _des(await _rest(head))
+            return await _des(await store.get(path))
         return await _load_pruned(store, path, want, leaves, runner,
                                   header, data_start, n_rows, nblocks,
-                                  _des, _rest, head)
-    except NotFoundError:
-        raise
-    except Exception:
+                                  _des)
+    except (KeyError, IndexError, ValueError, TypeError, struct.error):
         # a magic-valid but malformed header (bad indices, truncated
         # sections) must read as INVALID — the caller memoizes the miss
-        # permanently, same as an unparseable blob
+        # permanently, same as an unparseable blob.  Store/IO errors
+        # propagate instead: the caller treats those as TRANSIENT (no
+        # memo), so one network hiccup can't blacklist a valid sidecar
         return None
 
 
@@ -744,8 +732,6 @@ def _mask_to_ranges(mask: np.ndarray, n_rows: int) -> list[tuple[int, int]]:
 async def _load_columns(by_name, header, secs, want, ranges, runner):
     """Fetch each wanted column's bytes for the row ranges; ({name:
     (arr, enc)}, total_rows) or None on an unsupported column."""
-    import asyncio
-
     offsets = header["sections"]
     total = sum(hi - lo for lo, hi in ranges)
 
@@ -778,18 +764,18 @@ async def _load_columns(by_name, header, secs, want, ranges, runner):
 
 
 async def _load_pruned(store, path, want, leaves, runner, header,
-                       data_start, n_rows, nblocks, _des, _rest, head):
+                       data_start, n_rows, nblocks, _des):
     by_name = {m["name"]: m for m in header["columns"]}
     secs = _Sections(store, path, data_start)
     got = await _leaf_block_mask(leaves, by_name, header, secs, nblocks,
                                  runner)
     if got is None:
-        return await _des(await _rest(head))
+        return await _des(await store.get(path))
     mask, pruned_any = got
     kept = int(mask.sum())
     if (not pruned_any or kept == nblocks
             or kept * BLOCK_ROWS > _PARTIAL_MAX_FRAC * n_rows):
-        return await _des(await _rest(head))
+        return await _des(await store.get(path))
     ranges = _mask_to_ranges(mask, n_rows)
     return await _load_columns(by_name, header, secs, want, ranges,
                                runner)
@@ -929,8 +915,6 @@ async def plan_stream_windows(sessions: list, pk_names: list,
     -inf/+inf; equal-PK rows always land in exactly one window, which
     is what cross-SST dedup requires.  None = planning impossible
     (missing stats): fall back to the parquet streamer."""
-    import asyncio
-
     for col in pk_names:
         infos = await asyncio.gather(*(
             s.block_value_ranges(col) for s in sessions))

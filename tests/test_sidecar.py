@@ -368,10 +368,10 @@ class TestBlockPruning:
         store = self._store(blob)
         got = self._load(store, [Ge("timestamp", T0)])  # matches all
         assert got is not None and got[1] == batch.num_rows
-        # pruning saved nothing -> whole object read, reusing the
-        # probed head (no separate full GET)
-        assert store.full_gets == 0
-        assert store.range_bytes >= len(blob)
+        # pruning saved nothing -> ONE plain GET after the small probe
+        # (zero-copy on host-backed stores; the probe bytes are noise)
+        assert store.full_gets == 1
+        assert store.range_bytes < len(blob) // 4
 
     def test_absent_key_returns_empty_part(self):
         from horaedb_tpu.ops.filter import Eq
