@@ -1,0 +1,21 @@
+#!/bin/bash
+# Full real-TPU sweep for the moment the axon relay answers (run from
+# the repo root).  Results append to bench_results/tpu_round5.md and
+# config 1 auto-refreshes bench_results/tpu_verified.json.  One TPU
+# process at a time; each config is a fresh subprocess.
+set -u
+cd "$(dirname "$0")/.."
+OUT=bench_results/tpu_round5.md
+date=$(date -I)
+echo "# Real-TPU measurements, round 5 ($date)" >> "$OUT"
+echo >> "$OUT"
+for cfg in "1 10000000 20" "2 2000000 10" "4 12000000 3" "5 2000000 5" "7 2000000 20"; do
+  set -- $cfg
+  echo "## config $1 (rows=$2)" >> "$OUT"
+  echo '```json' >> "$OUT"
+  BENCH_CONFIG=$1 BENCH_ROWS=$2 BENCH_ITERS=$3 timeout 3600 python bench.py \
+    2>>"$OUT.log" | tail -1 >> "$OUT"
+  echo '```' >> "$OUT"
+  echo >> "$OUT"
+done
+echo "sweep done: $OUT"
