@@ -1,7 +1,18 @@
-.PHONY: test native bench clean verify lint
+.PHONY: test native bench clean verify lint chaos
 
 test:
 	python -m pytest tests/ -q
+
+# seeded fault-injection + crash-consistency torture suites (see
+# docs/robustness.md); override TORTURE_SEED / TORTURE_SCHEDULES to
+# reproduce a failure or dial intensity
+TORTURE_SEED ?= 1337
+TORTURE_SCHEDULES ?= 200
+
+chaos:
+	TORTURE_SEED=$(TORTURE_SEED) TORTURE_SCHEDULES=$(TORTURE_SCHEDULES) \
+	python -m pytest tests/test_fault_injection.py tests/test_torture.py \
+	tests/test_objstore_middleware.py -q
 
 # stdlib AST lint gate (the reference CI runs fmt+clippy -D warnings;
 # this image ships no ruff/flake8, so the gate is tools/lint.py)
@@ -9,8 +20,9 @@ lint:
 	python tools/lint.py
 
 # the driver-facing deliverables, end to end: lint + full suite + the
-# multi-chip dryrun on the virtual CPU mesh + a small engine bench
-verify: lint test
+# fixed-seed chaos gate + the multi-chip dryrun on the virtual CPU mesh
+# + a small engine bench
+verify: lint test chaos
 	python -c "import __graft_entry__; __graft_entry__.dryrun_multichip(8); print('dryrun OK')"
 	BENCH_ROWS=200000 BENCH_ITERS=3 python bench.py
 

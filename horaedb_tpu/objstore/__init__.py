@@ -9,11 +9,29 @@ a local-filesystem impl, and an in-memory fake for tests.
 from horaedb_tpu.objstore.api import NotFoundError, ObjectMeta, ObjectStore
 from horaedb_tpu.objstore.local import LocalObjectStore
 from horaedb_tpu.objstore.memory import MemoryObjectStore
+from horaedb_tpu.objstore.middleware import (
+    DeadlineExceededError,
+    FaultInjectingStore,
+    InjectedCrash,
+    InjectedFault,
+    InstrumentedStore,
+    RetryingObjectStore,
+    RetryPolicy,
+    WrappedObjectStore,
+)
 
 __all__ = [
+    "DeadlineExceededError",
+    "FaultInjectingStore",
+    "InjectedCrash",
+    "InjectedFault",
+    "InstrumentedStore",
     "LocalObjectStore",
     "MemoryObjectStore",
     "NotFoundError",
     "ObjectMeta",
     "ObjectStore",
+    "RetryPolicy",
+    "RetryingObjectStore",
+    "WrappedObjectStore",
 ]

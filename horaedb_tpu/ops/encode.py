@@ -26,9 +26,19 @@ import pyarrow as pa
 import pyarrow.compute as pc
 
 from horaedb_tpu.common.error import Error, ensure
+from horaedb_tpu.utils import registry
 
 _INT32_MIN = np.int32(-(2**31))
 _INT32_MAX = np.int32(2**31 - 1)
+
+_F32_MAX = np.float32(np.finfo(np.float32).max)
+# f64→f32 overflow policy: finite values beyond the f32 range CLAMP to
+# ±f32::MAX and count here, instead of silently becoming inf and
+# poisoning every aggregate over the segment.  Actual ±inf inputs pass
+# through unchanged (the caller said inf, the cast didn't invent it).
+_ENCODE_OVERFLOW = registry.counter(
+    "horaedb_encode_overflow_total",
+    "finite f64 values clamped to the f32 range during device encoding")
 
 MIN_CAPACITY = 128
 
@@ -149,8 +159,17 @@ def _dictionary_encode_arrow(col: pa.Array) -> tuple[np.ndarray, np.ndarray]:
 def encode_column(col: pa.Array, name: str) -> tuple[np.ndarray, ColumnEncoding]:
     t = col.type
     if pa.types.is_floating(t):
-        return (col.to_numpy(zero_copy_only=False).astype(np.float32),
-                ColumnEncoding("numeric", t))
+        host = col.to_numpy(zero_copy_only=False)
+        with np.errstate(over="ignore"):  # overflow handled below
+            out = host.astype(np.float32)
+        if host.dtype == np.float64:
+            overflow = np.isinf(out) & np.isfinite(host)
+            n = int(np.count_nonzero(overflow))
+            if n:
+                _ENCODE_OVERFLOW.inc(n)
+                np.copyto(out, np.sign(host).astype(np.float32) * _F32_MAX,
+                          where=overflow)
+        return out, ColumnEncoding("numeric", t)
     if pa.types.is_integer(t):
         np_col = col.to_numpy(zero_copy_only=False)
         if np_col.dtype in (np.int8, np.int16, np.int32, np.uint8, np.uint16):

@@ -110,6 +110,26 @@ def build_app(state: ServerState) -> web.Application:
         return web.Response(text=registry.render(),
                             content_type="text/plain")
 
+    @routes.post("/admin/scrub")
+    async def admin_scrub(req: web.Request) -> web.Response:
+        """On-demand orphan scrub across every table (storage/gc.py).
+        Optional ?grace_ms= overrides the configured grace period for
+        this pass only (grace_ms=0 reclaims everything currently
+        observed as orphaned — operator big-hammer, use with care)."""
+        grace_s = None
+        raw = req.query.get("grace_ms")
+        if raw is not None:
+            try:
+                grace_s = int(raw) / 1000.0
+            except ValueError:
+                return web.json_response(
+                    {"error": f"bad grace_ms: {raw!r}"}, status=400)
+        out = {}
+        for name, table in state.engine.tables.items():
+            report = await table.scrub(grace_override_s=grace_s)
+            out[name] = report.as_dict()
+        return web.json_response(out)
+
     @routes.get("/stats")
     async def stats(_req: web.Request) -> web.Response:
         # data-volume load signal for cluster rebalancing (rows/bytes
@@ -387,16 +407,21 @@ def _downsample_json(out: dict) -> dict:
 
 
 def _build_store(config: ServerConfig):
+    from horaedb_tpu.objstore import InstrumentedStore
+
     oc = config.metric_engine.object_store
     if oc.kind == "S3Like":
         from horaedb_tpu.objstore.s3 import S3ObjectStore, S3Options
 
-        return S3ObjectStore(S3Options(
+        store = S3ObjectStore(S3Options(
             endpoint=oc.s3.endpoint, region=oc.s3.region or "us-east-1",
             bucket=oc.s3.bucket, access_key_id=oc.s3.key_id,
             secret_access_key=oc.s3.key_secret, prefix=oc.s3.prefix,
             max_retries=oc.s3.max_retries))
-    return LocalObjectStore(oc.data_dir)
+    else:
+        store = LocalObjectStore(oc.data_dir)
+    # per-op objstore counters/latency histograms surface at /metrics
+    return InstrumentedStore(store)
 
 
 async def run_server(config: ServerConfig,

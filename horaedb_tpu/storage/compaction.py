@@ -29,6 +29,7 @@ from typing import TYPE_CHECKING, Optional
 import pyarrow as pa
 
 from horaedb_tpu.common.error import Error, ensure
+from horaedb_tpu.common.tasks import cancel_and_wait
 from horaedb_tpu.common.time_ext import now_ms
 from horaedb_tpu.storage import parquet_io, sidecar
 from horaedb_tpu.storage.manifest import ManifestUpdate
@@ -373,21 +374,36 @@ class Scheduler:
         self.picker = Picker(storage)
         self.executor = Executor(storage, self._trigger)
         self._loops: list[asyncio.Task] = []
+        # loops check this at every turn: a cancel delivered exactly as
+        # a trigger token completes the wait_for is SWALLOWED
+        # (bpo-37658), so cancellation alone cannot be the only exit
+        self._stopping = False
 
     async def start(self) -> None:
+        self._stopping = False
         self._loops = [
             asyncio.create_task(self._generate_task_loop(), name="compact-picker"),
             asyncio.create_task(self._recv_task_loop(), name="compact-executor"),
         ]
+        # the orphan scrubber rides the compaction scheduler's lifecycle:
+        # same background-loop ownership, stopped by the same stop()
+        scrub_cfg = self.storage.config.scrub
+        if scrub_cfg.enabled:
+            self._loops.append(asyncio.create_task(
+                self._scrub_loop(scrub_cfg.interval.seconds),
+                name="orphan-scrubber"))
 
     async def stop(self) -> None:
+        # flag + cancel_and_wait, not cancel+await: trigger tokens race
+        # stop() by design (a failing execute's trigger_more vs close),
+        # and with a dead store the pick→execute→trigger cycle produces
+        # tokens continuously, so EVERY cancel can land on a completed
+        # wait_for and be swallowed (bpo-37658) — the flag guarantees
+        # the loop exits at its next turn regardless (the torture
+        # harness reproduces the hang in a few hundred schedules)
+        self._stopping = True
         for t in self._loops:
-            t.cancel()
-        for t in self._loops:
-            try:
-                await t
-            except asyncio.CancelledError:
-                pass
+            await cancel_and_wait(t)
         self._loops = []
 
     async def trigger(self) -> None:
@@ -398,12 +414,14 @@ class Scheduler:
             pass
 
     async def _generate_task_loop(self) -> None:
-        while True:
+        while not self._stopping:
             try:
                 await asyncio.wait_for(self._trigger.get(),
                                        timeout=self.interval_s)
             except (TimeoutError, asyncio.TimeoutError):
                 pass
+            if self._stopping:
+                return
             # picker must run serially (in_compaction marking is the lock);
             # transient store errors must not kill the loop
             try:
@@ -421,9 +439,27 @@ class Scheduler:
                         f.unmark_compaction()
 
     async def _recv_task_loop(self) -> None:
-        while True:
+        failure_streak = 0
+        while not self._stopping:
             task = await self._tasks.get()
             try:
                 await self.executor.execute(task)
+                failure_streak = 0
             except Exception:
                 logger.exception("compaction task failed")
+                # back off on repeated failure: a dead store otherwise
+                # spins the pick→execute→trigger cycle at full speed (a
+                # retry storm against a struggling backend, and a
+                # shutdown that can never land a cancellation)
+                failure_streak += 1
+                await asyncio.sleep(min(5.0, 0.05 * 2 ** failure_streak))
+
+    async def _scrub_loop(self, interval_s: float) -> None:
+        while not self._stopping:
+            await asyncio.sleep(interval_s)
+            try:
+                report = await self.storage.scrubber.scrub()
+                if report.orphans_deleted or report.errors:
+                    logger.info("scrub pass: %s", report.as_dict())
+            except Exception:
+                logger.exception("orphan scrub pass failed; will retry")

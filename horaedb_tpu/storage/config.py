@@ -108,6 +108,42 @@ class ManifestConfig:
 
 
 @dataclass
+class RetryConfig:
+    """Object-store retry middleware for the manifest plane (no
+    reference analogue — see objstore/middleware.py).  This is the ONE
+    engine-level retry layer: the S3 backend keeps its protocol-level
+    retries, and the data plane (SST puts/reads) stays single-shot so
+    write-path failures surface to the caller's rollback discipline."""
+
+    enabled: bool = True
+    max_retries: int = 2
+    base_backoff: ReadableDuration = field(
+        default_factory=lambda: ReadableDuration.from_millis(50))
+    max_backoff: ReadableDuration = field(
+        default_factory=lambda: ReadableDuration.from_secs(2))
+    # total per-op wall clock including retries; None = unbounded
+    op_deadline: Optional[ReadableDuration] = None
+    # shared retry token bucket: capacity + refill rate (tokens/second)
+    budget: int = 32
+    budget_refill_per_s: float = 4.0
+
+
+@dataclass
+class ScrubConfig:
+    """Orphan scrubber (storage/gc.py): reconciles data/ objects against
+    the manifest and deletes unreferenced objects that stay orphaned for
+    a full grace period.  The grace period must comfortably exceed the
+    longest plausible gap between an SST put and its manifest add (a
+    write or compaction in flight) — minutes, not seconds."""
+
+    enabled: bool = True
+    interval: ReadableDuration = field(
+        default_factory=lambda: ReadableDuration.from_secs(600))
+    grace_period: ReadableDuration = field(
+        default_factory=lambda: ReadableDuration.from_secs(600))
+
+
+@dataclass
 class ScanConfig:
     """Device scan execution knobs (no reference analogue — the TPU
     build's HBM-budget control, SURVEY.md hard part #5)."""
@@ -175,10 +211,14 @@ class StorageConfig:
     scheduler: SchedulerConfig = field(default_factory=SchedulerConfig)
     scan: ScanConfig = field(default_factory=ScanConfig)
     threads: ThreadsConfig = field(default_factory=ThreadsConfig)
+    retry: RetryConfig = field(default_factory=RetryConfig)
+    scrub: ScrubConfig = field(default_factory=ScrubConfig)
     update_mode: UpdateMode = UpdateMode.OVERWRITE
 
 
-_DURATION_FIELDS = {"schedule_interval", "merge_interval", "ttl"}
+_DURATION_FIELDS = {"schedule_interval", "merge_interval", "ttl",
+                    "soft_merge_max_wait", "base_backoff", "max_backoff",
+                    "op_deadline", "interval", "grace_period"}
 _SIZE_FIELDS = {"memory_limit", "new_sst_max_size"}
 # Nested sections, keyed by field name.  This dict is THE mechanism for
 # nested coercion: add new nested config dataclasses here.
@@ -188,6 +228,8 @@ _NESTED = {
     "scheduler": SchedulerConfig,
     "scan": ScanConfig,
     "threads": ThreadsConfig,
+    "retry": RetryConfig,
+    "scrub": ScrubConfig,
 }
 
 

@@ -20,6 +20,7 @@ import logging
 
 from horaedb_tpu.common.error import Error
 from horaedb_tpu.common.id_alloc import MonotonicIdAllocator
+from horaedb_tpu.common.tasks import cancel_and_wait
 from horaedb_tpu.objstore import NotFoundError, ObjectStore
 from horaedb_tpu.storage.config import ManifestConfig
 from horaedb_tpu.storage.manifest.encoding import (
@@ -39,6 +40,13 @@ SNAPSHOT_FILENAME = "snapshot"
 DELTA_PREFIX = "delta"
 
 _DELTA_IDS = MonotonicIdAllocator()
+
+
+def _delta_order(path: str) -> int:
+    """Numeric delta-file ordering (lexicographic order breaks when id
+    digit counts differ)."""
+    name = path.rsplit("/", 1)[-1]
+    return int(name) if name.isdigit() else -1
 
 
 async def _read_snapshot_bytes(store: ObjectStore, path: str) -> bytes:
@@ -67,6 +75,9 @@ class _Merger:
         self.deltas_num = 0
         self._signal: asyncio.Queue[None] = asyncio.Queue(maxsize=config.channel_size)
         self._task: asyncio.Task | None = None
+        # checked each loop turn: merge signals racing stop() can make
+        # wait_for swallow the cancellation (bpo-37658)
+        self._stopping = False
         # Serializes folds: the reference funnels every merge through one
         # consumer task; we allow trigger_merge() alongside the background
         # loop, so an explicit lock keeps a delta from being folded twice
@@ -74,27 +85,30 @@ class _Merger:
         self._merge_lock = asyncio.Lock()
 
     def start(self) -> None:
+        self._stopping = False
         self._task = asyncio.create_task(self._run(), name="manifest-merger")
 
     async def stop(self) -> None:
+        self._stopping = True
         if self._task is not None:
-            self._task.cancel()
-            try:
-                await self._task
-            except asyncio.CancelledError:
-                pass
+            # merge signals race stop() exactly like compaction triggers
+            # do — re-deliver the cancel past the wait_for swallow race
+            # (see common/tasks.py)
+            await cancel_and_wait(self._task)
             self._task = None
 
     async def _run(self) -> None:
         interval = self.config.merge_interval.seconds
         logger.info("start manifest merge background job, interval=%ss", interval)
-        while True:
+        while not self._stopping:
             try:
                 await asyncio.wait_for(self._signal.get(), timeout=interval)
             except TimeoutError:
                 pass
             except asyncio.TimeoutError:  # Python < 3.11 alias
                 pass
+            if self._stopping:
+                return
             if self.deltas_num > self.config.min_merge_threshold:
                 try:
                     await self.do_merge(first_run=False)
@@ -159,16 +173,28 @@ class _Merger:
         else:
             new_snapshot = await asyncio.to_thread(fold)
 
-        # 1. Persist the snapshot, 2. then best-effort delete merged deltas.
+        # 1. Persist the snapshot, 2. then delete merged deltas — OLDEST
+        # FIRST, stopping at the first failure so survivors always form
+        # a SUFFIX of the folded batch.  Ids are never reused, so the
+        # delta deleting file X always has a larger id than the delta
+        # that added X; suffix survival therefore keeps every add with
+        # its matching delete, and recovery's re-fold stays a no-op.  A
+        # parallel best-effort delete could reap the delete-delta while
+        # its add-delta survived — the re-fold would then RESURRECT a
+        # manifest entry whose object is long gone (a permanent ghost
+        # every scan trips over).
         await self.store.put(self.snapshot_path, new_snapshot)
-        results = await asyncio.gather(
-            *(self.store.delete(p) for p in paths), return_exceptions=True
-        )
-        for path, res in zip(paths, results):
-            if isinstance(res, BaseException):
-                logger.error("failed to delete delta %s: %s", path, res)
-            else:
-                self.deltas_num -= 1
+        for path in sorted(paths, key=_delta_order):
+            try:
+                await self.store.delete(path)
+            except NotFoundError:
+                pass  # already reaped (e.g. by a prior partial pass)
+            except Exception as e:  # noqa: BLE001 — next fold retries
+                logger.error(
+                    "failed to delete delta %s: %s (stopping; remaining "
+                    "deltas re-fold on the next merge)", path, e)
+                break
+            self.deltas_num -= 1
 
 
 class Manifest:

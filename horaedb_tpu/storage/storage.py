@@ -27,8 +27,14 @@ import pyarrow.compute as pc
 import logging
 
 from horaedb_tpu.common.error import ensure
-from horaedb_tpu.objstore import NotFoundError, ObjectStore
+from horaedb_tpu.objstore import (
+    NotFoundError,
+    ObjectStore,
+    RetryingObjectStore,
+    RetryPolicy,
+)
 from horaedb_tpu.storage import parquet_io, sidecar
+from horaedb_tpu.storage.gc import Scrubber, ScrubReport
 from horaedb_tpu.storage.config import StorageConfig, UpdateMode
 from horaedb_tpu.storage.manifest import Manifest
 from horaedb_tpu.storage.read import ParquetReader, ScanPlan, ScanRequest
@@ -97,6 +103,7 @@ class CloudObjectStorage(TimeMergeStorage):
         self._schema = StorageSchema.try_new(user_schema, num_primary_keys,
                                              config.update_mode)
         self.manifest: Optional[Manifest] = None
+        self.scrubber: Optional[Scrubber] = None
         # dedicated worker pools (ref: StorageRuntimes, storage.rs:91-104);
         # shared when a parent (e.g. MetricEngine) passes its own
         self._own_runtimes = runtimes is None
@@ -109,12 +116,40 @@ class CloudObjectStorage(TimeMergeStorage):
     @classmethod
     async def open(cls, *args, **kwargs) -> "CloudObjectStorage":
         self = cls(*args, **kwargs)
-        self.manifest = await Manifest.open(self.root_path, self.store,
+        # The manifest plane gets the engine's ONE retry layer: a single
+        # transient store error must not fail an otherwise-healthy
+        # acknowledged write on backends without built-in retries.  The
+        # data plane stays single-shot — SST put failures surface to the
+        # write path's rollback discipline (and its tests).
+        manifest_store: ObjectStore = self.store
+        rc = self.config.retry
+        if rc.enabled:
+            manifest_store = RetryingObjectStore(self.store, RetryPolicy(
+                max_retries=rc.max_retries,
+                base_backoff_s=rc.base_backoff.seconds,
+                max_backoff_s=rc.max_backoff.seconds,
+                op_deadline_s=(rc.op_deadline.seconds
+                               if rc.op_deadline else None),
+                budget=float(rc.budget),
+                budget_refill_per_s=rc.budget_refill_per_s))
+        self.manifest = await Manifest.open(self.root_path, manifest_store,
                                             self.config.manifest,
                                             runtimes=self.runtimes)
+        # the scrubber reconciles against the RAW store: its deletes are
+        # already a retry loop (next pass), and reads that fail simply
+        # postpone reclamation
+        self.scrubber = Scrubber(self.root_path, self.store, self.manifest,
+                                 self.config.scrub.grace_period.seconds)
         self.reader.resolve_segment_ssts = self._segment_ssts_now
         await self._start_compaction()
         return self
+
+    async def scrub(self, grace_override_s: Optional[float] = None
+                    ) -> ScrubReport:
+        """One orphan-reconcile pass (see storage/gc.py); also the
+        POST /admin/scrub entry point."""
+        ensure(self.scrubber is not None, "storage not opened")
+        return await self.scrubber.scrub(grace_override_s=grace_override_s)
 
     async def _segment_ssts_now(self, segment_start: int,
                                 scan_range: Optional[TimeRange]):

@@ -6,8 +6,15 @@ failures at every discipline point and assert the invariants hold:
 
   - an acknowledged write is durable and queryable after recovery
   - a failed write leaves no manifest entry (no ghost files)
+  - a transient manifest fault is absorbed by the retry middleware
   - a failed compaction unmarks inputs and loses nothing
   - a crash between snapshot put and delta GC replays idempotently
+  - a PARTIAL delta GC never resurrects ghosts (suffix-survival rule)
+  - the orphan scrubber reclaims leaked objects after the grace period
+
+Fault injection uses the library FaultInjectingStore
+(objstore/middleware.py) — the one implementation shared with the
+torture harness in test_torture.py.
 """
 
 import asyncio
@@ -16,7 +23,7 @@ import pyarrow as pa
 import pytest
 
 from horaedb_tpu.common import ReadableDuration
-from horaedb_tpu.objstore import MemoryObjectStore
+from horaedb_tpu.objstore import FaultInjectingStore
 from horaedb_tpu.storage.config import StorageConfig, from_dict
 from horaedb_tpu.storage.read import ScanRequest
 from horaedb_tpu.storage.storage import CloudObjectStorage, WriteRequest
@@ -24,34 +31,9 @@ from horaedb_tpu.storage.types import TimeRange
 
 SEGMENT_MS = 3_600_000
 
-
-class FlakyStore(MemoryObjectStore):
-    """Injects one-shot failures keyed by (op, path-substring)."""
-
-    def __init__(self):
-        super().__init__()
-        self.failures: list[tuple[str, str]] = []
-
-    def fail_next(self, op: str, path_part: str) -> None:
-        self.failures.append((op, path_part))
-
-    def _maybe_fail(self, op: str, path: str) -> None:
-        for i, (fop, part) in enumerate(self.failures):
-            if fop == op and part in path:
-                del self.failures[i]
-                raise OSError(f"injected {op} failure for {path}")
-
-    async def put(self, path, data):
-        self._maybe_fail("put", path)
-        return await super().put(path, data)
-
-    async def get(self, path):
-        self._maybe_fail("get", path)
-        return await super().get(path)
-
-    async def delete(self, path):
-        self._maybe_fail("delete", path)
-        return await super().delete(path)
+# sticky: outlives the retry middleware's max_retries, so "the put
+# failed" keeps meaning what it meant before retries existed
+STICKY = -1
 
 
 def schema():
@@ -70,6 +52,10 @@ async def open_storage(store, **cfg_over):
     cfg = from_dict(StorageConfig, {"scheduler": {"schedule_interval": "1h",
                                                   **cfg_over}})
     cfg.manifest.merge_interval = ReadableDuration.parse("1h")
+    cfg.scrub.interval = ReadableDuration.parse("1h")
+    # keep retry exhaustion fast: the contract under test is attempt
+    # counts and rollback, not wall-clock backoff
+    cfg.retry.base_backoff = ReadableDuration.from_millis(1)
     return await CloudObjectStorage.open("db", SEGMENT_MS, store, schema(), 2,
                                          cfg)
 
@@ -85,14 +71,15 @@ async def scan_rows(s):
 class TestWriteFaults:
     def test_failed_sst_put_leaves_no_ghost(self):
         async def go():
-            store = FlakyStore()
+            store = FaultInjectingStore()
             s = await open_storage(store)
             try:
                 await s.write(WriteRequest(batch([("a", 1, 1.0)]),
                                            TimeRange.new(1, 2)))
                 # target the SST object specifically — the sidecar put
                 # runs concurrently under the same /data/ prefix and its
-                # failures are (deliberately) swallowed
+                # failures are (deliberately) swallowed.  The data plane
+                # has no retry layer, so one fault fails the write.
                 store.fail_next("put", ".sst")
                 with pytest.raises(OSError):
                     await s.write(WriteRequest(batch([("b", 2, 2.0)]),
@@ -113,7 +100,7 @@ class TestWriteFaults:
         """The sidecar is a cache: its put failing must not fail the
         write, and the SST stays fully readable without it."""
         async def go():
-            store = FlakyStore()
+            store = FaultInjectingStore()
             s = await open_storage(store)
             try:
                 store.fail_next("put", ".enc")
@@ -127,12 +114,32 @@ class TestWriteFaults:
 
         asyncio.run(go())
 
-    def test_failed_delta_put_rolls_back_ack(self):
+    def test_transient_delta_put_is_retried(self):
+        """One transient manifest fault must NOT fail an otherwise
+        healthy write: the retry middleware absorbs it (this is what
+        the S3 backend always had and every other backend lacked)."""
         async def go():
-            store = FlakyStore()
+            store = FaultInjectingStore()
             s = await open_storage(store)
             try:
-                store.fail_next("put", "/manifest/delta/")
+                store.fail_next("put", "/manifest/delta/")  # one-shot
+                res = await s.write(WriteRequest(batch([("a", 1, 1.0)]),
+                                                 TimeRange.new(1, 2)))
+                assert res.size > 0
+                assert await scan_rows(s) == [("a", 1, 1.0)]
+                assert s.manifest.deltas_num == 1
+            finally:
+                await s.close()
+
+        asyncio.run(go())
+
+    def test_failed_delta_put_rolls_back_ack(self):
+        """Retry exhaustion (sticky fault) still rolls the ack back."""
+        async def go():
+            store = FaultInjectingStore()
+            s = await open_storage(store)
+            try:
+                store.fail_next("put", "/manifest/delta/", times=STICKY)
                 with pytest.raises(OSError):
                     await s.write(WriteRequest(batch([("a", 1, 1.0)]),
                                                TimeRange.new(1, 2)))
@@ -140,6 +147,11 @@ class TestWriteFaults:
                 # acceptable garbage, never data)
                 assert await scan_rows(s) == []
                 assert s.manifest.deltas_num == 0  # counter rolled back
+                # the orphan SST is scrub fodder once past grace
+                store.clear_faults()
+                report = await s.scrub(grace_override_s=0.0)
+                assert report.orphans_deleted >= 1
+                assert [m for m in await store.list("db/data/")] == []
             finally:
                 await s.close()
 
@@ -147,7 +159,7 @@ class TestWriteFaults:
 
     def test_acknowledged_writes_survive_recovery(self):
         async def go():
-            store = FlakyStore()
+            store = FaultInjectingStore()
             s = await open_storage(store)
             await s.write(WriteRequest(batch([("a", 1, 1.0)]),
                                        TimeRange.new(1, 2)))
@@ -167,6 +179,10 @@ class TestWriteFaults:
 class TestCompactionFaults:
     async def _setup(self, store):
         s = await open_storage(store, input_sst_min_num=2)
+        # these tests drive the picker/executor BY HAND; the background
+        # loops must not race them for the same candidates (the failed
+        # execute's trigger_more would wake the background picker)
+        await s.compact_scheduler.stop()
         for i in range(3):
             await s.write(WriteRequest(batch([("k", 1, float(i))]),
                                        TimeRange.new(1, 2)))
@@ -174,7 +190,7 @@ class TestCompactionFaults:
 
     def test_failed_output_put_unmarks_and_recovers(self):
         async def go():
-            store = FlakyStore()
+            store = FaultInjectingStore()
             s = await self._setup(store)
             try:
                 task = await s.compact_scheduler.picker.pick_candidate()
@@ -196,11 +212,12 @@ class TestCompactionFaults:
 
         asyncio.run(go())
 
-    def test_failed_input_delete_is_tolerated(self):
-        """Old objects may leak; data must not duplicate or vanish."""
+    def test_failed_input_delete_is_tolerated_then_scrubbed(self):
+        """Old objects may leak; data must not duplicate or vanish —
+        and the scrubber reclaims the leak once it is past grace."""
 
         async def go():
-            store = FlakyStore()
+            store = FaultInjectingStore()
             s = await self._setup(store)
             try:
                 task = await s.compact_scheduler.picker.pick_candidate()
@@ -212,6 +229,22 @@ class TestCompactionFaults:
                 objs = await store.list("db/data/")
                 ssts = [m for m in objs if m.path.endswith(".sst")]
                 assert len(ssts) == 2  # 1 live + 1 leaked
+
+                # within grace: observed, never deleted
+                report = await s.scrub(grace_override_s=3600.0)
+                assert report.orphans_seen >= 1
+                assert report.orphans_deleted == 0
+                objs = await store.list("db/data/")
+                assert len([m for m in objs if m.path.endswith(".sst")]) == 2
+
+                # past grace: reclaimed; the referenced SST is intact
+                report = await s.scrub(grace_override_s=0.0)
+                assert report.orphans_deleted >= 1
+                live_id = (await s.manifest.all_ssts())[0].id
+                remaining = await store.list("db/data/")
+                assert {m.path.rsplit("/", 1)[-1].split(".")[0]
+                        for m in remaining} == {str(live_id)}
+                assert await scan_rows(s) == [("k", 1, 2.0)]
             finally:
                 await s.close()
 
@@ -221,25 +254,68 @@ class TestCompactionFaults:
 class TestManifestMergeFaults:
     def test_crash_between_snapshot_put_and_delta_gc(self):
         async def go():
-            store = FlakyStore()
+            store = FaultInjectingStore()
             s = await open_storage(store)
             await s.write(WriteRequest(batch([("a", 1, 1.0)]),
                                        TimeRange.new(1, 2)))
             await s.write(WriteRequest(batch([("a", 1, 2.0)]),
                                        TimeRange.new(1, 2)))
-            # merge succeeds in writing the snapshot but delta deletes fail
-            store.fail_next("delete", "/manifest/delta/")
-            store.fail_next("delete", "/manifest/delta/")
+            # merge succeeds in writing the snapshot but delta deletes
+            # fail (sticky: the retry layer must exhaust too)
+            store.fail_next("delete", "/manifest/delta/", times=STICKY)
             await s.manifest.trigger_merge()
             leftover = await store.list("db/manifest/delta/")
             assert leftover  # deltas survived the "crash"
             await s.close()
+            store.clear_faults()
 
             # recovery replays the deltas onto the already-folded snapshot
             s2 = await open_storage(store)
             try:
                 assert await scan_rows(s2) == [("a", 1, 2.0)]
                 assert len(await s2.manifest.all_ssts()) == 2
+                assert await store.list("db/manifest/delta/") == []
+            finally:
+                await s2.close()
+
+        asyncio.run(go())
+
+    def test_partial_delta_gc_never_resurrects_ghosts(self):
+        """Regression for the suffix-survival rule: if the delta that
+        ADDED a file survives GC while the delta that DELETED it (via
+        compaction) is reaped, recovery's re-fold would resurrect a
+        manifest entry whose object is gone — a permanent ghost.  The
+        merger deletes oldest-first and stops on the first failure, so
+        a surviving add always keeps its delete alongside."""
+        async def go():
+            store = FaultInjectingStore()
+            s = await open_storage(store, input_sst_min_num=2)
+            await s.compact_scheduler.stop()  # manual picker/executor
+            for i in range(3):
+                await s.write(WriteRequest(batch([("k", 1, float(i))]),
+                                           TimeRange.new(1, 2)))
+            # compaction: adds the output delta {add out, delete inputs}
+            # and deletes the input OBJECTS for real
+            task = await s.compact_scheduler.picker.pick_candidate()
+            await s.compact_scheduler.executor.execute(task)
+            deltas = [m.path for m in await store.list("db/manifest/delta/")]
+            assert len(deltas) == 4  # 3 adds + 1 compaction update
+            # the OLDEST delta (an input's add) refuses to die
+            oldest = min(deltas, key=lambda p: int(p.rsplit("/", 1)[-1]))
+            store.fail_next("delete", oldest, times=STICKY)
+            await s.manifest.trigger_merge()
+            # stop-on-first-failure: every delta survived, not a subset
+            leftover = await store.list("db/manifest/delta/")
+            assert len(leftover) == 4
+            await s.close()
+            store.clear_faults()
+
+            s2 = await open_storage(store)
+            try:
+                # the re-fold is idempotent: one SST, no ghost entries
+                # pointing at deleted input objects
+                assert len(await s2.manifest.all_ssts()) == 1
+                assert await scan_rows(s2) == [("k", 1, 2.0)]
                 assert await store.list("db/manifest/delta/") == []
             finally:
                 await s2.close()

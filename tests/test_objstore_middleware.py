@@ -1,0 +1,237 @@
+"""Unit tests for the object-store middleware (objstore/middleware.py):
+retry exhaustion/absorption, per-op deadlines, NotFound passthrough,
+retry budget, fault-injection semantics, and metrics emission."""
+
+import asyncio
+import random
+
+import pytest
+
+from horaedb_tpu.objstore import (
+    DeadlineExceededError,
+    FaultInjectingStore,
+    InjectedCrash,
+    InjectedFault,
+    InstrumentedStore,
+    MemoryObjectStore,
+    NotFoundError,
+    RetryingObjectStore,
+    RetryPolicy,
+)
+from horaedb_tpu.utils.metrics import MetricsRegistry
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def fast_policy(**over):
+    kw = dict(max_retries=2, base_backoff_s=0.001, max_backoff_s=0.002)
+    kw.update(over)
+    return RetryPolicy(**kw)
+
+
+class CountingStore(MemoryObjectStore):
+    """Counts raw op invocations under any middleware stack."""
+
+    def __init__(self):
+        super().__init__()
+        self.calls = 0
+
+    async def get(self, path):
+        self.calls += 1
+        return await super().get(path)
+
+    async def put(self, path, data):
+        self.calls += 1
+        return await super().put(path, data)
+
+
+class TestRetryingStore:
+    def test_transient_fault_is_absorbed(self):
+        async def go():
+            flaky = FaultInjectingStore(CountingStore())
+            store = RetryingObjectStore(flaky, fast_policy(),
+                                        rng=random.Random(0))
+            await store.put("k", b"v")
+            flaky.fail_next("get", "k")  # one-shot
+            assert await store.get("k") == b"v"
+            assert flaky.inner.calls == 2  # put + retried get... get only
+        run(go())
+
+    def test_exhaustion_raises_last_error(self):
+        async def go():
+            flaky = FaultInjectingStore(CountingStore())
+            store = RetryingObjectStore(flaky, fast_policy(max_retries=2),
+                                        rng=random.Random(0))
+            await store.put("k", b"v")
+            flaky.fail_next("get", "k", times=-1)  # sticky
+            with pytest.raises(InjectedFault):
+                await store.get("k")
+        run(go())
+
+    def test_not_found_passes_through_without_retry(self):
+        async def go():
+            inner = CountingStore()
+            store = RetryingObjectStore(inner, fast_policy(),
+                                        rng=random.Random(0))
+            with pytest.raises(NotFoundError):
+                await store.get("missing")
+            assert inner.calls == 1  # no retries on a semantic miss
+        run(go())
+
+    def test_deadline_bounds_total_time(self):
+        class SlowStore(MemoryObjectStore):
+            async def get(self, path):
+                await asyncio.sleep(0.5)
+                return await super().get(path)
+
+        async def go():
+            store = RetryingObjectStore(
+                SlowStore(), fast_policy(op_deadline_s=0.05),
+                rng=random.Random(0))
+            loop = asyncio.get_running_loop()
+            t0 = loop.time()
+            with pytest.raises(DeadlineExceededError):
+                await store.get("k")
+            assert loop.time() - t0 < 0.4  # well under one slow attempt
+        run(go())
+
+    def test_budget_exhaustion_fails_fast(self):
+        async def go():
+            flaky = FaultInjectingStore(CountingStore())
+            # 1 token, no refill: the first op may retry once; the
+            # second gets no retry at all
+            store = RetryingObjectStore(
+                flaky,
+                fast_policy(max_retries=3, budget=1.0,
+                            budget_refill_per_s=0.0),
+                rng=random.Random(0))
+            await store.put("k", b"v")
+            base = flaky.inner.calls
+            flaky.fail_next("get", "k")
+            assert await store.get("k") == b"v"  # used the only token
+            flaky.fail_next("get", "k")
+            with pytest.raises(InjectedFault):
+                await store.get("k")  # no token -> no retry
+            assert flaky.inner.calls == base + 1  # only the first retried
+        run(go())
+
+
+class TestFaultInjectingStore:
+    def test_scripted_one_shot_and_sticky(self):
+        async def go():
+            store = FaultInjectingStore()
+            await store.put("a/b", b"x")
+            store.fail_next("get", "a/")
+            with pytest.raises(InjectedFault):
+                await store.get("a/b")
+            assert await store.get("a/b") == b"x"  # consumed
+            store.fail_next("get", "a/", times=-1)
+            for _ in range(3):
+                with pytest.raises(InjectedFault):
+                    await store.get("a/b")
+            store.clear_faults()
+            assert await store.get("a/b") == b"x"
+        run(go())
+
+    def test_after_mode_applies_op_then_raises(self):
+        async def go():
+            store = FaultInjectingStore()
+            store.fail_next("put", "k", after=True)
+            with pytest.raises(InjectedFault):
+                await store.put("k", b"v")
+            # the op landed; only the ack was lost
+            assert await store.get("k") == b"v"
+        run(go())
+
+    def test_crash_halts_until_revive(self):
+        async def go():
+            store = FaultInjectingStore(crash_at=3)
+            await store.put("a", b"1")
+            await store.put("b", b"2")
+            with pytest.raises((InjectedCrash, InjectedFault)):
+                await store.put("c", b"3")
+                await store.get("a")
+            # halted: everything fails now
+            with pytest.raises(InjectedFault):
+                await store.get("a")
+            store.revive()
+            assert await store.get("a") == b"1"
+        run(go())
+
+    def test_probabilistic_faults_are_seed_deterministic(self):
+        async def outcomes(seed):
+            store = FaultInjectingStore(seed=seed, fault_rate=0.3)
+            out = []
+            for i in range(40):
+                try:
+                    await store.put(f"k{i}", b"v")
+                    out.append("ok")
+                except InjectedFault:
+                    out.append("fault")
+            return out
+
+        async def go():
+            a = await outcomes(7)
+            b = await outcomes(7)
+            c = await outcomes(8)
+            assert a == b
+            assert "fault" in a and "ok" in a
+            assert a != c  # different seed, different schedule
+        run(go())
+
+    def test_put_rule_covers_put_stream(self):
+        async def go():
+            store = FaultInjectingStore()
+            store.fail_next("put", "obj")
+
+            async def chunks():
+                yield b"data"
+
+            with pytest.raises(InjectedFault):
+                await store.put_stream("obj", chunks())
+        run(go())
+
+
+class TestInstrumentedStore:
+    def test_counters_and_latency(self):
+        async def go():
+            metrics = MetricsRegistry()
+            flaky = FaultInjectingStore()
+            store = InstrumentedStore(flaky, metrics=metrics)
+            await store.put("k", b"v")
+            await store.get("k")
+            await store.get("k")
+            assert metrics.counter("objstore_put_total").value == 1
+            assert metrics.counter("objstore_get_total").value == 2
+            assert metrics.histogram("objstore_get_seconds").count == 2
+
+            # a miss is an answer, not an error
+            with pytest.raises(NotFoundError):
+                await store.get("missing")
+            assert metrics.counter("objstore_get_errors_total").value == 0
+
+            flaky.fail_next("get", "k")
+            with pytest.raises(InjectedFault):
+                await store.get("k")
+            assert metrics.counter("objstore_get_errors_total").value == 1
+            # the rendered exposition includes the op families
+            assert "objstore_put_seconds" in metrics.render()
+        run(go())
+
+    def test_composed_stack_roundtrip(self):
+        """The advertised composition order works end to end."""
+        async def go():
+            metrics = MetricsRegistry()
+            flaky = FaultInjectingStore()
+            store = InstrumentedStore(
+                RetryingObjectStore(flaky, fast_policy(),
+                                    rng=random.Random(0)),
+                metrics=metrics)
+            flaky.fail_next("put", "k")
+            await store.put("k", b"v")  # absorbed by the retry layer
+            assert await store.get("k") == b"v"
+            assert metrics.counter("objstore_put_errors_total").value == 0
+            assert [m.path for m in await store.list("")] == ["k"]
+        run(go())

@@ -60,6 +60,24 @@ class TestEncodeDecode:
         assert back.column(2).to_pylist() == pytest.approx(batch.column(2).to_pylist())
         assert back.column(3).to_pylist() == batch.column(3).to_pylist()
 
+    def test_f64_overflow_clamps_and_counts(self):
+        """VERDICT item 7: finite f64 values beyond the f32 range clamp
+        to ±f32::MAX (with a counter) instead of silently becoming inf;
+        true infinities pass through as the caller wrote them."""
+        from horaedb_tpu.ops.encode import encode_column
+        from horaedb_tpu.utils import registry
+
+        counter = registry.counter("horaedb_encode_overflow_total")
+        before = counter.value
+        col = pa.array([1e39, -1e39, 1.0, float("inf")], type=pa.float64())
+        dev, enc = encode_column(col, "v")
+        assert enc.kind == "numeric"
+        f32_max = np.finfo(np.float32).max
+        assert dev[0] == f32_max and dev[1] == -f32_max
+        assert dev[2] == np.float32(1.0)
+        assert np.isinf(dev[3])  # caller-supplied inf is not clamped
+        assert counter.value == before + 2
+
     def test_dict_codes_order_preserving(self):
         batch = pa.record_batch({"h": pa.array(["c", "a", "b", "a"])})
         dev = encode_batch(batch)

@@ -54,6 +54,26 @@ class TestEndpoints:
 
         run(go())
 
+    def test_admin_scrub_endpoint(self):
+        async def go():
+            client, _state, engine = await make_client()
+            try:
+                r = await client.post("/admin/scrub")
+                assert r.status == 200
+                body = await r.json()
+                # one report per engine table, with the reconcile fields
+                assert set(body) == set(engine.tables)
+                for report in body.values():
+                    assert {"data_objects", "referenced", "orphans_seen",
+                            "orphans_deleted"} <= set(report)
+                r = await client.post("/admin/scrub?grace_ms=banana")
+                assert r.status == 400
+            finally:
+                await client.close()
+                await engine.close()
+
+        run(go())
+
     def test_write_then_query_roundtrip(self):
         async def go():
             client, _state, engine = await make_client()

@@ -66,6 +66,27 @@ def rows_of(batches):
     return out
 
 
+def test_write_f64_overflow_clamps_to_f32_range():
+    """End-to-end overflow policy: a 1e39 value survives the f64→f32
+    device encoding as ±f32::MAX — finite, aggregate-safe — instead of
+    silently turning into inf (VERDICT item 7)."""
+    async def go():
+        s = await open_storage()
+        try:
+            await s.write(WriteRequest(
+                make_batch([("h", 5, 1e39), ("h", 6, -1e39)]),
+                TimeRange.new(5, 7)))
+            got = rows_of(await collect(
+                s.scan(ScanRequest(range=TimeRange.new(0, 100)))))
+            f32_max = float(np.finfo(np.float32).max)
+            assert [v for _, _, v in got] == [f32_max, -f32_max]
+            assert all(np.isfinite(v) for _, _, v in got)
+        finally:
+            await s.close()
+
+    asyncio.run(go())
+
+
 class TestWriteScan:
     def test_write_then_scan_dedups_across_files(self):
         """The reference's core scenario (storage.rs:390-490): two writes
