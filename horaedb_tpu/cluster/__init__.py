@@ -16,8 +16,10 @@ from horaedb_tpu.cluster.router import (
     RoutingTable,
     routing_key,
 )
-from horaedb_tpu.cluster.cluster import Cluster
+from horaedb_tpu.cluster.breaker import BreakerConfig, CircuitBreaker
+from horaedb_tpu.cluster.cluster import Cluster, GatherMeta
 from horaedb_tpu.cluster.remote import RemoteRegion
 
-__all__ = ["Cluster", "MAX_TTL", "PartitionRule", "RemoteRegion",
-           "RoutingTable", "routing_key"]
+__all__ = ["BreakerConfig", "CircuitBreaker", "Cluster", "GatherMeta",
+           "MAX_TTL", "PartitionRule", "RemoteRegion", "RoutingTable",
+           "routing_key"]

@@ -15,17 +15,53 @@ gRPC the same way, SURVEY.md P6.)
 from __future__ import annotations
 
 import asyncio
+import logging
+import time
+from dataclasses import dataclass, field
 from typing import Optional
 
 import pyarrow as pa
 
-from horaedb_tpu.common.error import ensure
+from horaedb_tpu.common import deadline as deadline_mod
+from horaedb_tpu.common.error import Error, ensure
 from horaedb_tpu.common.time_ext import now_ms
+from horaedb_tpu.cluster.breaker import (CLOSED as BREAKER_CLOSED,
+                                         BreakerConfig, CircuitBreaker)
 from horaedb_tpu.cluster.router import RoutingTable, routing_key
 from horaedb_tpu.metric_engine import MetricEngine, Sample
 from horaedb_tpu.objstore import ObjectStore
 from horaedb_tpu.storage.config import StorageConfig
 from horaedb_tpu.storage.types import TimeRange
+from horaedb_tpu.utils import registry
+
+logger = logging.getLogger(__name__)
+
+_GATHER_PARTIAL = registry.counter(
+    "cluster_gather_partial_total",
+    "scatter-gather queries answered with one or more regions missing")
+_RPC_TIMEOUTS = registry.counter(
+    "cluster_region_rpc_timeouts_total",
+    "remote region RPC attempts that hit their timeout budget")
+_RPC_RETRIES = registry.counter(
+    "cluster_region_rpc_retries_total",
+    "bounded single-retry attempts against remote regions")
+_HEDGES = registry.counter(
+    "cluster_hedged_rpcs_total",
+    "hedge requests fired after the hedge delay elapsed")
+_HEDGE_WINS = registry.counter(
+    "cluster_hedge_wins_total",
+    "hedged requests that beat the primary attempt")
+
+
+@dataclass
+class GatherMeta:
+    """Outcome marker for a degraded scatter-gather: which routed
+    regions contributed nothing and why.  `partial` is the wire-level
+    `partial: true` flag the server surfaces on /query* responses."""
+
+    partial: bool = False
+    missing_regions: list[int] = field(default_factory=list)
+    errors: dict[int, str] = field(default_factory=dict)
 
 
 class Cluster:
@@ -43,6 +79,24 @@ class Cluster:
         self._health_task: Optional[asyncio.Task] = None
         self._health_fails: dict[int, int] = {}
         self.dead_regions: set[int] = set()
+        # per-remote-region circuit breakers (docs/robustness.md):
+        # consecutive failures open the circuit; the health monitor's
+        # pings drive open -> half-open recovery
+        self.breakers: dict[int, CircuitBreaker] = {}
+        self._breaker_config = BreakerConfig()
+
+    @property
+    def breaker_config(self) -> BreakerConfig:
+        return self._breaker_config
+
+    @breaker_config.setter
+    def breaker_config(self, cfg: BreakerConfig) -> None:
+        """Re-point EXISTING breakers too: a server that applies its
+        [breaker] section after remote regions were attached must not
+        leave them on the defaults (order-independent configuration)."""
+        self._breaker_config = cfg
+        for br in self.breakers.values():
+            br.config = cfg
 
     @classmethod
     async def open(cls, root_path: str, store: ObjectStore,
@@ -120,6 +174,8 @@ class Cluster:
         ensure(region_id not in self.regions, f"region {region_id} exists")
         self.regions[region_id] = backend
         self._clear_dead_mark(region_id)  # fresh backend, fresh health
+        self.breakers[region_id] = CircuitBreaker(str(region_id),
+                                                  self.breaker_config)
         if (self._health_task is None
                 and getattr(backend, "ping", None) is not None):
             try:
@@ -131,9 +187,11 @@ class Cluster:
 
     def _clear_dead_mark(self, region_id: int) -> None:
         """A region whose backend changed (adopted locally, re-attached
-        remote) must not inherit a stale dead mark or failure count."""
+        remote) must not inherit a stale dead mark, failure count, or
+        breaker state."""
         self.dead_regions.discard(region_id)
         self._health_fails.pop(region_id, None)
+        self.breakers.pop(region_id, None)
 
     # ---- region movement --------------------------------------------------
 
@@ -248,13 +306,23 @@ class Cluster:
         alive: dict[int, bool] = {}
         for (rid, _p), ok in zip(targets, results):
             alive[rid] = ok
+            br = self.breakers.get(rid)
             if ok:
                 self._health_fails[rid] = 0
                 self.dead_regions.discard(rid)
+                if br is not None:
+                    # open circuits move to half-open on a good ping:
+                    # the next real query is the recovery probe
+                    br.on_ping_ok()
             else:
                 self._health_fails[rid] = self._health_fails.get(rid, 0) + 1
                 if self._health_fails[rid] >= self._HEALTH_FAILS:
                     self.dead_regions.add(rid)
+                if br is not None:
+                    # a dead peer opens its circuit even without query
+                    # traffic, so the first query after an outage skips
+                    # it instead of paying a connect timeout
+                    br.record_failure()
         return alive
 
     async def _health_loop(self, interval_s: float) -> None:
@@ -370,49 +438,7 @@ class Cluster:
             self.regions[rid].query_downsample(metric, filters, time_range,
                                                bucket_ms, field=field)
             for rid in rids))
-        results = [r for r in results if r["tsids"]]
-        num_buckets = -(-(int(time_range.end) - int(time_range.start))
-                        // bucket_ms)
-        if not results:
-            return {"tsids": [], "num_buckets": num_buckets, "aggs": {}}
-
-        import numpy as np
-
-        all_tsids = sorted({t for r in results for t in r["tsids"]})
-        idx = {t: i for i, t in enumerate(all_tsids)}
-        g = len(all_tsids)
-        agg = {"count": np.zeros((g, num_buckets)),
-               "sum": np.zeros((g, num_buckets)),
-               "min": np.full((g, num_buckets), np.inf),
-               "max": np.full((g, num_buckets), -np.inf),
-               "last": np.full((g, num_buckets), np.nan),
-               "last_ts": np.full((g, num_buckets), -np.inf)}
-        for r in results:
-            rows = np.asarray([idx[t] for t in r["tsids"]])
-            a = r["aggs"]
-            agg["count"][rows] += np.nan_to_num(np.asarray(a["count"]))
-            agg["sum"][rows] += np.nan_to_num(np.asarray(a["sum"]))
-            agg["min"][rows] = np.fmin(agg["min"][rows], np.asarray(a["min"]))
-            agg["max"][rows] = np.fmax(agg["max"][rows], np.asarray(a["max"]))
-            has = np.asarray(a["count"]) > 0
-            # winner by actual sample time (regions expose last_ts);
-            # ties break toward the later region in route order
-            cand_ts = np.nan_to_num(
-                np.asarray(a["last_ts"], dtype=np.float64), nan=-np.inf)
-            take = has & (cand_ts >= agg["last_ts"][rows])
-            last_rows = agg["last"][rows]
-            last_rows[take] = np.asarray(a["last"])[take]
-            agg["last"][rows] = last_rows
-            lt_rows = agg["last_ts"][rows]
-            lt_rows[take] = cand_ts[take]
-            agg["last_ts"][rows] = lt_rows
-        empty = agg["count"] == 0
-        with np.errstate(invalid="ignore"):
-            agg["avg"] = np.where(empty, np.nan,
-                                  agg["sum"] / np.maximum(agg["count"], 1))
-        agg["min"] = np.where(empty, np.inf, agg["min"])
-        agg["max"] = np.where(empty, -np.inf, agg["max"])
-        return {"tsids": all_tsids, "num_buckets": num_buckets, "aggs": agg}
+        return _merge_downsample(results, time_range, bucket_ms)
 
     async def label_values(self, metric: str, tag_key: str,
                            time_range: TimeRange) -> list[str]:
@@ -424,3 +450,299 @@ class Cluster:
         for r in results:
             out.update(r)
         return sorted(out)
+
+    # ---- degraded read (resilient scatter-gather) -------------------------
+    #
+    # The strict methods above fail the whole query when any routed
+    # region is unreachable — correct for consistency-sensitive
+    # callers, wrong for a serving path where one slow or dead region
+    # must not take down every dashboard.  The *_gather variants
+    # return the SURVIVING regions' data plus a GatherMeta marker
+    # (partial / missing_regions) instead:
+    #
+    #   * dead regions (heartbeat) and open-circuit regions are
+    #     skipped up front — no connect attempt, no timeout wait;
+    #   * every remote attempt is bounded by
+    #     min(breaker.rpc_timeout, ambient deadline remaining);
+    #   * failures and timeouts get ONE bounded retry (reads are
+    #     idempotent), breaker bookkeeping on every outcome;
+    #   * optional hedged reads: after hedge_delay with no response a
+    #     second identical request races the first.
+
+    def breaker_states(self) -> dict[int, str]:
+        """Per-region breaker state (ops/debug surface)."""
+        return {rid: br.state for rid, br in self.breakers.items()}
+
+    def _gather_targets(self, time_range: TimeRange
+                        ) -> tuple[list[int], dict[int, str]]:
+        """Split routed regions into live targets and skipped ones
+        (with reasons).  Unlike _query_regions, nothing raises."""
+        rids = self.routing.route_query(None, int(time_range.start),
+                                        int(time_range.end))
+        live: list[int] = []
+        skipped: dict[int, str] = {}
+        for rid in rids:
+            if rid not in self.regions:
+                skipped[rid] = "no attached backend (moved/detached?)"
+            elif rid in self.dead_regions:
+                skipped[rid] = "dead (heartbeat failing)"
+            else:
+                br = self.breakers.get(rid)
+                if br is not None and not br.allow():
+                    skipped[rid] = "circuit open"
+                else:
+                    live.append(rid)
+        return live, skipped
+
+    async def _call_region(self, rid: int, factory):
+        """One region's read RPC under the resilience policy.  `factory`
+        builds a fresh coroutine per attempt (retries and hedges need
+        independent coroutines)."""
+        backend = self.regions[rid]
+        br = self.breakers.get(rid)
+        if isinstance(backend, MetricEngine):
+            # local engines are bounded by the deadline checkpoints in
+            # the storage read path, not by an RPC timeout
+            return await factory()
+        cfg = self.breaker_config
+        cap = cfg.rpc_timeout.seconds or None
+        attempts = 1 + max(0, cfg.retries)
+        try:
+            return await self._call_region_attempts(rid, factory, br, cap,
+                                                    attempts)
+        except (asyncio.CancelledError, deadline_mod.DeadlineExceeded):
+            # exits that record NO outcome must still release a
+            # half-open probe slot this call may have claimed, or the
+            # breaker wedges rejecting until the next good ping
+            if br is not None:
+                br.abort_probe()
+            raise
+
+    async def _call_region_attempts(self, rid, factory, br,
+                                    cap: Optional[float], attempts: int):
+        last_exc: Optional[BaseException] = None
+        for attempt in range(attempts):
+            budget = deadline_mod.remaining_budget(cap)
+            if budget is not None and budget <= 0.001:
+                # the REQUEST ran out of time — not the region's fault,
+                # so no breaker failure is recorded
+                raise deadline_mod.DeadlineExceeded(
+                    f"region {rid}: no deadline budget left")
+            # when the deadline (not rpc_timeout) is what bounds this
+            # attempt, a timeout is the requester's deadline expiring —
+            # charging it to the region would open circuits on healthy
+            # peers whenever clients send tight deadlines
+            deadline_limited = (budget is not None
+                                and (cap is None or budget < cap))
+            if attempt:
+                _RPC_RETRIES.inc()
+            try:
+                result = await self._hedged_attempt(factory, budget)
+                if br is not None:
+                    br.record_success()
+                return result
+            except asyncio.CancelledError:
+                raise
+            except deadline_mod.DeadlineExceeded:
+                raise  # requester's deadline: no breaker bookkeeping
+            except asyncio.TimeoutError:
+                if deadline_limited:
+                    raise deadline_mod.DeadlineExceeded(
+                        f"region {rid}: request deadline expired "
+                        "mid-RPC")
+                _RPC_TIMEOUTS.inc()
+                if br is not None:
+                    br.record_failure()
+                shown = "unbounded" if budget is None else f"{budget:.3f}s"
+                last_exc = Error(
+                    f"region {rid} RPC timed out (budget {shown})")
+            except Exception as exc:
+                if br is not None:
+                    br.record_failure()
+                last_exc = exc
+            # the failure may have opened (or re-opened) the circuit:
+            # retrying into an open breaker is exactly the load
+            # multiplication it exists to prevent.  state (pure read)
+            # rather than allow(): allow() on a cooled-down breaker
+            # would CLAIM the half-open probe slot we are not about to
+            # use
+            if br is not None and br.state != BREAKER_CLOSED:
+                break
+        assert last_exc is not None
+        raise last_exc
+
+    async def _hedged_attempt(self, factory, budget: Optional[float]):
+        """One policy attempt, optionally hedged: if the primary has
+        not answered within hedge_delay, fire a second identical
+        request and take whichever SUCCEEDS first.  Reads only —
+        callers guarantee idempotency."""
+        delay = self.breaker_config.hedge_delay.seconds
+        if delay <= 0 or (budget is not None and delay >= budget):
+            return await asyncio.wait_for(factory(), budget)
+        primary = asyncio.ensure_future(factory())
+        tasks = [primary]
+        try:
+            done, _ = await asyncio.wait({primary}, timeout=delay)
+            if done:
+                return primary.result()  # raises the primary's error
+            _HEDGES.inc()
+            hedge = asyncio.ensure_future(factory())
+            tasks.append(hedge)
+            end = (None if budget is None
+                   else time.monotonic() + (budget - delay))
+            pending = set(tasks)
+            last_exc: Optional[BaseException] = None
+            while pending:
+                step = (None if end is None
+                        else max(0.0, end - time.monotonic()))
+                done, pending = await asyncio.wait(
+                    pending, timeout=step,
+                    return_when=asyncio.FIRST_COMPLETED)
+                if not done:
+                    raise asyncio.TimeoutError()
+                for t in done:
+                    if t.exception() is None:
+                        if t is not primary:
+                            _HEDGE_WINS.inc()
+                        return t.result()
+                    last_exc = t.exception()
+            assert last_exc is not None
+            raise last_exc
+        finally:
+            for t in tasks:
+                if not t.done():
+                    t.cancel()
+                elif not t.cancelled():
+                    # retrieve a loser's error so asyncio never logs
+                    # "Task exception was never retrieved" for the
+                    # attempt that lost the race
+                    t.exception()
+
+    async def _gather(self, time_range: TimeRange, factory_for
+                      ) -> tuple[dict[int, object], GatherMeta]:
+        """Degraded scatter-gather core: returns {rid: result} for the
+        regions that answered plus the GatherMeta marker.  Raises only
+        when EVERY routed region failed or was skipped — a query that
+        can return no region's data at all has nothing to degrade to."""
+        live, skipped = self._gather_targets(time_range)
+        outcomes = await asyncio.gather(
+            *(self._call_region(rid, factory_for(rid)) for rid in live),
+            return_exceptions=True)
+        results: dict[int, object] = {}
+        errors: dict[int, str] = dict(skipped)
+        for rid, out in zip(live, outcomes):
+            if isinstance(out, asyncio.CancelledError):
+                raise out
+            if isinstance(out, BaseException):
+                logger.warning("gather: region %s failed: %s", rid, out)
+                errors[rid] = str(out) or type(out).__name__
+            else:
+                results[rid] = out
+        missing = sorted(set(errors))
+        if not results:
+            dl = deadline_mod.current_deadline()
+            if dl is not None and dl.expired:
+                # every region "failed" because the request ran out of
+                # time — that is a deadline outcome (HTTP 504), not a
+                # region failure (400)
+                raise deadline_mod.DeadlineExceeded(
+                    "query deadline expired before any region answered: "
+                    f"{errors}")
+            raise Error(f"query failed in every routed region: {errors}")
+        if missing:
+            _GATHER_PARTIAL.inc()
+        meta = GatherMeta(partial=bool(missing), missing_regions=missing,
+                          errors=errors)
+        return results, meta
+
+    async def query_gather(self, metric: str,
+                           filters: list[tuple[str, str]],
+                           time_range: TimeRange, field: str = "value"
+                           ) -> tuple[pa.Table, GatherMeta]:
+        """Degraded row scatter-gather: surviving regions' rows plus
+        the partial/missing_regions marker."""
+        results, meta = await self._gather(
+            time_range,
+            lambda rid: lambda: self.regions[rid].query(
+                metric, filters, time_range, field=field))
+        return pa.concat_tables(list(results.values())), meta
+
+    async def query_downsample_gather(self, metric: str,
+                                      filters: list[tuple[str, str]],
+                                      time_range: TimeRange,
+                                      bucket_ms: int,
+                                      field: str = "value"
+                                      ) -> tuple[dict, GatherMeta]:
+        """Degraded downsample scatter-gather (same per-tsid merge as
+        the strict path)."""
+        results, meta = await self._gather(
+            time_range,
+            lambda rid: lambda: self.regions[rid].query_downsample(
+                metric, filters, time_range, bucket_ms, field=field))
+        return (_merge_downsample(list(results.values()), time_range,
+                                  bucket_ms), meta)
+
+    async def label_values_gather(self, metric: str, tag_key: str,
+                                  time_range: TimeRange
+                                  ) -> tuple[list[str], GatherMeta]:
+        """Degraded label-value union across surviving regions."""
+        results, meta = await self._gather(
+            time_range,
+            lambda rid: lambda: self.regions[rid].label_values(
+                metric, tag_key, time_range))
+        out: set[str] = set()
+        for vals in results.values():
+            out.update(vals)
+        return sorted(out), meta
+
+
+def _merge_downsample(results: list[dict], time_range: TimeRange,
+                      bucket_ms: int) -> dict:
+    """Merge per-region downsample grids by tsid (shared by the strict
+    and degraded gather paths).  Regions are series-disjoint in steady
+    state; during a split's TTL window an overlapping tsid combines
+    additively (sum/count/min/max; avg recomputed; `last` takes the
+    later sample time)."""
+    results = [r for r in results if r["tsids"]]
+    num_buckets = -(-(int(time_range.end) - int(time_range.start))
+                    // bucket_ms)
+    if not results:
+        return {"tsids": [], "num_buckets": num_buckets, "aggs": {}}
+
+    import numpy as np
+
+    all_tsids = sorted({t for r in results for t in r["tsids"]})
+    idx = {t: i for i, t in enumerate(all_tsids)}
+    g = len(all_tsids)
+    agg = {"count": np.zeros((g, num_buckets)),
+           "sum": np.zeros((g, num_buckets)),
+           "min": np.full((g, num_buckets), np.inf),
+           "max": np.full((g, num_buckets), -np.inf),
+           "last": np.full((g, num_buckets), np.nan),
+           "last_ts": np.full((g, num_buckets), -np.inf)}
+    for r in results:
+        rows = np.asarray([idx[t] for t in r["tsids"]])
+        a = r["aggs"]
+        agg["count"][rows] += np.nan_to_num(np.asarray(a["count"]))
+        agg["sum"][rows] += np.nan_to_num(np.asarray(a["sum"]))
+        agg["min"][rows] = np.fmin(agg["min"][rows], np.asarray(a["min"]))
+        agg["max"][rows] = np.fmax(agg["max"][rows], np.asarray(a["max"]))
+        has = np.asarray(a["count"]) > 0
+        # winner by actual sample time (regions expose last_ts);
+        # ties break toward the later region in route order
+        cand_ts = np.nan_to_num(
+            np.asarray(a["last_ts"], dtype=np.float64), nan=-np.inf)
+        take = has & (cand_ts >= agg["last_ts"][rows])
+        last_rows = agg["last"][rows]
+        last_rows[take] = np.asarray(a["last"])[take]
+        agg["last"][rows] = last_rows
+        lt_rows = agg["last_ts"][rows]
+        lt_rows[take] = cand_ts[take]
+        agg["last_ts"][rows] = lt_rows
+    empty = agg["count"] == 0
+    with np.errstate(invalid="ignore"):
+        agg["avg"] = np.where(empty, np.nan,
+                              agg["sum"] / np.maximum(agg["count"], 1))
+    agg["min"] = np.where(empty, np.inf, agg["min"])
+    agg["max"] = np.where(empty, -np.inf, agg["max"])
+    return {"tsids": all_tsids, "num_buckets": num_buckets, "aggs": agg}

@@ -6,25 +6,41 @@ server, so a region can live in any process that runs one).
 RemoteRegion duck-types the MetricEngine surface the Cluster facade uses
 (write / query / query_downsample / label_values / close), so a Cluster
 can mix in-process and remote regions freely.
+
+Every RPC is bounded: each call gets an `aiohttp.ClientTimeout` of
+`min(timeout_s, ambient deadline remaining)` — aiohttp's 5-minute
+default total timeout is never inherited (docs/robustness.md).  The
+remaining budget also rides ahead of the request as `X-Deadline-Ms`,
+so the peer's server can bind the same deadline for ITS downstream
+work instead of scanning for a client that already gave up.
 """
 
 from __future__ import annotations
 
+import math
 from typing import Optional
 
 import pyarrow as pa
 
 import aiohttp
 
+from horaedb_tpu.common.deadline import current_deadline, remaining_budget
 from horaedb_tpu.common.error import Error
 from horaedb_tpu.metric_engine.types import Sample
 from horaedb_tpu.storage.types import TimeRange
 
+# default per-RPC total timeout when no deadline is bound and no
+# override is configured; generous for bulk ingest, far below aiohttp's
+# 5-minute default
+DEFAULT_RPC_TIMEOUT_S = 60.0
+
 
 class RemoteRegion:
     def __init__(self, base_url: str,
-                 session: Optional[aiohttp.ClientSession] = None):
+                 session: Optional[aiohttp.ClientSession] = None,
+                 timeout_s: float = DEFAULT_RPC_TIMEOUT_S):
         self.base_url = base_url.rstrip("/")
+        self.timeout_s = timeout_s
         self._session = session
         self._own_session = session is None
 
@@ -38,11 +54,30 @@ class RemoteRegion:
             await self._session.close()
             self._session = None
 
+    def _rpc_budget(self) -> tuple[aiohttp.ClientTimeout, dict]:
+        """Per-call (timeout, deadline headers).  Raises rather than
+        firing an RPC whose request is already out of time."""
+        dl = current_deadline()
+        if dl is not None:
+            dl.check()
+        budget = remaining_budget(self.timeout_s)
+        headers = {}
+        if dl is not None and dl.deadline_at is not None:
+            # remaining budget in whole ms, floored so the peer's view
+            # is never LONGER than ours
+            headers["X-Deadline-Ms"] = str(
+                max(1, math.floor((budget or 0.0) * 1000)))
+        return aiohttp.ClientTimeout(total=budget), headers
+
     async def _post_raw(self, path: str, **kwargs) -> bytes:
         """POST with the shared status-first error contract; returns the
-        raw response body."""
+        raw response body.  Every call carries an explicit timeout
+        derived from the propagated deadline (capped by `timeout_s`)."""
         session = await self._ensure_session()
-        async with session.post(self.base_url + path, **kwargs) as resp:
+        timeout, dl_headers = self._rpc_budget()
+        headers = {**dl_headers, **kwargs.pop("headers", {})}
+        async with session.post(self.base_url + path, timeout=timeout,
+                                headers=headers, **kwargs) as resp:
             if resp.status != 200:
                 # body may be a non-JSON error page (404 text, 500 html)
                 text = await resp.text()
@@ -144,11 +179,18 @@ class RemoteRegion:
     async def label_values(self, metric: str, tag_key: str,
                            time_range: TimeRange) -> list[str]:
         session = await self._ensure_session()
+        timeout, dl_headers = self._rpc_budget()
+        # status FIRST (the _post_raw contract): a non-JSON error page
+        # (404 text, 500 html) must surface as Error, not as a
+        # ContentTypeError from reading the body as JSON
         async with session.get(self.base_url + "/label_values", params={
                 "metric": metric, "key": tag_key,
                 "start": str(int(time_range.start)),
-                "end": str(int(time_range.end))}) as resp:
-            data = await resp.json()
+                "end": str(int(time_range.end))},
+                timeout=timeout, headers=dl_headers) as resp:
             if resp.status != 200:
-                raise Error(f"remote label_values failed: {data}")
+                text = await resp.text()
+                raise Error(f"remote region {self.base_url}/label_values "
+                            f"returned {resp.status}: {text[:200]}")
+            data = await resp.json()
             return data["values"]

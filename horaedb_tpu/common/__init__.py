@@ -1,9 +1,11 @@
 """Common utilities shared by every layer (ref: src/common)."""
 
+from horaedb_tpu.common.deadline import Deadline, DeadlineExceeded
 from horaedb_tpu.common.error import Error, ensure
 from horaedb_tpu.common.size_ext import ReadableSize
 from horaedb_tpu.common.tasks import cancel_and_wait
 from horaedb_tpu.common.time_ext import ReadableDuration, now_ms
 
-__all__ = ["Error", "ensure", "ReadableDuration", "ReadableSize",
-           "cancel_and_wait", "now_ms"]
+__all__ = ["Deadline", "DeadlineExceeded", "Error", "ensure",
+           "ReadableDuration", "ReadableSize", "cancel_and_wait",
+           "now_ms"]

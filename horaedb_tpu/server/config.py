@@ -17,8 +17,39 @@ from dataclasses import dataclass, field
 from typing import Any, Optional
 
 from horaedb_tpu.common import Error, ReadableDuration, ensure
+from horaedb_tpu.cluster.breaker import BreakerConfig
 from horaedb_tpu.storage.config import StorageConfig, _check_scalar
 from horaedb_tpu.storage.config import from_dict as storage_from_dict
+
+
+@dataclass
+class AdmissionConfig:
+    """[admission]: server-side query admission control + per-endpoint
+    deadlines (docs/robustness.md, query-path failure domains).
+
+    At most `max_concurrent_queries` queries execute at once; up to
+    `max_queued` more wait at most `queue_timeout` for a slot.  Beyond
+    that the server SHEDS: 429 when the wait queue is full, 503 when
+    the queued wait times out, both with a Retry-After header — under
+    overload, fast rejection beats slow collapse (TiLT/PAPERS.md:
+    bounding per-request latency keeps a time-centric engine usable)."""
+
+    enabled: bool = True
+    max_concurrent_queries: int = 64
+    max_queued: int = 128
+    queue_timeout: ReadableDuration = field(
+        default_factory=lambda: ReadableDuration.parse("500ms"))
+    # per-endpoint default deadlines; a client may shrink (never grow
+    # past max_timeout) via the X-Deadline-Ms header or timeout_ms param
+    query_timeout: ReadableDuration = field(
+        default_factory=lambda: ReadableDuration.parse("30s"))
+    write_timeout: ReadableDuration = field(
+        default_factory=lambda: ReadableDuration.parse("30s"))
+    max_timeout: ReadableDuration = field(
+        default_factory=lambda: ReadableDuration.parse("5m"))
+    # hint returned on 429/503 responses
+    retry_after: ReadableDuration = field(
+        default_factory=lambda: ReadableDuration.parse("1s"))
 
 
 @dataclass
@@ -69,6 +100,10 @@ class MetricEngineConfig:
 class ServerConfig:
     port: int = 5000
     test: TestConfig = field(default_factory=TestConfig)
+    admission: AdmissionConfig = field(default_factory=AdmissionConfig)
+    # circuit breaker / RPC policy for a cluster-backed server's
+    # scatter-gather plane (applied when the served engine is a Cluster)
+    breaker: BreakerConfig = field(default_factory=BreakerConfig)
     metric_engine: MetricEngineConfig = field(default_factory=MetricEngineConfig)
 
 
@@ -96,6 +131,12 @@ def _dc_from_dict(cls: type, data: dict[str, Any]) -> Any:
         elif key == "test":
             ensure(isinstance(value, dict), f"{where} expects a config table")
             kwargs[key] = _dc_from_dict(TestConfig, value)
+        elif key == "admission":
+            ensure(isinstance(value, dict), f"{where} expects a config table")
+            kwargs[key] = _dc_from_dict(AdmissionConfig, value)
+        elif key == "breaker":
+            ensure(isinstance(value, dict), f"{where} expects a config table")
+            kwargs[key] = _dc_from_dict(BreakerConfig, value)
         elif key == "metric_engine":
             ensure(isinstance(value, dict), f"{where} expects a config table")
             kwargs[key] = _dc_from_dict(MetricEngineConfig, value)

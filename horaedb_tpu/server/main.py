@@ -28,18 +28,119 @@ import asyncio
 import logging
 import math
 import random
+from collections import deque
 from typing import Optional
 
 from aiohttp import web
 
 from horaedb_tpu.common import Error, now_ms
+from horaedb_tpu.common.deadline import (
+    Deadline,
+    DeadlineExceeded,
+    deadline_scope,
+)
 from horaedb_tpu.metric_engine import Label, MetricEngine, Sample
 from horaedb_tpu.objstore import LocalObjectStore
-from horaedb_tpu.server.config import ServerConfig, load_config
+from horaedb_tpu.server.config import (AdmissionConfig, ServerConfig,
+                                       load_config)
 from horaedb_tpu.storage.types import TimeRange
 from horaedb_tpu.utils import registry
 
 logger = logging.getLogger(__name__)
+
+# endpoints under query admission control + the query deadline; writes
+# get the write deadline but are never shed (back-pressure belongs to
+# the storage write path), admin/ops endpoints run unbounded
+_QUERY_ENDPOINTS = frozenset({
+    "/query", "/query_arrow", "/query_topk", "/query_multi",
+    "/label_values", "/label_names", "/metrics_list"})
+_WRITE_ENDPOINTS = frozenset({"/write", "/write_arrow"})
+
+_SHED = registry.counter(
+    "server_queries_shed_total",
+    "queries rejected with 429 because the admission queue was full")
+_QUEUE_TIMEOUTS = registry.counter(
+    "server_queries_queue_timeout_total",
+    "queries rejected with 503 after timing out in the admission queue")
+_DEADLINE_504 = registry.counter(
+    "server_requests_timed_out_total",
+    "requests that exceeded their deadline and returned 504")
+_ACTIVE_QUERIES = registry.gauge(
+    "server_active_queries", "queries currently executing")
+_QUEUED_QUERIES = registry.gauge(
+    "server_queued_queries", "queries waiting for an admission slot")
+
+
+class AdmissionController:
+    """Semaphore-bounded query pool with a bounded FIFO wait queue
+    (docs/robustness.md).  `acquire` returns "ok" (slot held — caller
+    must release), "shed" (queue full: answer 429 immediately), or
+    "timeout" (waited out `queue_timeout`: answer 503).  Shedding fast
+    keeps latency bounded for the queries that ARE admitted instead of
+    letting everyone collapse together."""
+
+    def __init__(self, config: AdmissionConfig):
+        self.config = config
+        self._active = 0
+        self._waiters: deque[asyncio.Future] = deque()
+
+    @property
+    def active(self) -> int:
+        return self._active
+
+    @property
+    def queued(self) -> int:
+        return len(self._waiters)
+
+    def _wake(self) -> None:
+        while (self._waiters
+               and self._active < self.config.max_concurrent_queries):
+            fut = self._waiters.popleft()
+            if not fut.done():  # skip cancelled (timed-out) waiters
+                self._active += 1
+                _ACTIVE_QUERIES.set(self._active)
+                fut.set_result(True)
+
+    async def acquire(self, timeout_s: Optional[float]) -> str:
+        if self._active < self.config.max_concurrent_queries:
+            self._active += 1
+            _ACTIVE_QUERIES.set(self._active)
+            return "ok"
+        if len(self._waiters) >= self.config.max_queued:
+            return "shed"
+        fut = asyncio.get_running_loop().create_future()
+        self._waiters.append(fut)
+        _QUEUED_QUERIES.set(len(self._waiters))
+        try:
+            await asyncio.wait_for(fut, timeout_s)
+            return "ok"
+        except asyncio.TimeoutError:
+            self._give_back_racing_grant(fut)
+            return "timeout"
+        except asyncio.CancelledError:
+            # client disconnected while queued; a grant that raced the
+            # cancellation must be returned or _active ratchets up
+            self._give_back_racing_grant(fut)
+            raise
+        finally:
+            try:
+                self._waiters.remove(fut)
+            except ValueError:
+                pass  # already granted and popped by _wake
+            _QUEUED_QUERIES.set(len(self._waiters))
+
+    def _give_back_racing_grant(self, fut: asyncio.Future) -> None:
+        """On py3.12+ wait_for no longer returns the result when the
+        future completes in the same tick as the timeout/cancel — a
+        grant from _wake (which already incremented _active) would leak
+        the slot permanently.  Hand it to the next waiter instead."""
+        if fut.done() and not fut.cancelled():
+            self.release()
+
+    def release(self) -> None:
+        self._active -= 1
+        _ACTIVE_QUERIES.set(self._active)
+        self._wake()
 
 
 class ServerState:
@@ -47,6 +148,12 @@ class ServerState:
         self.engine = engine
         self.config = config
         self.write_enabled = True
+        self.admission = AdmissionController(config.admission)
+        # a cluster-backed server applies its [breaker] section to the
+        # engine's scatter-gather policy (the setter re-points breakers
+        # of already-attached remote regions too)
+        if hasattr(engine, "breaker_config"):
+            engine.breaker_config = config.breaker
         self._generator_tasks: list[asyncio.Task] = []
 
     # ---- write-load generator (ref: main.rs:187-233) ----------------------
@@ -87,8 +194,141 @@ class ServerState:
                 logger.exception("write-load generator failed")
 
 
+def _resilience_middleware(state: ServerState):
+    """Request-lifecycle robustness (docs/robustness.md): mint ONE
+    Deadline per request at ingress (per-endpoint default, shrinkable
+    via X-Deadline-Ms header or timeout_ms param), bind it as the
+    ambient deadline every layer below budgets against, enforce it with
+    a hard 504 backstop, and run query endpoints through admission
+    control (429 queue-full shed / 503 queued-wait timeout, both with
+    Retry-After)."""
+
+    @web.middleware
+    async def middleware(request: web.Request, handler):
+        cfg = state.config.admission
+        path = request.path
+        if path in _QUERY_ENDPOINTS:
+            default_s = cfg.query_timeout.seconds or None
+        elif path in _WRITE_ENDPOINTS:
+            default_s = cfg.write_timeout.seconds or None
+        else:
+            default_s = None  # ops/admin endpoints run unbounded
+        timeout_s = default_s
+        raw = (request.headers.get("X-Deadline-Ms")
+               or request.query.get("timeout_ms"))
+        if raw is not None:
+            try:
+                asked_s = int(raw) / 1000.0
+            except ValueError:
+                return web.json_response(
+                    {"error": f"bad deadline: {raw!r}"}, status=400)
+            cap = cfg.max_timeout.seconds or None
+            timeout_s = max(0.001, min(asked_s, cap) if cap else asked_s)
+        retry_after = str(max(1, math.ceil(cfg.retry_after.seconds)))
+        deadline = (Deadline.after(timeout_s, reason=path)
+                    if timeout_s is not None else None)
+        admitted = False
+        try:
+            if cfg.enabled and path in _QUERY_ENDPOINTS:
+                wait_s = cfg.queue_timeout.seconds
+                if deadline is not None:
+                    wait_s = deadline.budget(wait_s)
+                outcome = await state.admission.acquire(wait_s)
+                if outcome == "shed":
+                    _SHED.inc()
+                    return web.json_response(
+                        {"error": "overloaded: admission queue full"},
+                        status=429, headers={"Retry-After": retry_after})
+                if outcome == "timeout":
+                    _QUEUE_TIMEOUTS.inc()
+                    return web.json_response(
+                        {"error": "overloaded: timed out waiting for a "
+                                  "query slot"},
+                        status=503, headers={"Retry-After": retry_after})
+                admitted = True
+            with deadline_scope(deadline):
+                if deadline is None:
+                    return await handler(request)
+                try:
+                    if path in _WRITE_ENDPOINTS:
+                        # writes are deadline-SCOPED (each outgoing RPC
+                        # budgets against it) but never hard-cancelled:
+                        # aborting a multi-region commit mid-flight
+                        # would break the write path's no-partial-commit
+                        # retry-safety discipline
+                        return await handler(request)
+                    # queries are idempotent: hard backstop around the
+                    # cooperative checkpoints — even a handler that
+                    # never checkpoints cannot overrun its deadline
+                    return await asyncio.wait_for(handler(request),
+                                                  deadline.remaining())
+                except (asyncio.TimeoutError, DeadlineExceeded):
+                    deadline.cancel()
+                    _DEADLINE_504.inc()
+                    return web.json_response(
+                        {"error": f"deadline exceeded "
+                                  f"({timeout_s:.3f}s budget)"},
+                        status=504)
+        finally:
+            if admitted:
+                state.admission.release()
+
+    return middleware
+
+
 def build_app(state: ServerState) -> web.Application:
     routes = web.RouteTableDef()
+
+    def _error_response(e: Error) -> web.Response:
+        """Client-error mapping shared by every handler.  Request
+        -deadline expiry re-raises so the middleware answers 504; a
+        STORAGE-side deadline overrun (objstore retry middleware's
+        per-op deadline) is the server's problem, not the client's —
+        503, never 400."""
+        from horaedb_tpu.objstore.middleware import DeadlineExceededError
+
+        if isinstance(e, DeadlineExceeded):
+            raise e
+        if isinstance(e, DeadlineExceededError):
+            return web.json_response({"error": str(e)}, status=503)
+        return web.json_response({"error": str(e)}, status=400)
+
+    def _attach_partial(body: dict, meta) -> dict:
+        """Degraded scatter-gather marker on /query* JSON bodies (meta
+        is None for single-engine servers — shape unchanged)."""
+        if meta is not None:
+            body["partial"] = meta.partial
+            body["missing_regions"] = meta.missing_regions
+        return body
+
+    def _partial_headers(meta) -> dict:
+        """The same marker for Arrow responses, as HTTP headers (the
+        IPC stream body stays pure data)."""
+        if meta is None:
+            return {}
+        headers = {"X-Partial": "true" if meta.partial else "false"}
+        if meta.missing_regions:
+            headers["X-Missing-Regions"] = ",".join(
+                str(r) for r in meta.missing_regions)
+        return headers
+
+    async def _engine_query(metric, filters, rng, field):
+        """Row query with degraded gather when the engine is a Cluster
+        (returns (table, GatherMeta|None))."""
+        gather = getattr(state.engine, "query_gather", None)
+        if gather is not None:
+            return await gather(metric, filters, rng, field=field)
+        tbl = await state.engine.query(metric, filters, rng, field=field)
+        return tbl, None
+
+    async def _engine_downsample(metric, filters, rng, bucket_ms, field):
+        gather = getattr(state.engine, "query_downsample_gather", None)
+        if gather is not None:
+            return await gather(metric, filters, rng, bucket_ms,
+                                field=field)
+        out = await state.engine.query_downsample(metric, filters, rng,
+                                                  bucket_ms, field=field)
+        return out, None
 
     @routes.get("/")
     async def hello(_req: web.Request) -> web.Response:
@@ -101,7 +341,13 @@ def build_app(state: ServerState) -> web.Application:
 
     @routes.get("/compact")
     async def compact(_req: web.Request) -> web.Response:
-        for table in state.engine.tables.values():
+        tables = getattr(state.engine, "tables", None)
+        if tables is None:
+            return web.json_response(
+                {"error": "compaction is a per-node operation; this "
+                          "server fronts a cluster — compact each "
+                          "region's own server"}, status=501)
+        for table in tables.values():
             await table.compact()
         return web.Response(text="compaction triggered")
 
@@ -124,8 +370,16 @@ def build_app(state: ServerState) -> web.Application:
             except ValueError:
                 return web.json_response(
                     {"error": f"bad grace_ms: {raw!r}"}, status=400)
+        tables = getattr(state.engine, "tables", None)
+        if tables is None:
+            # cluster-backed servers have no direct table surface;
+            # scrub each region's node instead
+            return web.json_response(
+                {"error": "scrub is a per-node operation; this server "
+                          "fronts a cluster — scrub each region's own "
+                          "server"}, status=501)
         out = {}
-        for name, table in state.engine.tables.items():
+        for name, table in tables.items():
             report = await table.scrub(grace_override_s=grace_s)
             out[name] = report.as_dict()
         return web.json_response(out)
@@ -153,7 +407,7 @@ def build_app(state: ServerState) -> web.Application:
         try:
             await state.engine.write(samples)
         except Error as e:
-            return web.json_response({"error": str(e)}, status=400)
+            return _error_response(e)
         return web.json_response({"written": len(samples)})
 
     @routes.post("/write_arrow")
@@ -185,7 +439,7 @@ def build_app(state: ServerState) -> web.Application:
                                                field=field)
                 written += batch.num_rows
         except Error as e:
-            return web.json_response({"error": str(e)}, status=400)
+            return _error_response(e)
         return web.json_response({"written": written})
 
     def _parse_query_body(body: dict):
@@ -237,20 +491,20 @@ def build_app(state: ServerState) -> web.Application:
                 return err
         try:
             if bucket_ms:
-                out = await state.engine.query_downsample(
-                    metric, filters, rng, bucket_ms, field=field)
+                out, meta = await _engine_downsample(metric, filters, rng,
+                                                     bucket_ms, field)
                 body_out = _downsample_json(out)
                 if impl is not None and out["tsids"]:
                     body_out["aggs"][fn] = _grid_json(
                         impl(out["aggs"], bucket_ms))
-                return web.json_response(body_out)
-            tbl = await state.engine.query(metric, filters, rng, field=field)
-            return web.json_response({
+                return web.json_response(_attach_partial(body_out, meta))
+            tbl, meta = await _engine_query(metric, filters, rng, field)
+            return web.json_response(_attach_partial({
                 "tsids": [str(t) for t in tbl.column("tsid").to_pylist()],
                 "timestamps": tbl.column("timestamp").to_pylist(),
-                "values": tbl.column("value").to_pylist()})
+                "values": tbl.column("value").to_pylist()}, meta))
         except Error as e:
-            return web.json_response({"error": str(e)}, status=400)
+            return _error_response(e)
 
     @routes.post("/query_topk")
     async def query_topk(req: web.Request) -> web.Response:
@@ -276,7 +530,7 @@ def build_app(state: ServerState) -> web.Application:
                 metric, filters, rng, bucket_ms, k=k, by=by,
                 largest=largest, field=field)
         except Error as e:
-            return web.json_response({"error": str(e)}, status=400)
+            return _error_response(e)
         return web.json_response(_downsample_json(out))
 
     @routes.post("/query_multi")
@@ -302,7 +556,7 @@ def build_app(state: ServerState) -> web.Application:
             outs = await state.engine.query_downsample_multi(
                 metric, filters, rng, bucket_ms, fields=fields)
         except Error as e:
-            return web.json_response({"error": str(e)}, status=400)
+            return _error_response(e)
         return web.json_response({f: _downsample_json(out)
                                   for f, out in outs.items()})
 
@@ -340,17 +594,18 @@ def build_app(state: ServerState) -> web.Application:
                 return err
         try:
             if bucket_ms:
-                out = await state.engine.query_downsample(
-                    metric, filters, rng, bucket_ms, field=field)
+                out, meta = await _engine_downsample(metric, filters, rng,
+                                                     bucket_ms, field)
                 if impl is not None and out["tsids"]:
                     out["aggs"][fn] = impl(out["aggs"], bucket_ms)
                 tbl = downsample_to_arrow(out)
             else:
-                tbl = await state.engine.query(metric, filters, rng,
-                                               field=field)
+                tbl, meta = await _engine_query(metric, filters, rng,
+                                                field)
         except Error as e:
-            return web.json_response({"error": str(e)}, status=400)
+            return _error_response(e)
         return web.Response(body=serialize_stream(tbl, compression),
+                            headers=_partial_headers(meta),
                             content_type="application/vnd.apache.arrow.stream")
 
     @routes.get("/label_names")
@@ -380,12 +635,21 @@ def build_app(state: ServerState) -> web.Application:
             rng = TimeRange.new(int(req.query["start"]), int(req.query["end"]))
         except (KeyError, ValueError) as e:
             return web.json_response({"error": f"bad request: {e}"}, status=400)
-        vals = await state.engine.label_values(metric, key, rng)
+        try:
+            gather = getattr(state.engine, "label_values_gather", None)
+            if gather is not None:
+                vals, meta = await gather(metric, key, rng)
+                return web.json_response(
+                    _attach_partial({"values": vals}, meta))
+            vals = await state.engine.label_values(metric, key, rng)
+        except Error as e:
+            return _error_response(e)
         return web.json_response({"values": vals})
 
     # sized for the Arrow-IPC bulk data plane (default 1 MiB would 413
     # any real ingest batch)
-    app = web.Application(client_max_size=256 * 1024 * 1024)
+    app = web.Application(client_max_size=256 * 1024 * 1024,
+                          middlewares=[_resilience_middleware(state)])
     app.add_routes(routes)
     return app
 

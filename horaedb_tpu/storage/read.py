@@ -39,6 +39,7 @@ import pyarrow as pa
 import jax
 import jax.numpy as jnp
 
+from horaedb_tpu.common.deadline import checkpoint as deadline_checkpoint
 from horaedb_tpu.common.error import Error, ensure
 from horaedb_tpu.objstore import NotFoundError, ObjectStore
 from horaedb_tpu.ops import downsample as downsample_ops
@@ -410,6 +411,9 @@ class ParquetReader:
             feed = self._segment_feed(plan, plan.segments)
             try:
                 async for seg, is_streamed, table, read_s in feed:
+                    # cooperative deadline checkpoint: an expired query
+                    # aborts between segments, not after a full scan
+                    deadline_checkpoint()
                     async for out in self._append_segment(
                             seg, is_streamed, table, read_s, plan):
                         yield out
@@ -422,6 +426,9 @@ class ParquetReader:
             async for seg, windows, read_s in windows_iter:
                 elapsed = 0.0  # decode work only — yields suspend into
                 for w in windows:  # the consumer, not scan time
+                    # per-window deadline checkpoint (the merge loop's
+                    # cooperative cancellation point)
+                    deadline_checkpoint()
                     t0 = time.perf_counter()
                     part = await self._run_pool(
                         plan.pool, self._window_to_arrow, w,
@@ -446,6 +453,7 @@ class ParquetReader:
             spent = 0.0
             async for batch in self._stream_window_batches(
                     seg, plan, strict_no_replay=True):
+                deadline_checkpoint()
                 t0 = time.perf_counter()
                 part = await self._run_pool(
                     plan.pool, self._merge_segment_table,
@@ -547,6 +555,10 @@ class ParquetReader:
 
         try:
             for seg in plan.segments:
+                # cooperative deadline checkpoint between segments: a
+                # query that ran out of budget stops reading/merging
+                # instead of finishing a doomed scan
+                deadline_checkpoint()
                 if id(seg) in cached:
                     yield seg, cached[id(seg)], 0.0
                     continue
@@ -672,6 +684,7 @@ class ParquetReader:
 
         try:
             for seg in plan.segments:
+                deadline_checkpoint()  # between-segment cancellation point
                 if id(seg) in cached:
                     buffer.append([seg, cached[id(seg)], 0, 0.0])
                 else:
@@ -1111,6 +1124,9 @@ class ParquetReader:
         pyval = lambda x: x.item() if hasattr(x, "item") else x
         yielded_any = False
         for lo, hi in ranges:
+            # streamed segments can span many windows: check the
+            # deadline before paying for each window's pushdown read
+            deadline_checkpoint()
             expr = (pc.field(part_col) >= pyval(lo)) \
                 & (pc.field(part_col) <= pyval(hi))
             if plan.pushdown is not None:

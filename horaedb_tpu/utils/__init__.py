@@ -1,7 +1,8 @@
 """Cross-cutting utilities: observability registry + tracing spans."""
 
-from horaedb_tpu.utils.metrics import Counter, Histogram, MetricsRegistry, registry
+from horaedb_tpu.utils.metrics import (Counter, Gauge, Histogram,
+                                       MetricsRegistry, registry)
 from horaedb_tpu.utils.tracing import current_span, span
 
-__all__ = ["Counter", "Histogram", "MetricsRegistry", "current_span",
-           "registry", "span"]
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
+           "current_span", "registry", "span"]

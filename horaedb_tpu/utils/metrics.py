@@ -40,6 +40,40 @@ class Counter:
                 f"{self.name} {self._value}\n")
 
 
+class Gauge:
+    """A value that goes up and down (queue depth, active queries,
+    breaker state).  Rendered with the Prometheus `gauge` type."""
+
+    __slots__ = ("name", "help", "_value", "_lock")
+
+    def __init__(self, name: str, help_: str = ""):
+        self.name = name
+        self.help = help_
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = value
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value -= amount
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def render(self) -> str:
+        return (f"# HELP {self.name} {self.help}\n"
+                f"# TYPE {self.name} gauge\n"
+                f"{self.name} {self._value}\n")
+
+
 _RESERVOIR_SIZE = 4096
 
 
@@ -115,6 +149,15 @@ class MetricsRegistry:
                 m = Counter(name, help_)
                 self._metrics[name] = m
             assert isinstance(m, Counter)
+            return m
+
+    def gauge(self, name: str, help_: str = "") -> Gauge:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = Gauge(name, help_)
+                self._metrics[name] = m
+            assert isinstance(m, Gauge)
             return m
 
     def histogram(self, name: str, help_: str = "",

@@ -1,0 +1,862 @@
+"""Query-path resilience tests (docs/robustness.md): deadlines,
+admission control, circuit breakers, and degraded scatter-gather."""
+
+import asyncio
+import os
+import pathlib
+import subprocess
+import sys
+import time
+
+import pyarrow as pa
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from horaedb_tpu.cluster import BreakerConfig, CircuitBreaker, Cluster
+from horaedb_tpu.cluster import breaker as breaker_mod
+from horaedb_tpu.cluster.breaker import CLOSED, HALF_OPEN, OPEN
+from horaedb_tpu.common import (
+    Deadline,
+    DeadlineExceeded,
+    Error,
+    ReadableDuration,
+)
+from horaedb_tpu.common.deadline import (
+    checkpoint,
+    current_deadline,
+    deadline_scope,
+    remaining_budget,
+)
+from horaedb_tpu.common.time_ext import now_ms
+from horaedb_tpu.metric_engine import Label, MetricEngine, Sample
+from horaedb_tpu.objstore import MemoryObjectStore
+from horaedb_tpu.server.config import AdmissionConfig, ServerConfig
+from horaedb_tpu.server.main import ServerState, build_app
+from horaedb_tpu.storage.types import TimeRange
+
+T0 = 1_700_000_000_000
+HOUR = 3_600_000
+DAY = 24 * HOUR
+ROOT = pathlib.Path(__file__).resolve().parents[1]
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def sample(name, labels, ts, value):
+    return Sample(name=name, labels=[Label(k, v) for k, v in labels],
+                  timestamp=ts, value=value)
+
+
+def _empty_table() -> pa.Table:
+    return pa.table({"tsid": pa.array([], pa.uint64()),
+                     "timestamp": pa.array([], pa.int64()),
+                     "value": pa.array([], pa.float64())})
+
+
+def metric_value(text: str, name: str):
+    for line in text.splitlines():
+        if line.startswith(name + " "):
+            return float(line.split()[1])
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Deadline
+
+
+class TestDeadline:
+    def test_remaining_and_budget(self):
+        dl = Deadline.after(10.0)
+        rem = dl.remaining()
+        assert 9.0 < rem <= 10.0
+        assert dl.budget(1.0) == 1.0  # cap wins when under remaining
+        assert abs(dl.budget(None) - rem) < 1.0  # remaining wins over None
+        unbounded = Deadline.after(None)
+        assert unbounded.remaining() is None
+        assert unbounded.budget(5.0) == 5.0
+        assert unbounded.budget(None) is None
+
+    def test_expiry_and_cancel(self):
+        dl = Deadline.after(0.0)
+        assert dl.expired
+        assert dl.remaining() == 0.0
+        with pytest.raises(DeadlineExceeded, match="deadline exceeded"):
+            dl.check()
+        dl2 = Deadline.after(10.0)
+        assert not dl2.expired
+        dl2.cancel()
+        assert dl2.expired
+        with pytest.raises(DeadlineExceeded, match="cancelled"):
+            dl2.check()
+
+    def test_ambient_scope_and_checkpoint(self):
+        assert current_deadline() is None
+        checkpoint()  # no ambient deadline: cheap no-op
+        assert remaining_budget(5.0) == 5.0
+        with deadline_scope(Deadline.after(0.0)) as dl:
+            assert current_deadline() is dl
+            assert remaining_budget(5.0) == 0.0
+            with pytest.raises(DeadlineExceeded):
+                checkpoint()
+        assert current_deadline() is None
+
+    def test_scope_propagates_into_tasks(self):
+        async def child():
+            checkpoint()
+
+        async def go():
+            with deadline_scope(Deadline.after(0.0)):
+                task = asyncio.create_task(child())
+                with pytest.raises(DeadlineExceeded):
+                    await task
+
+        run(go())
+
+
+# ---------------------------------------------------------------------------
+# Circuit breaker state machine
+
+
+def _breaker_cfg(**kw):
+    defaults = dict(failure_threshold=2,
+                    open_cooldown=ReadableDuration.parse("10s"))
+    defaults.update(kw)
+    return BreakerConfig(**defaults)
+
+
+class TestCircuitBreaker:
+    def test_full_state_machine(self):
+        t = [0.0]
+        br = CircuitBreaker("r", _breaker_cfg(), clock=lambda: t[0])
+        assert br.state == CLOSED and br.allow()
+        br.record_failure()
+        assert br.state == CLOSED  # under threshold
+        br.record_success()  # success resets the consecutive streak
+        br.record_failure()
+        br.record_failure()
+        assert br.state == OPEN and not br.allow()
+        t[0] = 10.1  # cooldown elapsed: half-open admits ONE probe
+        assert br.state == HALF_OPEN
+        assert br.allow()
+        assert not br.allow()  # a single probe at a time
+        br.record_failure()  # failed probe: back to open, cooldown restarts
+        assert br.state == OPEN and not br.allow()
+        t[0] = 20.3
+        assert br.allow()
+        br.record_success()
+        assert br.state == CLOSED and br.allow()
+
+    def test_ping_ok_promotes_open_to_half_open(self):
+        br = CircuitBreaker("r", _breaker_cfg())
+        br.record_failure()
+        br.record_failure()
+        assert br.state == OPEN
+        br.on_ping_ok()  # monitor sees the peer again: probe rides it
+        assert br.state == HALF_OPEN
+        assert br.allow()
+        br.record_success()
+        assert br.state == CLOSED
+
+    def test_transitions_feed_metrics_counters(self):
+        opened0 = breaker_mod._OPENED.value
+        half0 = breaker_mod._HALF_OPENED.value
+        closed0 = breaker_mod._CLOSED.value
+        br = CircuitBreaker("r", _breaker_cfg())
+        br.record_failure()
+        br.record_failure()
+        br.on_ping_ok()
+        assert br.allow()
+        br.record_success()
+        assert breaker_mod._OPENED.value == opened0 + 1
+        assert breaker_mod._HALF_OPENED.value == half0 + 1
+        assert breaker_mod._CLOSED.value == closed0 + 1
+
+    def test_disabled_breaker_always_allows(self):
+        br = CircuitBreaker("r", _breaker_cfg(enabled=False))
+        for _ in range(5):
+            br.record_failure()
+        assert br.allow()
+        # a disabled breaker never opens at all — it must not suppress
+        # the gather's bounded retries through a non-closed state
+        assert br.state == CLOSED
+
+    def test_abort_probe_releases_the_slot_without_an_outcome(self):
+        br = CircuitBreaker("r", _breaker_cfg())
+        br.record_failure()
+        br.record_failure()
+        br.on_ping_ok()
+        assert br.allow() and not br.allow()  # probe claimed
+        br.abort_probe()  # requester's deadline expired: no outcome
+        assert br.state == HALF_OPEN
+        assert br.allow()  # slot free for the next probe
+        br.record_success()
+        assert br.state == CLOSED
+
+    def test_ping_ok_rearms_a_stuck_half_open_probe(self):
+        """A probe task that died between allow() and its outcome
+        (cancelled gather) must not wedge the breaker: the next good
+        ping re-arms the probe slot."""
+        br = CircuitBreaker("r", _breaker_cfg())
+        br.record_failure()
+        br.record_failure()
+        br.on_ping_ok()
+        assert br.allow()  # probe claimed...
+        assert not br.allow()  # ...and in flight
+        # the probe's task dies without record_success/record_failure
+        br.on_ping_ok()  # peer still answers pings: re-arm
+        assert br.allow()
+        br.record_success()
+        assert br.state == CLOSED
+
+
+# ---------------------------------------------------------------------------
+# Admission control + deadlines over HTTP
+
+
+class SlowEngine:
+    """Duck-typed engine whose queries block — drives admission tests."""
+
+    def __init__(self, delay_s: float):
+        self.delay_s = delay_s
+        self.tables = {}
+
+    async def query(self, metric, filters, rng, field="value"):
+        await asyncio.sleep(self.delay_s)
+        return _empty_table()
+
+    async def close(self):
+        pass
+
+
+def _admission_config(**adm) -> ServerConfig:
+    cfg = ServerConfig()
+    cfg.admission = AdmissionConfig(**adm)
+    return cfg
+
+
+class TestAdmissionControl:
+    def test_shed_and_queue_timeout(self):
+        async def go():
+            cfg = _admission_config(
+                max_concurrent_queries=1, max_queued=1,
+                queue_timeout=ReadableDuration.parse("100ms"),
+                query_timeout=ReadableDuration.parse("5s"),
+                retry_after=ReadableDuration.parse("2s"))
+            state = ServerState(SlowEngine(0.6), cfg)
+            client = TestClient(TestServer(build_app(state)))
+            await client.start_server()
+            try:
+                body = {"metric": "m", "filters": {},
+                        "start": T0, "end": T0 + HOUR}
+                resps = await asyncio.gather(*(
+                    client.post("/query", json=body) for _ in range(4)))
+                statuses = sorted(r.status for r in resps)
+                # 1 admitted; 1 queued, waits out 100ms < the 600ms run
+                # -> 503; 2 beyond the queue bound -> 429
+                assert statuses == [200, 429, 429, 503]
+                for r in resps:
+                    if r.status in (429, 503):
+                        assert r.headers["Retry-After"] == "2"
+                        assert "overloaded" in (await r.json())["error"]
+                m = await (await client.get("/metrics")).text()
+                assert metric_value(m, "server_queries_shed_total") >= 2
+                assert metric_value(
+                    m, "server_queries_queue_timeout_total") >= 1
+            finally:
+                await client.close()
+
+        run(go())
+
+    def test_deadline_enforced_with_504(self):
+        async def go():
+            cfg = _admission_config(
+                query_timeout=ReadableDuration.parse("200ms"))
+            state = ServerState(SlowEngine(5.0), cfg)
+            client = TestClient(TestServer(build_app(state)))
+            await client.start_server()
+            try:
+                body = {"metric": "m", "filters": {},
+                        "start": T0, "end": T0 + HOUR}
+                t0 = time.monotonic()
+                r = await client.post("/query", json=body)
+                elapsed = time.monotonic() - t0
+                assert r.status == 504
+                assert "deadline" in (await r.json())["error"]
+                assert elapsed < 2.0  # nowhere near the engine's 5s
+                m = await (await client.get("/metrics")).text()
+                assert metric_value(
+                    m, "server_requests_timed_out_total") >= 1
+            finally:
+                await client.close()
+
+        run(go())
+
+    def test_client_can_shrink_deadline_via_header(self):
+        async def go():
+            # server default is generous; the client's X-Deadline-Ms wins
+            cfg = _admission_config(
+                query_timeout=ReadableDuration.parse("30s"))
+            state = ServerState(SlowEngine(5.0), cfg)
+            client = TestClient(TestServer(build_app(state)))
+            await client.start_server()
+            try:
+                body = {"metric": "m", "filters": {},
+                        "start": T0, "end": T0 + HOUR}
+                t0 = time.monotonic()
+                r = await client.post("/query", json=body,
+                                      headers={"X-Deadline-Ms": "150"})
+                assert r.status == 504
+                assert time.monotonic() - t0 < 2.0
+                r = await client.post("/query?timeout_ms=banana", json=body)
+                assert r.status == 400
+            finally:
+                await client.close()
+
+        run(go())
+
+
+# ---------------------------------------------------------------------------
+# RemoteRegion RPC bounds
+
+
+class TestRemoteRegionTimeouts:
+    def test_label_values_error_page_is_status_first(self):
+        """A non-JSON error page (500 html) must raise the contract's
+        Error, not a ContentTypeError from parsing the body as JSON."""
+        async def go():
+            import aiohttp
+
+            from horaedb_tpu.cluster import RemoteRegion
+
+            async def err(_req):
+                return web.Response(text="<html>boom</html>", status=500,
+                                    content_type="text/html")
+
+            app = web.Application()
+            app.router.add_get("/label_values", err)
+            server = TestServer(app)
+            await server.start_server()
+            session = aiohttp.ClientSession()
+            remote = RemoteRegion(str(server.make_url("/")), session)
+            try:
+                with pytest.raises(Error, match="returned 500"):
+                    await remote.label_values(
+                        "m", "k", TimeRange.new(T0, T0 + HOUR))
+            finally:
+                await session.close()
+                await server.close()
+
+        run(go())
+
+    def test_default_timeout_bounds_hanging_peer(self):
+        """Data-plane RPCs must never inherit aiohttp's 5-minute
+        default: a blackholed peer fails in ~timeout_s."""
+        async def go():
+            import aiohttp
+
+            from horaedb_tpu.cluster import RemoteRegion
+
+            async def hang(_req):
+                await asyncio.sleep(30)
+                return web.Response(text="late")
+
+            app = web.Application()
+            app.router.add_post("/query_arrow", hang)
+            server = TestServer(app)
+            await server.start_server()
+            session = aiohttp.ClientSession()
+            remote = RemoteRegion(str(server.make_url("/")), session,
+                                  timeout_s=0.2)
+            try:
+                t0 = time.monotonic()
+                with pytest.raises((asyncio.TimeoutError,
+                                    aiohttp.ClientError)):
+                    await remote.query("m", [],
+                                       TimeRange.new(T0, T0 + HOUR))
+                assert time.monotonic() - t0 < 5.0
+            finally:
+                await session.close()
+                await server.close()
+
+        run(go())
+
+    def test_deadline_header_propagates_to_peer(self):
+        async def go():
+            import aiohttp
+
+            from horaedb_tpu.cluster import RemoteRegion
+            from horaedb_tpu.common.ipc import serialize_stream
+
+            seen = {}
+
+            async def qa(req):
+                seen.update(req.headers)
+                return web.Response(body=serialize_stream(
+                    _empty_table(), None))
+
+            app = web.Application()
+            app.router.add_post("/query_arrow", qa)
+            server = TestServer(app)
+            await server.start_server()
+            session = aiohttp.ClientSession()
+            remote = RemoteRegion(str(server.make_url("/")), session)
+            try:
+                with deadline_scope(Deadline.after(5.0)):
+                    await remote.query("m", [],
+                                       TimeRange.new(T0, T0 + HOUR))
+                assert "X-Deadline-Ms" in seen
+                assert 0 < int(seen["X-Deadline-Ms"]) <= 5000
+                # an already-expired deadline refuses to fire at all
+                with deadline_scope(Deadline.after(0.0)):
+                    with pytest.raises(DeadlineExceeded):
+                        await remote.query("m", [],
+                                           TimeRange.new(T0, T0 + HOUR))
+            finally:
+                await session.close()
+                await server.close()
+
+        run(go())
+
+
+# ---------------------------------------------------------------------------
+# Degraded scatter-gather
+
+
+class FlakyRegion:
+    """Duck-typed 'remote' region over a local engine, with a kill
+    switch and an optional per-query delay."""
+
+    def __init__(self, engine, delay_s: float = 0.0):
+        self.engine = engine
+        self.fail = False
+        self.delay_s = delay_s
+        self.calls = 0
+
+    async def ping(self, timeout_s: float = 2.0):
+        return not self.fail
+
+    async def _gate(self):
+        self.calls += 1
+        if self.delay_s:
+            await asyncio.sleep(self.delay_s)
+        if self.fail:
+            raise Error("injected region failure")
+
+    async def query(self, metric, filters, rng, field="value"):
+        await self._gate()
+        return await self.engine.query(metric, filters, rng, field=field)
+
+    async def query_downsample(self, metric, filters, rng, bucket_ms,
+                               field="value"):
+        await self._gate()
+        return await self.engine.query_downsample(metric, filters, rng,
+                                                  bucket_ms, field=field)
+
+    async def label_values(self, metric, key, rng):
+        await self._gate()
+        return await self.engine.label_values(metric, key, rng)
+
+    async def write(self, samples):
+        await self.engine.write(samples)
+
+    async def stats(self):
+        return await self.engine.stats()
+
+    async def close(self):
+        pass
+
+
+async def make_split_cluster(tag: str, breaker_config=None,
+                             delay_s: float = 0.0):
+    """Local region 0 + flaky 'remote' region 7 behind a split, with 32
+    series written across both.  Health monitor stopped — tests drive
+    heartbeats explicitly."""
+    c = await Cluster.open(f"{tag}_cluster", MemoryObjectStore(),
+                           num_regions=1, segment_ms=2 * HOUR)
+    if breaker_config is not None:
+        c.breaker_config = breaker_config
+    c.routing.split(0, 1 << 62, 7, now_ms(), 30 * DAY)
+    engine7 = await MetricEngine.open(f"{tag}_remote", MemoryObjectStore(),
+                                      segment_ms=2 * HOUR)
+    flaky = FlakyRegion(engine7, delay_s=delay_s)
+    c.add_remote_region(7, flaky)
+    await c.stop_health_monitor()
+    await c.write([sample("cpu", [("host", f"h{i:02d}")], T0 + 1000,
+                          float(i)) for i in range(32)])
+    return c, flaky, engine7
+
+
+class TestDegradedGather:
+    def test_mid_query_failure_yields_partial(self):
+        async def go():
+            c, flaky, engine7 = await make_split_cluster(
+                "midq", _breaker_cfg(failure_threshold=10))
+            try:
+                rng = TimeRange.new(T0, T0 + HOUR)
+                full, meta = await c.query_gather("cpu", [], rng)
+                assert not meta.partial and meta.missing_regions == []
+                assert full.num_rows == 32
+                # the region dies between routing and response
+                flaky.fail = True
+                t, meta = await c.query_gather("cpu", [], rng)
+                assert meta.partial and meta.missing_regions == [7]
+                assert "injected" in meta.errors[7]
+                assert 0 < t.num_rows < 32
+                # the strict path still fails loudly
+                with pytest.raises(Error, match="injected"):
+                    await c.query("cpu", [], rng)
+            finally:
+                await c.close()
+                await engine7.close()
+
+        run(go())
+
+    def test_dead_region_yields_partial_everywhere(self):
+        async def go():
+            c, flaky, engine7 = await make_split_cluster("deadr")
+            try:
+                rng = TimeRange.new(T0, T0 + HOUR)
+                flaky.fail = True
+                await c.check_health_once()
+                await c.check_health_once()
+                assert 7 in c.dead_regions
+                calls0 = flaky.calls
+                t, meta = await c.query_gather("cpu", [], rng)
+                assert meta.partial and meta.missing_regions == [7]
+                assert "dead" in meta.errors[7]
+                assert flaky.calls == calls0  # skipped, not attempted
+                ds, meta2 = await c.query_downsample_gather(
+                    "cpu", [], rng, 60_000)
+                assert meta2.partial and meta2.missing_regions == [7]
+                assert len(ds["tsids"]) == t.num_rows
+                vals, meta3 = await c.label_values_gather("cpu", "host",
+                                                          rng)
+                assert meta3.partial and len(vals) == t.num_rows
+            finally:
+                await c.close()
+                await engine7.close()
+
+        run(go())
+
+    def test_open_circuit_region_skipped_without_rpc(self):
+        async def go():
+            c, flaky, engine7 = await make_split_cluster(
+                "openc", _breaker_cfg(failure_threshold=1,
+                                      open_cooldown=ReadableDuration
+                                      .parse("60s")))
+            try:
+                rng = TimeRange.new(T0, T0 + HOUR)
+                c.breakers[7].record_failure()  # threshold 1 -> open
+                assert c.breaker_states()[7] == OPEN
+                calls0 = flaky.calls
+                t, meta = await c.query_gather("cpu", [], rng)
+                assert flaky.calls == calls0  # no connect attempt
+                assert meta.partial and meta.missing_regions == [7]
+                assert "circuit open" in meta.errors[7]
+                assert t.num_rows > 0
+            finally:
+                await c.close()
+                await engine7.close()
+
+        run(go())
+
+    def test_half_open_recovery_restores_full_results(self):
+        async def go():
+            c, flaky, engine7 = await make_split_cluster(
+                "recov", _breaker_cfg(failure_threshold=2, retries=1,
+                                      open_cooldown=ReadableDuration
+                                      .parse("60s")))
+            try:
+                rng = TimeRange.new(T0, T0 + HOUR)
+                flaky.fail = True
+                # one gather = initial attempt + bounded retry = two
+                # consecutive failures -> the circuit opens
+                _t, meta = await c.query_gather("cpu", [], rng)
+                assert meta.partial
+                assert c.breaker_states()[7] == OPEN
+                _t, meta = await c.query_gather("cpu", [], rng)
+                assert "circuit open" in meta.errors[7]
+                # the peer recovers; the monitor's ping promotes the
+                # circuit to half-open, the next query is the probe
+                flaky.fail = False
+                await c.check_health_once()
+                assert c.breaker_states()[7] == HALF_OPEN
+                t, meta = await c.query_gather("cpu", [], rng)
+                assert not meta.partial and meta.missing_regions == []
+                assert t.num_rows == 32
+                assert c.breaker_states()[7] == CLOSED
+            finally:
+                await c.close()
+                await engine7.close()
+
+        run(go())
+
+    def test_requester_deadline_not_charged_to_breaker(self):
+        """A query arriving with a tight deadline must not open the
+        circuit of a healthy-but-slower region: the timeout is the
+        requester's, not the region's."""
+        async def go():
+            c, flaky, engine7 = await make_split_cluster(
+                "tightdl", _breaker_cfg(
+                    failure_threshold=1,
+                    rpc_timeout=ReadableDuration.parse("10s")),
+                delay_s=0.5)
+            try:
+                rng = TimeRange.new(T0, T0 + HOUR)
+                with deadline_scope(Deadline.after(0.15)):
+                    t, meta = await c.query_gather("cpu", [], rng)
+                assert meta.partial and meta.missing_regions == [7]
+                assert "deadline" in meta.errors[7]
+                # threshold is 1, yet the breaker stayed closed
+                assert c.breaker_states()[7] == CLOSED
+                # without the tight deadline the region answers fine
+                t, meta = await c.query_gather("cpu", [], rng)
+                assert not meta.partial and t.num_rows == 32
+            finally:
+                await c.close()
+                await engine7.close()
+
+        run(go())
+
+    def test_expired_deadline_releases_half_open_probe(self):
+        """A half-open probe whose requester ran out of deadline must
+        release the probe slot so the NEXT query can still recover the
+        region."""
+        async def go():
+            c, flaky, engine7 = await make_split_cluster(
+                "probedl", _breaker_cfg(failure_threshold=2, retries=0),
+                delay_s=0.5)
+            try:
+                rng = TimeRange.new(T0, T0 + HOUR)
+                br = c.breakers[7]
+                br.record_failure()
+                br.record_failure()
+                br.on_ping_ok()
+                assert c.breaker_states()[7] == HALF_OPEN
+                # probe claimed by this gather, then its deadline dies
+                with deadline_scope(Deadline.after(0.1)):
+                    _t, meta = await c.query_gather("cpu", [], rng)
+                assert meta.partial and 7 in meta.missing_regions
+                # slot released: the next (patient) query probes and
+                # closes the circuit
+                t, meta = await c.query_gather("cpu", [], rng)
+                assert not meta.partial and t.num_rows == 32
+                assert c.breaker_states()[7] == CLOSED
+            finally:
+                await c.close()
+                await engine7.close()
+
+        run(go())
+
+    def test_breaker_config_setter_repoints_existing_breakers(self):
+        async def go():
+            c, flaky, engine7 = await make_split_cluster("cfgset")
+            try:
+                assert c.breakers[7].config is c.breaker_config
+                new_cfg = _breaker_cfg(failure_threshold=99)
+                c.breaker_config = new_cfg  # regions already attached
+                assert c.breakers[7].config is new_cfg
+            finally:
+                await c.close()
+                await engine7.close()
+
+        run(go())
+
+    def test_all_regions_failed_raises(self):
+        async def go():
+            c, flaky, engine7 = await make_split_cluster("allfail")
+            try:
+                # detach the local region, kill the remote: nothing to
+                # degrade to -> loud error, not an empty 200
+                await c.detach_region(0)
+                flaky.fail = True
+                with pytest.raises(Error, match="every routed region"):
+                    await c.query_gather("cpu", [],
+                                         TimeRange.new(T0, T0 + HOUR))
+            finally:
+                await c.close()
+                await engine7.close()
+
+        run(go())
+
+    def test_hedged_read_beats_slow_primary(self):
+        async def go():
+            class SlowThenFast(FlakyRegion):
+                async def _gate(self):
+                    self.calls += 1
+                    if self.calls == 1:
+                        await asyncio.sleep(1.0)
+
+            cfg = _breaker_cfg(
+                hedge_delay=ReadableDuration.parse("100ms"),
+                rpc_timeout=ReadableDuration.parse("5s"))
+            c = await Cluster.open("hedge_cluster", MemoryObjectStore(),
+                                   num_regions=1, segment_ms=2 * HOUR)
+            c.breaker_config = cfg
+            c.routing.split(0, 1 << 62, 7, now_ms(), 30 * DAY)
+            engine7 = await MetricEngine.open(
+                "hedge_remote", MemoryObjectStore(), segment_ms=2 * HOUR)
+            slow = SlowThenFast(engine7)
+            c.add_remote_region(7, slow)
+            await c.stop_health_monitor()
+            try:
+                await c.write([sample("cpu", [("host", f"h{i:02d}")],
+                                      T0 + 1000, float(i))
+                               for i in range(32)])
+                wins0 = int(breaker_mod.registry.counter(
+                    "cluster_hedge_wins_total").value)
+                t0 = time.monotonic()
+                t, meta = await c.query_gather(
+                    "cpu", [], TimeRange.new(T0, T0 + HOUR))
+                elapsed = time.monotonic() - t0
+                assert not meta.partial and t.num_rows == 32
+                assert elapsed < 0.9  # the 1.0s primary did not gate us
+                assert slow.calls >= 2  # a hedge was actually fired
+                wins = int(breaker_mod.registry.counter(
+                    "cluster_hedge_wins_total").value)
+                assert wins == wins0 + 1
+            finally:
+                await c.close()
+                await engine7.close()
+
+        run(go())
+
+
+# ---------------------------------------------------------------------------
+# Acceptance: seeded overload/chaos — slow region + dead region +
+# saturating client
+
+
+class TestOverloadChaos:
+    def test_seeded_overload(self):
+        async def go():
+            import random
+
+            seed = int(os.environ.get("CHAOS_SEED", "1337"))
+            jitter = random.Random(seed)
+
+            cfg = ServerConfig()
+            cfg.admission = AdmissionConfig(
+                max_concurrent_queries=2, max_queued=2,
+                queue_timeout=ReadableDuration.parse("150ms"),
+                query_timeout=ReadableDuration.parse("900ms"),
+                retry_after=ReadableDuration.parse("1s"))
+            cfg.breaker = BreakerConfig(
+                failure_threshold=2, retries=1,
+                rpc_timeout=ReadableDuration.parse("250ms"),
+                open_cooldown=ReadableDuration.parse("60s"))
+
+            c = await Cluster.open("chaos_cluster", MemoryObjectStore(),
+                                   num_regions=1, segment_ms=2 * HOUR)
+            state = ServerState(c, cfg)  # applies cfg.breaker to c
+            c.routing.split(0, 1 << 62, 7, now_ms(), 30 * DAY)
+            c.routing.split(7, 3 << 61, 9, now_ms(), 30 * DAY)
+            engine7 = await MetricEngine.open(
+                "chaos_slow", MemoryObjectStore(), segment_ms=2 * HOUR)
+            engine9 = await MetricEngine.open(
+                "chaos_dead", MemoryObjectStore(), segment_ms=2 * HOUR)
+            slow = FlakyRegion(engine7, delay_s=5.0)  # >> any deadline
+            dead = FlakyRegion(engine9)
+            c.add_remote_region(7, slow)
+            c.add_remote_region(9, dead)
+            await c.stop_health_monitor()
+            await c.write([sample("cpu", [("host", f"h{i:02d}")],
+                                  T0 + 1000, float(i)) for i in range(48)])
+            # the dead region dies AFTER taking writes; two heartbeat
+            # rounds discover it
+            dead.fail = True
+            await c.check_health_once()
+            await c.check_health_once()
+            assert 9 in c.dead_regions
+
+            client = TestClient(TestServer(build_app(state)))
+            await client.start_server()
+            try:
+                body = {"metric": "cpu", "filters": {},
+                        "start": T0, "end": T0 + HOUR}
+
+                async def one():
+                    await asyncio.sleep(jitter.random() * 0.05)
+                    t0 = time.monotonic()
+                    r = await client.post("/query", json=body)
+                    elapsed = time.monotonic() - t0
+                    data = (await r.json()
+                            if r.content_type == "application/json"
+                            else {})
+                    return r.status, data, dict(r.headers), elapsed
+
+                results = await asyncio.gather(*(one() for _ in range(10)))
+
+                statuses = [s for s, _d, _h, _e in results]
+                # no request overran its deadline by more than one
+                # checkpoint/scheduling interval
+                assert all(e < 2.5 for _s, _d, _h, e in results), statuses
+                assert statuses.count(200) >= 1
+                assert statuses.count(429) >= 1
+                assert statuses.count(503) >= 1
+                for status, data, headers, _e in results:
+                    if status in (429, 503):
+                        assert headers.get("Retry-After") == "1"
+                    if status == 200:
+                        # surviving region's data with the partial marker
+                        assert data["partial"] is True
+                        assert set(data["missing_regions"]) == {7, 9}
+                        assert len(data["values"]) > 0
+                # the slow region's timeouts opened its breaker
+                assert c.breaker_states()[7] == OPEN
+
+                m = await (await client.get("/metrics")).text()
+                assert metric_value(m, "server_queries_shed_total") >= 1
+                assert metric_value(
+                    m, "server_queries_queue_timeout_total") >= 1
+                assert metric_value(
+                    m, "cluster_region_rpc_timeouts_total") >= 1
+                assert metric_value(
+                    m, "cluster_gather_partial_total") >= 1
+                assert metric_value(
+                    m, "cluster_breaker_opened_total") >= 1
+                assert metric_value(
+                    m, "cluster_breaker_rejected_total") >= 1
+            finally:
+                await client.close()
+                await c.close()
+                await engine7.close()
+                await engine9.close()
+
+        run(go())
+
+
+# ---------------------------------------------------------------------------
+# Lint rule: aiohttp session calls must carry an explicit timeout
+
+
+class TestLintTimeoutRule:
+    def test_session_calls_without_timeout_rejected(self, tmp_path):
+        pkg = tmp_path / "horaedb_tpu"
+        pkg.mkdir()
+        (pkg / "bad.py").write_text(
+            "async def f(session):\n"
+            "    await session.get('http://x')\n")
+        (pkg / "ok.py").write_text(
+            "async def f(session):\n"
+            "    await session.post('http://x', timeout=1)\n")
+        out = subprocess.run(
+            [sys.executable, str(ROOT / "tools" / "lint.py"), str(pkg)],
+            capture_output=True, text=True)
+        assert out.returncode == 1
+        assert "bad.py" in out.stdout and "timeout" in out.stdout
+        assert "ok.py" not in out.stdout
+
+    def test_rule_scoped_to_package_paths(self, tmp_path):
+        other = tmp_path / "elsewhere"
+        other.mkdir()
+        (other / "free.py").write_text(
+            "async def f(session):\n"
+            "    await session.get('http://x')\n")
+        out = subprocess.run(
+            [sys.executable, str(ROOT / "tools" / "lint.py"), str(other)],
+            capture_output=True, text=True)
+        assert out.returncode == 0

@@ -12,6 +12,9 @@ Checks, all hard failures:
   - trailing whitespace / tabs in indentation
   - mutable default arguments (def f(x=[]) / {} / set())
   - bare `except:` clauses
+  - aiohttp session HTTP calls without an explicit `timeout=` anywhere
+    under horaedb_tpu/ (docs/robustness.md: aiohttp's 5-minute default
+    total timeout must never be inherited on the serving path)
 
 Usage: python tools/lint.py [paths...]   (default: horaedb_tpu tests
 bench.py __graft_entry__.py)
@@ -62,6 +65,33 @@ class _Names(ast.NodeVisitor):
                         .replace("]", " ").split()):
                 if tok.isidentifier():
                     self.used.add(tok)
+
+
+# HTTP-verb methods on a client session object; any such call under
+# horaedb_tpu/ must carry an explicit timeout= keyword
+_SESSION_HTTP_VERBS = {"get", "post", "put", "delete", "head", "options",
+                       "patch", "request"}
+
+
+def _session_call_without_timeout(node: ast.Call) -> bool:
+    """True for `<...session...>.<verb>(...)` calls missing timeout=.
+    The receiver chain is matched on the token "session" (session,
+    self._session, cls.session, ...) — conservative enough to skip
+    aiohttp server/request objects and pyarrow readers."""
+    func = node.func
+    if not isinstance(func, ast.Attribute) \
+            or func.attr not in _SESSION_HTTP_VERBS:
+        return False
+    chain = []
+    cur = func.value
+    while isinstance(cur, ast.Attribute):
+        chain.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        chain.append(cur.id)
+    if not any("session" in part.lower() for part in chain):
+        return False
+    return not any(kw.arg == "timeout" for kw in node.keywords)
 
 
 def lint_file(path: pathlib.Path) -> list[str]:
@@ -117,6 +147,14 @@ def lint_file(path: pathlib.Path) -> list[str]:
                         f"in {node.name}()")
         elif isinstance(node, ast.ExceptHandler) and node.type is None:
             problems.append(f"{path}:{node.lineno}: bare except")
+        elif (isinstance(node, ast.Call) and "horaedb_tpu" in path.parts
+                and _session_call_without_timeout(node)):
+            src = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+            if "noqa" not in src:
+                problems.append(
+                    f"{path}:{node.lineno}: aiohttp session call without "
+                    "an explicit timeout= (would inherit the 5-minute "
+                    "default; derive one from the deadline)")
     return problems
 
 
