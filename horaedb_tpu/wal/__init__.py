@@ -1,0 +1,10 @@
+"""Durable ingest subsystem: WAL + memtables in front of
+TimeMergeStorage (see wal/ingest.py for the architecture note)."""
+
+from horaedb_tpu.wal.config import WalConfig
+from horaedb_tpu.wal.ingest import IngestStorage
+from horaedb_tpu.wal.log import Wal, WalError, WalRecord
+from horaedb_tpu.wal.memtable import MemEntry, Memtable
+
+__all__ = ["IngestStorage", "MemEntry", "Memtable", "Wal", "WalConfig",
+           "WalError", "WalRecord"]

@@ -1,0 +1,95 @@
+"""Per-time-segment mutable write buffer.
+
+A memtable accumulates acked-but-unflushed writes of ONE time segment
+(keyed exactly like SSTs: range-start truncation, sst.segment_of).  It
+serves reads immediately — `stamped_batches` hands the scan path
+full-schema batches with each entry's original write seq filled into
+`__seq__`, so the hybrid merge dedups memtable rows against SST rows
+under the one last-value discipline — and drains to a single SST via
+`drain()` when the flusher decides it crossed a threshold.
+
+Seqs are PRESERVED end to end (write -> WAL -> memtable -> flushed
+SST): restamping at flush time would let a flush race a concurrent
+write and elevate old rows above a newer, already-allocated seq.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import pyarrow as pa
+
+from horaedb_tpu.storage.types import StorageSchema, TimeRange
+from horaedb_tpu.utils import registry
+
+_MEM_ROWS = registry.gauge(
+    "memtable_rows", "acked rows buffered in memtables, not yet in SSTs")
+_MEM_BYTES = registry.gauge(
+    "memtable_bytes", "arrow bytes buffered in memtables")
+
+
+@dataclass
+class MemEntry:
+    seq: int
+    batch: pa.RecordBatch  # user schema
+    time_range: TimeRange
+
+
+class Memtable:
+    def __init__(self, segment_start: int, created_at: float):
+        self.segment_start = segment_start
+        self.created_at = created_at  # injected-clock time of first entry
+        self.entries: list[MemEntry] = []
+        self.rows = 0
+        self.bytes = 0
+
+    def add(self, entry: MemEntry) -> None:
+        self.entries.append(entry)
+        self.rows += entry.batch.num_rows
+        self.bytes += entry.batch.nbytes
+        _MEM_ROWS.inc(entry.batch.num_rows)
+        _MEM_BYTES.inc(entry.batch.nbytes)
+
+    def account_drop(self) -> None:
+        """Gauge bookkeeping when this memtable leaves the live map
+        (flushed or abandoned)."""
+        _MEM_ROWS.inc(-self.rows)
+        _MEM_BYTES.inc(-self.bytes)
+
+    @property
+    def time_range(self) -> Optional[TimeRange]:
+        rng = None
+        for e in self.entries:
+            rng = e.time_range if rng is None else rng.merged(e.time_range)
+        return rng
+
+    @property
+    def seqs(self) -> list[int]:
+        return [e.seq for e in self.entries]
+
+    def stamped_batches(self, schema: StorageSchema,
+                        scan_range: Optional[TimeRange] = None
+                        ) -> list[pa.RecordBatch]:
+        """Full-schema batches with per-entry seqs stamped, entry-level
+        filtered by range overlap (the same granularity the manifest
+        filters SSTs at — row-exact time filtering stays the
+        predicate's job, as on the SST path)."""
+        out = []
+        for e in self.entries:
+            if scan_range is not None and not e.time_range.overlaps(
+                    scan_range):
+                continue
+            if e.batch.num_rows:
+                out.append(schema.fill_builtin_columns(e.batch, e.seq))
+        return out
+
+    def drain(self, schema: StorageSchema):
+        """(stamped concatenated table, union range, seqs) for the
+        flusher — per-row seqs preserved; the SST write sorts by
+        (PK, __seq__) so equal-PK runs stay in last-value order."""
+        stamped = [schema.fill_builtin_columns(e.batch, e.seq)
+                   for e in self.entries if e.batch.num_rows]
+        if not stamped:
+            return None, None, self.seqs
+        return (pa.Table.from_batches(stamped), self.time_range, self.seqs)

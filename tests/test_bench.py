@@ -37,9 +37,14 @@ class TestTsbsGen:
 @pytest.mark.parametrize("config", sorted(RUNNERS))
 def test_suite_configs_run(config):
     result = RUNNERS[config](rows=20_000, iters=2)
-    assert result["unit"] == "ms"
+    # config 8 reports throughput (writes/s, vs_baseline = multiple
+    # over the one-SST-per-write baseline); the rest report latency
+    assert result["unit"] == ("writes/s" if config == 8 else "ms")
     assert result["value"] > 0
     assert result["vs_baseline"] > 0
+    # (config 8's >=5x acceptance floor is checked by the bench tier,
+    # not here — a real-time fsync ratio has no place gating `make test`
+    # on a loaded CI box)
 
 
 def test_engine_headline_runs():

@@ -13,6 +13,7 @@ import subprocess
 import sys
 
 import numpy as np
+import pytest
 
 
 def _free_port() -> int:
@@ -21,20 +22,22 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_global_downsample(tmp_path):
-    # bounded by the workers' communicate(timeout=240) below —
-    # pytest-timeout isn't in the image
+def _run_workers(tmp_path, nprocs: int) -> list[str]:
+    """Spawn the worker script as `nprocs` processes; returns the npz
+    output paths.  Bounded by communicate(timeout=240) — pytest-timeout
+    isn't in the image."""
     port = _free_port()
     coordinator = f"127.0.0.1:{port}"
     worker = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
-    outs = [str(tmp_path / f"out{r}.npz") for r in range(2)]
+    outs = [str(tmp_path / f"out{r}.npz") for r in range(nprocs)]
     env = {k: v for k, v in os.environ.items()
            if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
     procs = [
         subprocess.Popen(
-            [sys.executable, worker, coordinator, "2", str(r), outs[r]],
+            [sys.executable, worker, coordinator, str(nprocs), str(r),
+             outs[r]],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env)
-        for r in range(2)
+        for r in range(nprocs)
     ]
     logs = []
     for p in procs:
@@ -42,6 +45,15 @@ def test_two_process_global_downsample(tmp_path):
         logs.append(out.decode(errors="replace"))
     assert all(p.returncode == 0 for p in procs), \
         "worker failed:\n" + "\n---\n".join(logs)
+    return outs
+
+
+@pytest.mark.slow
+def test_two_process_global_downsample(tmp_path):
+    """Full cross-process collectives over Gloo — slow (two interpreter
+    starts + distributed init); tier-1 keeps the single-process fast
+    variant below."""
+    outs = _run_workers(tmp_path, 2)
 
     # ground truth over ALL 8 windows (both processes' quarters)
     NUM_GROUPS, NUM_BUCKETS, CAP = 8, 4, 128
@@ -99,3 +111,33 @@ def test_two_process_global_downsample(tmp_path):
     scores = np.where(ref_count > 0, a["max"], -np.inf).max(axis=1)
     np.testing.assert_array_equal(a["top_idx"],
                                   np.argsort(-scores, kind="stable")[:3])
+
+
+def test_single_process_worker_fast(tmp_path):
+    """Tier-1 default variant: ONE worker process (n_global = 4
+    windows) exercises the worker script end to end — lazy-import
+    invariant, jax.distributed init, the global downsample program and
+    the npz contract — without the 2-process Gloo coordination cost."""
+    outs = _run_workers(tmp_path, 1)
+
+    NUM_GROUPS, NUM_BUCKETS, CAP = 8, 4, 128
+    bucket_ms = 60_000
+    rng = np.random.default_rng(99)
+    n_global = 4
+    ts = rng.integers(0, NUM_BUCKETS * bucket_ms,
+                      (n_global, CAP)).astype(np.int32)
+    gid = rng.integers(0, NUM_GROUPS, (n_global, CAP)).astype(np.int32)
+    vals = (rng.random((n_global, CAP)) * 100).astype(np.float32)
+    nv = CAP - 8
+    t = np.concatenate([ts[i, :nv] for i in range(n_global)])
+    g = np.concatenate([gid[i, :nv] for i in range(n_global)])
+    v = np.concatenate([vals[i, :nv] for i in range(n_global)])
+    cell = g.astype(np.int64) * NUM_BUCKETS + t // bucket_ms
+    ncell = NUM_GROUPS * NUM_BUCKETS
+    ref_count = np.bincount(cell, minlength=ncell).reshape(
+        NUM_GROUPS, NUM_BUCKETS)
+    ref_sum = np.bincount(cell, weights=v.astype(np.float64),
+                          minlength=ncell).reshape(NUM_GROUPS, NUM_BUCKETS)
+    a = np.load(outs[0])
+    np.testing.assert_array_equal(a["count"], ref_count)
+    np.testing.assert_allclose(a["sum"], ref_sum, rtol=1e-5)

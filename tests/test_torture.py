@@ -230,14 +230,29 @@ async def run_schedule(i: int, runtimes) -> None:
         await s2.close()
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("chunk", range(10))
 def test_torture_schedules(chunk, runtimes):
     """SCHEDULES seeded crash schedules, split into 10 chunks so a
-    failure pins down a reproducible seed range quickly."""
+    failure pins down a reproducible seed range quickly.  Marked slow
+    (the full run belongs to `make chaos`); tier-1 keeps the fast
+    variant below."""
     per = max(1, SCHEDULES // 10)
 
     async def go():
         for i in range(chunk * per, (chunk + 1) * per):
+            await run_schedule(i, runtimes)
+
+    asyncio.run(go())
+
+
+def test_torture_fast(runtimes):
+    """Tier-1 default: the first 16 schedules of the same seeded space
+    — every invariant exercised on every CI run, with `make chaos`
+    dialing the full intensity."""
+
+    async def go():
+        for i in range(16):
             await run_schedule(i, runtimes)
 
     asyncio.run(go())

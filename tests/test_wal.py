@@ -1,0 +1,820 @@
+"""Durable ingest subsystem tests: WAL framing, group commit, hybrid
+scan semantics, crash replay, engine/server wiring, and the seeded
+WAL/flush crash-torture harness (the WAL twin of test_torture.py —
+knobs WAL_TORTURE_SEED / WAL_TORTURE_SCHEDULES, wired into
+`make chaos`)."""
+
+import asyncio
+import os
+import random
+
+import pyarrow as pa
+import pytest
+
+from horaedb_tpu.common import ReadableDuration
+from horaedb_tpu.common import runtimes as runtimes_mod
+from horaedb_tpu.objstore import FaultInjectingStore, MemoryObjectStore
+from horaedb_tpu.ops import And, Eq
+from horaedb_tpu.storage.config import StorageConfig, ThreadsConfig, from_dict
+from horaedb_tpu.storage.read import ScanRequest
+from horaedb_tpu.storage.storage import CloudObjectStorage, WriteRequest
+from horaedb_tpu.storage.types import TimeRange
+from horaedb_tpu.wal import IngestStorage, WalConfig
+from horaedb_tpu.wal.log import Wal, decode_records, encode_record
+
+WAL_SEED = int(os.environ.get("WAL_TORTURE_SEED", "1337"), 0)
+WAL_SCHEDULES = int(os.environ.get("WAL_TORTURE_SCHEDULES", "120"), 0)
+
+SEGMENT_MS = 3_600_000
+SCHEMA = pa.schema([("k", pa.string()), ("ts", pa.int64()),
+                    ("v", pa.float64())])
+
+
+@pytest.fixture(scope="module")
+def runtimes():
+    rt = runtimes_mod.from_config(ThreadsConfig())
+    yield rt
+    rt.close()
+
+
+def batch(rows):
+    k, t, v = zip(*rows)
+    return pa.record_batch(
+        [pa.array(list(k)), pa.array(list(t), type=pa.int64()),
+         pa.array(list(v), type=pa.float64())], schema=SCHEMA)
+
+
+def wreq(rows):
+    lo = min(r[1] for r in rows)
+    hi = max(r[1] for r in rows) + 1
+    return WriteRequest(batch(rows), TimeRange.new(lo, hi))
+
+
+def storage_config():
+    cfg = from_dict(StorageConfig, {
+        "scheduler": {"schedule_interval": "1h", "input_sst_min_num": 2},
+    })
+    cfg.manifest.merge_interval = ReadableDuration.parse("1h")
+    cfg.scrub.interval = ReadableDuration.parse("1h")
+    cfg.retry.base_backoff = ReadableDuration.from_millis(1)
+    return cfg
+
+
+def wal_config(wal_dir, **kw):
+    defaults = dict(enabled=True, dir=str(wal_dir), flush_rows=10**6,
+                    flush_bytes=1 << 30,
+                    flush_age=ReadableDuration.parse("1h"),
+                    flush_interval=ReadableDuration.parse("1h"),
+                    max_group_wait=ReadableDuration.from_millis(0))
+    defaults.update(kw)
+    return WalConfig(**defaults)
+
+
+async def open_ingest(store, wal_dir, runtimes, on_op=None, **kw):
+    inner = await CloudObjectStorage.open("db", SEGMENT_MS, store, SCHEMA, 2,
+                                          storage_config(), runtimes=runtimes)
+    return await IngestStorage.open(inner, str(wal_dir),
+                                    wal_config(wal_dir, **kw), on_op=on_op)
+
+
+async def scan_rows(s, pred=None):
+    out = []
+    async for b in s.scan(ScanRequest(range=TimeRange.new(0, 10**12),
+                                      predicate=pred)):
+        out.extend(zip(b.column(0).to_pylist(), b.column(1).to_pylist(),
+                       b.column(2).to_pylist()))
+    return sorted(out)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# ---------------------------------------------------------------------------
+
+
+class TestWalFraming:
+    def test_roundtrip(self):
+        b = batch([("a", 1, 1.5), ("b", 2, 2.5)])
+        blob = encode_record(7, TimeRange.new(1, 3), b)
+        recs = list(decode_records(blob * 3))
+        assert len(recs) == 3
+        for r in recs:
+            assert r.seq == 7
+            assert r.time_range == TimeRange.new(1, 3)
+            assert r.batch.equals(b)
+
+    def test_torn_tail_stops_cleanly(self):
+        b = batch([("a", 1, 1.0)])
+        blob = encode_record(1, TimeRange.new(1, 2), b)
+        recs = list(decode_records(blob + blob[: len(blob) // 2]))
+        assert len(recs) == 1  # the torn half-record is dropped
+
+    def test_crc_corruption_stops(self):
+        b = batch([("a", 1, 1.0)])
+        blob = bytearray(encode_record(1, TimeRange.new(1, 2), b) * 2)
+        blob[12] ^= 0xFF  # flip a payload byte of record 0
+        assert list(decode_records(bytes(blob))) == []
+
+    def test_garbage_header_stops(self):
+        assert list(decode_records(b"\xff" * 64)) == []
+
+
+class TestWalLog:
+    def test_rotation_and_truncation(self, tmp_path):
+        async def go():
+            cfg = wal_config(tmp_path, segment_bytes=1)  # rotate every group
+            wal = Wal(str(tmp_path), cfg)
+            wal.replay()
+            wal.start()
+            b = batch([("a", 1, 1.0)])
+            seqs = []
+            for seq in (1, 2, 3):
+                await wal.append(seq, TimeRange.new(1, 2), b)
+                seqs.append(seq)
+            assert wal.segment_count >= 3
+            wal.mark_flushed(seqs[:2])
+            deleted = await wal.truncate()
+            # the first two segments are sealed + drained; the last may
+            # still be active
+            assert deleted >= 1
+            await wal.close()
+
+        run(go())
+
+    def test_group_commit_coalesces(self, tmp_path):
+        fsyncs = []
+
+        async def go():
+            cfg = wal_config(tmp_path,
+                             max_group_wait=ReadableDuration.from_millis(5))
+            wal = Wal(str(tmp_path), cfg,
+                      on_op=lambda op: fsyncs.append(op)
+                      if op == "fsync" else None)
+            wal.replay()
+            wal.start()
+            b = batch([("a", 1, 1.0)])
+            await asyncio.gather(*[
+                wal.append(seq, TimeRange.new(1, 2), b)
+                for seq in range(1, 33)])
+            await wal.close()
+
+        run(go())
+        # 32 concurrent writers must share fsyncs (one per group, not
+        # one per write)
+        assert 1 <= len(fsyncs) < 32
+
+    def test_replay_reads_back(self, tmp_path):
+        async def go():
+            cfg = wal_config(tmp_path)
+            wal = Wal(str(tmp_path), cfg)
+            wal.replay()
+            wal.start()
+            await wal.append(5, TimeRange.new(1, 2), batch([("a", 1, 1.0)]))
+            await wal.append(6, TimeRange.new(2, 3), batch([("b", 2, 2.0)]))
+            await wal.close()
+            wal2 = Wal(str(tmp_path), cfg)
+            recs = wal2.replay()
+            assert [r.seq for r in recs] == [5, 6]
+            await wal2.close()
+
+        run(go())
+
+
+class TestHybridScan:
+    def test_unflushed_rows_visible(self, tmp_path, runtimes):
+        async def go():
+            s = await open_ingest(MemoryObjectStore(), tmp_path, runtimes)
+            try:
+                await s.write(wreq([("a", 10, 1.0), ("b", 20, 2.0)]))
+                assert await scan_rows(s) == [("a", 10, 1.0),
+                                              ("b", 20, 2.0)]
+                # no SST was written (ack point is the WAL fsync)
+                assert await s.manifest.all_ssts() == []
+            finally:
+                await s.close()
+
+        run(go())
+
+    def test_last_value_across_flush_boundary(self, tmp_path, runtimes):
+        async def go():
+            s = await open_ingest(MemoryObjectStore(), tmp_path, runtimes)
+            try:
+                await s.write(wreq([("a", 10, 1.0)]))
+                await s.flush_all()
+                assert len(await s.manifest.all_ssts()) == 1
+                await s.write(wreq([("a", 10, 9.0)]))  # newer, unflushed
+                assert await scan_rows(s) == [("a", 10, 9.0)]
+                # and the reverse: memtable row older than nothing —
+                # flush everything, same answer
+                await s.flush_all()
+                assert await scan_rows(s) == [("a", 10, 9.0)]
+            finally:
+                await s.close()
+
+        run(go())
+
+    def test_predicate_applies_after_dedup(self, tmp_path, runtimes):
+        """A value-column predicate must not resurrect an overwritten
+        SST row: (a,10)->1.0 is flushed, then overwritten in the
+        memtable with 5.0; filtering v==1.0 returns NOTHING."""
+
+        async def go():
+            s = await open_ingest(MemoryObjectStore(), tmp_path, runtimes)
+            try:
+                await s.write(wreq([("a", 10, 1.0)]))
+                await s.flush_all()
+                await s.write(wreq([("a", 10, 5.0)]))
+                assert await scan_rows(s, pred=Eq("v", 1.0)) == []
+                assert await scan_rows(s, pred=Eq("v", 5.0)) == \
+                    [("a", 10, 5.0)]
+                # pk predicates keep working on the hybrid path
+                assert await scan_rows(
+                    s, pred=And([Eq("k", "a"), Eq("v", 5.0)])) == \
+                    [("a", 10, 5.0)]
+            finally:
+                await s.close()
+
+        run(go())
+
+    def test_multi_segment_hybrid(self, tmp_path, runtimes):
+        async def go():
+            s = await open_ingest(MemoryObjectStore(), tmp_path, runtimes)
+            try:
+                # seg 0 flushed, seg 1 memtable-only, seg 2 hybrid
+                await s.write(wreq([("a", 10, 1.0)]))
+                await s.flush_all()
+                await s.write(wreq([("b", SEGMENT_MS + 10, 2.0)]))
+                await s.write(wreq([("c", 2 * SEGMENT_MS + 10, 3.0)]))
+                await s.flush_all()
+                await s.write(wreq([("c", 2 * SEGMENT_MS + 10, 4.0)]))
+                assert await scan_rows(s) == [
+                    ("a", 10, 1.0), ("b", SEGMENT_MS + 10, 2.0),
+                    ("c", 2 * SEGMENT_MS + 10, 4.0)]
+            finally:
+                await s.close()
+
+        run(go())
+
+    def test_rows_flush_threshold_triggers_background(self, tmp_path,
+                                                      runtimes):
+        async def go():
+            s = await open_ingest(
+                MemoryObjectStore(), tmp_path, runtimes, flush_rows=4,
+                flush_interval=ReadableDuration.from_millis(10))
+            try:
+                for i in range(6):
+                    await s.write(wreq([(f"k{i}", 10 + i, float(i))]))
+                for _ in range(200):
+                    if await s.manifest.all_ssts():
+                        break
+                    await asyncio.sleep(0.01)
+                assert await s.manifest.all_ssts(), \
+                    "background flusher never drained the memtable"
+                assert len(await scan_rows(s)) == 6
+            finally:
+                await s.close()
+
+        run(go())
+
+    def test_aggregate_flushes_then_delegates(self, tmp_path, runtimes):
+        async def go():
+            from horaedb_tpu.storage.read import AggregateSpec
+
+            s = await open_ingest(MemoryObjectStore(), tmp_path, runtimes)
+            try:
+                await s.write(wreq([("a", 10, 1.0), ("a", 70_000, 3.0)]))
+                spec = AggregateSpec(group_col="k", ts_col="ts",
+                                     value_col="v", range_start=0,
+                                     bucket_ms=60_000, num_buckets=2,
+                                     which=("sum",))
+                req = ScanRequest(range=TimeRange.new(0, 120_000))
+                values, grids = await s.scan_aggregate(req, spec)
+                # the pre-flush drained the memtable into an SST
+                assert len(await s.manifest.all_ssts()) == 1
+                assert list(values) == ["a"]
+                assert grids["sum"].tolist() == [[1.0, 3.0]]
+            finally:
+                await s.close()
+
+        run(go())
+
+
+class TestFlushScanRace:
+    def test_rows_visible_during_inflight_flush(self, tmp_path, runtimes):
+        """The flush-visibility invariant: while the SST write is in
+        flight (memtable already drained for writing, manifest commit
+        not yet landed), a concurrent scan must still see the rows."""
+
+        async def go():
+            s = await open_ingest(MemoryObjectStore(), tmp_path, runtimes)
+            try:
+                await s.write(wreq([("a", 10, 1.0), ("b", 20, 2.0)]))
+                gate = asyncio.Event()
+                entered = asyncio.Event()
+                real = s.inner.write_stamped
+
+                async def slow_write_stamped(table, rng):
+                    entered.set()
+                    await gate.wait()
+                    return await real(table, rng)
+
+                s.inner.write_stamped = slow_write_stamped
+                flush_task = asyncio.create_task(s.flush_all())
+                await asyncio.wait_for(entered.wait(), 10)
+                # mid-flush: neither popped-invisible nor SST-visible
+                assert await scan_rows(s) == [("a", 10, 1.0),
+                                              ("b", 20, 2.0)]
+                st = s.ingest_stats()
+                assert st["memtable_rows"] == 2  # still buffered
+                gate.set()
+                await flush_task
+                s.inner.write_stamped = real
+                assert await scan_rows(s) == [("a", 10, 1.0),
+                                              ("b", 20, 2.0)]
+                assert s.ingest_stats()["memtable_rows"] == 0
+            finally:
+                await s.close()
+
+        run(go())
+
+
+class TestGroupWriteFailure:
+    def test_failed_group_write_rotates_segment(self, tmp_path, runtimes):
+        """After a failed group write the active segment may end in a
+        torn frame; later acked groups must land in a FRESH segment so
+        replay (which stops at the first bad frame) can reach them."""
+
+        class FailOnce:
+            def __init__(self):
+                self.fired = False
+
+            def __call__(self, op):
+                if op == "append" and not self.fired:
+                    self.fired = True
+                    raise OSError("simulated EIO mid-append")
+
+        async def go():
+            store = MemoryObjectStore()
+            s = await open_ingest(store, tmp_path, runtimes,
+                                  on_op=FailOnce())
+            with pytest.raises(Exception):
+                await s.write(wreq([("lost", 10, 1.0)]))
+            await s.write(wreq([("kept", 20, 2.0)]))  # acked
+            files = sorted(f for f in os.listdir(tmp_path)
+                           if f.endswith(".wal"))
+            assert len(files) == 2, \
+                "the acked group must not share the possibly-torn file"
+            await s.abort()
+            s2 = await open_ingest(store, tmp_path, runtimes)
+            try:
+                assert await scan_rows(s2) == [("kept", 20, 2.0)]
+            finally:
+                await s2.close()
+
+        run(go())
+
+
+class TestStaleSchemaReplay:
+    def test_dropped_records_do_not_pin_segments(self, tmp_path, runtimes):
+        async def go():
+            store = MemoryObjectStore()
+            s = await open_ingest(store, tmp_path, runtimes)
+            await s.write(wreq([("a", 10, 1.0)]))
+            await s.abort()
+            # reopen under a DIFFERENT user schema: the replayed record
+            # is dropped, but its seq must not pin the segment forever
+            schema_b = pa.schema([("k", pa.string()), ("ts", pa.int64()),
+                                  ("other", pa.float64())])
+            inner = await CloudObjectStorage.open(
+                "db2", SEGMENT_MS, store, schema_b, 2, storage_config(),
+                runtimes=runtimes)
+            s2 = await IngestStorage.open(inner, str(tmp_path),
+                                          wal_config(tmp_path))
+            try:
+                assert s2.ingest_stats()["memtable_rows"] == 0
+                await s2.wal.truncate()
+                assert s2.wal.backlog_bytes == 0
+            finally:
+                await s2.close()
+
+        run(go())
+
+
+class TestReplay:
+    def test_acked_rows_survive_kill(self, tmp_path, runtimes):
+        async def go():
+            store = MemoryObjectStore()
+            s = await open_ingest(store, tmp_path, runtimes)
+            await s.write(wreq([("a", 10, 1.0)]))
+            await s.write(wreq([("b", 20, 2.0)]))
+            await s.abort()  # kill -9: nothing flushed
+            s2 = await open_ingest(store, tmp_path, runtimes)
+            try:
+                assert await scan_rows(s2) == [("a", 10, 1.0),
+                                               ("b", 20, 2.0)]
+                st = s2.ingest_stats()
+                assert st["memtable_rows"] == 2
+                assert st["wal_backlog_bytes"] > 0
+            finally:
+                await s2.close()
+
+        run(go())
+
+    def test_replay_over_flushed_sst_is_exactly_once(self, tmp_path,
+                                                     runtimes):
+        """Crash AFTER the flush commit but BEFORE truncation: replay
+        rebuilds memtables an SST already covers — the seq tie must
+        collapse in the merge, and a re-flush must not duplicate."""
+        import shutil
+
+        async def go():
+            store = MemoryObjectStore()
+            s = await open_ingest(store, tmp_path / "wal", runtimes)
+            await s.write(wreq([("a", 10, 1.0)]))
+            await s.write(wreq([("a", 10, 2.0), ("b", 20, 3.0)]))
+            backup = tmp_path / "bk"
+            shutil.copytree(tmp_path / "wal", backup)
+            await s.flush_all()
+            await s.abort()
+            # restore the pre-truncation WAL: both sources now hold the
+            # same rows
+            shutil.rmtree(tmp_path / "wal")
+            shutil.copytree(backup, tmp_path / "wal")
+            s2 = await open_ingest(store, tmp_path / "wal", runtimes)
+            try:
+                expect = [("a", 10, 2.0), ("b", 20, 3.0)]
+                assert await scan_rows(s2) == expect
+                await s2.flush_all()
+                assert await scan_rows(s2) == expect
+            finally:
+                await s2.close()
+
+        run(go())
+
+    def test_truncation_empties_wal_dir(self, tmp_path, runtimes):
+        async def go():
+            store = MemoryObjectStore()
+            # segment_bytes=1: every group seals its segment, so a
+            # flush truncates ALL previous data
+            s = await open_ingest(store, tmp_path, runtimes,
+                                  segment_bytes=1)
+            try:
+                for i in range(4):
+                    await s.write(wreq([(f"k{i}", 10 + i, float(i))]))
+                assert s.wal.backlog_bytes > 0
+                await s.flush_all()
+                assert s.wal.backlog_bytes == 0
+                files = [f for f in os.listdir(tmp_path)
+                         if f.endswith(".wal")]
+                assert len(files) <= 1  # at most the empty active file
+            finally:
+                await s.close()
+
+        run(go())
+
+    def test_wal_disabled_for_append_mode(self, tmp_path, runtimes):
+        async def go():
+            from horaedb_tpu.common.error import Error
+            from horaedb_tpu.storage.config import UpdateMode
+
+            cfg = storage_config()
+            cfg.update_mode = UpdateMode.APPEND
+            inner = await CloudObjectStorage.open(
+                "db", SEGMENT_MS, MemoryObjectStore(), SCHEMA, 2, cfg,
+                runtimes=runtimes)
+            with pytest.raises(Error):
+                await IngestStorage.open(inner, str(tmp_path),
+                                         wal_config(tmp_path))
+            await inner.close()
+
+        run(go())
+
+
+class TestEngineAndServer:
+    def test_metric_engine_hybrid_query(self, tmp_path):
+        async def go():
+            from horaedb_tpu.metric_engine import (Label, MetricEngine,
+                                                   Sample)
+
+            engine = await MetricEngine.open(
+                "m", MemoryObjectStore(), segment_ms=2 * SEGMENT_MS,
+                wal_config=wal_config(tmp_path))
+            try:
+                t0 = 1_700_000_000_000
+                await engine.write([
+                    Sample("cpu", [Label("host", "h1")], t0 + i, float(i))
+                    for i in range(5)])
+                rng = TimeRange.new(t0, t0 + 1000)
+                # raw query sees acked-but-unflushed rows (all five
+                # tables are WAL-fronted; resolution + index + data all
+                # ride the hybrid scan)
+                tbl = await engine.query("cpu", [("host", "h1")], rng)
+                assert sorted(tbl.column("value").to_pylist()) == \
+                    [0.0, 1.0, 2.0, 3.0, 4.0]
+                # downsample flushes then reads pure SST state
+                out = await engine.query_downsample(
+                    "cpu", [], rng, bucket_ms=1000, aggs=("sum",))
+                assert out["aggs"]["sum"].tolist() == [[10.0]]
+                stats = await engine.stats()
+                assert stats["ssts"] > 0
+                assert "wal_backlog_bytes" in stats
+                flushed = await engine.flush()
+                assert set(flushed) == set(engine.tables)
+            finally:
+                await engine.close()
+
+        run(go())
+
+    def test_server_stats_and_admin_flush(self, tmp_path):
+        async def go():
+            from aiohttp.test_utils import TestClient, TestServer
+
+            from horaedb_tpu.metric_engine import MetricEngine
+            from horaedb_tpu.server.config import ServerConfig
+            from horaedb_tpu.server.main import ServerState, build_app
+
+            engine = await MetricEngine.open(
+                "m", MemoryObjectStore(), segment_ms=2 * SEGMENT_MS,
+                wal_config=wal_config(tmp_path))
+            state = ServerState(engine, ServerConfig())
+            client = TestClient(TestServer(build_app(state)))
+            await client.start_server()
+            try:
+                t0 = 1_700_000_000_000
+                r = await client.post("/write", json={"samples": [
+                    {"name": "m1", "labels": {"h": "a"},
+                     "timestamp": t0, "value": 1.5}]})
+                assert r.status == 200
+                r = await client.get("/stats")
+                body = await r.json()
+                assert body["memtable_rows"] > 0
+                assert body["ssts"] == 0  # nothing flushed yet
+                r = await client.post("/admin/flush")
+                assert r.status == 200
+                flushed = await r.json()
+                assert sum(v["flushed_rows"]
+                           for v in flushed.values()) > 0
+                r = await client.get("/stats")
+                body = await r.json()
+                assert body["memtable_rows"] == 0
+                assert body["ssts"] > 0
+                # the write is still queryable after the flush
+                r = await client.post("/query", json={
+                    "metric": "m1", "start": t0, "end": t0 + 10})
+                assert (await r.json())["values"] == [1.5]
+                r = await client.get("/metrics")
+                text = await r.text()
+                for name in ("wal_appends_total", "wal_group_commits_total",
+                             "memtable_flushes_total", "wal_backlog_bytes",
+                             "memtable_rows"):
+                    assert name in text, name
+            finally:
+                await client.close()
+                await engine.close()
+
+        run(go())
+
+    def test_wal_config_section_parses(self):
+        from horaedb_tpu.common.error import Error
+        from horaedb_tpu.server.config import ServerConfig, _dc_from_dict
+
+        cfg = _dc_from_dict(ServerConfig, {"wal": {
+            "enabled": True, "dir": "/tmp/w", "max_group_wait": "3ms",
+            "flush_rows": 123}})
+        assert cfg.wal.enabled and cfg.wal.dir == "/tmp/w"
+        assert cfg.wal.max_group_wait.seconds == 0.003
+        assert cfg.wal.flush_rows == 123
+        with pytest.raises(Error):
+            _dc_from_dict(ServerConfig, {"wal": {"bogus_key": 1}})
+
+    def test_wal_toml_roundtrip(self, tmp_path):
+        pytest.importorskip("tomllib")  # py3.11+ (mirrors TestConfig)
+        from horaedb_tpu.server.config import load_config
+
+        p = tmp_path / "cfg.toml"
+        p.write_text('[wal]\nenabled = true\ndir = "/tmp/w"\n'
+                     'max_group_wait = "3ms"\nflush_rows = 123\n')
+        cfg = load_config(str(p))
+        assert cfg.wal.enabled and cfg.wal.dir == "/tmp/w"
+
+    def test_wal_empty_dir_requires_local_store(self, tmp_path):
+        pytest.importorskip("tomllib")  # py3.11+ (mirrors TestConfig)
+        from horaedb_tpu.common.error import Error
+        from horaedb_tpu.server.config import load_config
+
+        p = tmp_path / "cfg.toml"
+        p.write_text(
+            '[wal]\nenabled = true\n'
+            '[metric_engine.object_store]\nkind = "S3Like"\n'
+            '[metric_engine.object_store.s3]\nendpoint = "http://x"\n'
+            'bucket = "b"\nkey_id = "k"\nkey_secret = "s"\n')
+        with pytest.raises(Error):
+            load_config(str(p))
+
+
+# ---------------------------------------------------------------------------
+# The WAL crash-torture harness: seeded schedules of write / flush /
+# reopen with a simulated process kill at a random WAL op index AND/OR
+# a random object-store op index.  Invariant: after revival + replay,
+# every acked row is visible exactly once with a value no older than
+# its last ack, and nothing visible was never attempted.
+
+
+class SimCrash(Exception):
+    pass
+
+
+class Crashed(Exception):
+    pass
+
+
+class CrashHook:
+    """Crash-at-op for WAL durable transitions, shared with the
+    object-store's FaultInjectingStore halt so a 'process death' stops
+    both planes at once."""
+
+    def __init__(self, crash_at, store):
+        self.ops = 0
+        self.crash_at = crash_at
+        self.store = store
+        self.halted = False
+
+    def __call__(self, op: str) -> None:
+        if self.halted:
+            raise SimCrash(f"halted: {op}")
+        self.ops += 1
+        if self.crash_at is not None and self.ops >= self.crash_at:
+            self.halted = True
+            self.store.crash()
+            raise SimCrash(f"crash at wal op #{self.ops} ({op})")
+
+
+async def run_wal_schedule(i: int, runtimes, base_dir) -> None:
+    rng = random.Random((WAL_SEED << 16) ^ i)
+    inner_store = MemoryObjectStore()
+    store = FaultInjectingStore(
+        inner_store, seed=rng.randrange(2**32),
+        fault_rate=rng.choice([0.0, 0.0, 0.02]),
+        crash_at=(rng.randint(2, 80) if rng.random() < 0.5 else None))
+    hook = CrashHook(
+        rng.randint(2, 40) if rng.random() < 0.7 else None, store)
+    wal_dir = os.path.join(str(base_dir), f"sched{i}")
+
+    # (k, ts) -> (order, value) of the last ACKED write; attempted maps
+    # each key to every (order, value) ever sent — lost-ack writes may
+    # surface with a NEWER-than-acked attempted value, which is legal
+    acked: dict = {}
+    attempted: dict = {}
+    order = 0
+    keys_used: list = []
+
+    def next_rows():
+        nonlocal order
+        rows = []
+        for _ in range(rng.randint(1, 3)):
+            if keys_used and rng.random() < 0.3:
+                k, ts = rng.choice(keys_used)  # overwrite an older key
+            else:
+                seg = rng.randrange(2)
+                k, ts = f"k{rng.randrange(6)}", \
+                    seg * SEGMENT_MS + 10 + len(keys_used)
+                keys_used.append((k, ts))
+            rows.append((k, ts, float(order * 1000 + len(rows))))
+        order += 1
+        return rows
+
+    def guard(coro):
+        async def go():
+            try:
+                return await coro
+            except asyncio.CancelledError:
+                raise
+            except BaseException:
+                if store.halted or hook.halted:
+                    hook.halted = True
+                    raise Crashed from None
+                raise
+        return go()
+
+    async def open_s():
+        inner = await CloudObjectStorage.open(
+            "db", SEGMENT_MS, store, SCHEMA, 2, storage_config(),
+            runtimes=runtimes)
+        cfg = wal_config(wal_dir,
+                         flush_rows=rng.choice([3, 20, 10**6]),
+                         segment_bytes=rng.choice([1, 1 << 20]),
+                         flush_interval=ReadableDuration.parse("1h"))
+        return await IngestStorage.open(inner, wal_dir, cfg, on_op=hook)
+
+    s = None
+    try:
+        s = await guard(open_s())
+        for _ in range(rng.randint(4, 12)):
+            op = rng.choices(["write", "flush", "reopen", "scan"],
+                             weights=[65, 15, 10, 10])[0]
+            if op == "write":
+                rows = next_rows()
+                this_order = order
+                for k, ts, v in rows:
+                    attempted.setdefault((k, ts), []).append(
+                        (this_order, v))
+                try:
+                    await guard(s.write(wreq(rows)))
+                except Crashed:
+                    raise
+                except Exception:
+                    continue  # unacked: may or may not surface later
+                for k, ts, v in rows:
+                    acked[(k, ts)] = (this_order, v)
+            elif op == "flush":
+                try:
+                    await guard(s.flush_all())
+                except Crashed:
+                    raise
+                except Exception:
+                    continue
+            elif op == "reopen":
+                try:
+                    await guard(s.close(flush=rng.random() < 0.5))
+                except Crashed:
+                    s = None
+                    raise
+                except Exception:
+                    pass
+                s = await guard(open_s())
+            elif op == "scan":
+                try:
+                    rows = await guard(scan_rows(s))
+                except Crashed:
+                    raise
+                except Exception:
+                    continue
+                seen = dict(((k, ts), v) for k, ts, v in rows)
+                assert len(seen) == len(rows), \
+                    f"schedule {i}: duplicate rows mid-schedule"
+                for key, (_, v) in acked.items():
+                    assert key in seen, \
+                        f"schedule {i}: acked row {key} missing pre-crash"
+    except Crashed:
+        pass
+    finally:
+        if s is not None:
+            await s.abort()
+
+    # ---- the restart -----------------------------------------------------
+    store.revive()
+    store.clear_faults()
+    store.fault_rate = 0.0
+    hook.halted = False
+    hook.crash_at = None
+
+    s2 = await open_s()
+    try:
+        for attempt in range(2):  # scan, then flush + rescan
+            rows = await scan_rows(s2)
+            seen: dict = {}
+            for k, ts, v in rows:
+                key = (k, ts)
+                assert key not in seen, \
+                    f"schedule {i}: duplicate row {key} (attempt " \
+                    f"{attempt})"
+                seen[key] = v
+            for key, (ord_, v) in acked.items():
+                assert key in seen, \
+                    f"schedule {i}: acked row {key} lost"
+                candidates = [(o, av) for o, av in attempted[key]
+                              if o >= ord_]
+                assert any(av == seen[key] for _, av in candidates), \
+                    f"schedule {i}: acked row {key} shows {seen[key]}, " \
+                    f"older than its last ack {v}"
+            for key, v in seen.items():
+                assert any(av == v for _, av in attempted.get(key, [])), \
+                    f"schedule {i}: ghost row {key}={v}"
+            if attempt == 0:
+                await s2.flush_all()
+    finally:
+        await s2.close()
+
+
+def test_wal_torture_fast(runtimes, tmp_path):
+    """Tier-1 default: 12 seeded WAL crash schedules; `make chaos`
+    runs the full WAL_TORTURE_SCHEDULES sweep below."""
+
+    async def go():
+        for i in range(12):
+            await run_wal_schedule(i, runtimes, tmp_path)
+
+    run(go())
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("chunk", range(6))
+def test_wal_torture_schedules(chunk, runtimes, tmp_path):
+    per = max(1, WAL_SCHEDULES // 6)
+
+    async def go():
+        for i in range(chunk * per, (chunk + 1) * per):
+            await run_wal_schedule(i, runtimes, tmp_path)
+
+    run(go())

@@ -15,6 +15,10 @@ Checks, all hard failures:
   - aiohttp session HTTP calls without an explicit `timeout=` anywhere
     under horaedb_tpu/ (docs/robustness.md: aiohttp's 5-minute default
     total timeout must never be inherited on the serving path)
+  - WAL durability rules under horaedb_tpu/wal/: a module that writes
+    file bytes must also os.fsync (an fsync-free WAL write is not an
+    ack point), and bare `time.time()` is banned (replay must order by
+    the persisted id clock; tests inject clocks)
 
 Usage: python tools/lint.py [paths...]   (default: horaedb_tpu tests
 bench.py __graft_entry__.py)
@@ -155,6 +159,54 @@ def lint_file(path: pathlib.Path) -> list[str]:
                     f"{path}:{node.lineno}: aiohttp session call without "
                     "an explicit timeout= (would inherit the 5-minute "
                     "default; derive one from the deadline)")
+    if "wal" in path.parts and "horaedb_tpu" in path.parts:
+        problems.extend(_lint_wal_module(path, tree, lines))
+    return problems
+
+
+def _is_call_to(node: ast.Call, mod: str, attr: str) -> bool:
+    return (isinstance(node.func, ast.Attribute)
+            and node.func.attr == attr
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == mod)
+
+
+def _lint_wal_module(path: pathlib.Path, tree: ast.AST,
+                     lines: list[str]) -> list[str]:
+    """WAL durability rules (docs/robustness.md, write durability):
+    a wal/ module performing file `.write()` calls must fsync (an
+    unfsynced WAL append is not an ack point), and bare `time.time()`
+    never appears — flush aging and replay use injected clocks / the
+    persisted monotonic id clock so torture schedules are
+    deterministic."""
+    problems: list[str] = []
+    has_fsync = False
+    write_calls: list[int] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        src = (lines[node.lineno - 1]
+               if node.lineno <= len(lines) else "")
+        if _is_call_to(node, "os", "fsync"):
+            has_fsync = True
+        elif (isinstance(node.func, ast.Attribute)
+                and node.func.attr == "write"
+                and not (isinstance(node.func.value, ast.Attribute)
+                         or "noqa" in src)):
+            # direct `<name>.write(...)` — the file-handle shape; method
+            # chains (self.inner.write, sink.stream.write) are storage
+            # or arrow surfaces with their own disciplines
+            write_calls.append(node.lineno)
+        elif _is_call_to(node, "time", "time") and "noqa" not in src:
+            problems.append(
+                f"{path}:{node.lineno}: bare time.time() in wal/ "
+                "(inject a clock; replay must use the persisted id "
+                "clock)")
+    if write_calls and not has_fsync:
+        problems.append(
+            f"{path}:{write_calls[0]}: file write in wal/ with no "
+            "os.fsync anywhere in the module — an unfsynced WAL write "
+            "must never be an ack point")
     return problems
 
 
