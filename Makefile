@@ -1,18 +1,26 @@
 .PHONY: test native bench clean verify lint chaos
 
+# mirrors the tier-1 invocation (fast variants of the slow suites stay
+# in-tier; `make chaos` runs the full slow schedules)
 test:
-	python -m pytest tests/ -q
+	python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
+	-p no:cacheprovider -p no:xdist -p no:randomly
 
 # seeded fault-injection + crash-consistency torture suites (see
-# docs/robustness.md); override TORTURE_SEED / TORTURE_SCHEDULES to
+# docs/robustness.md); override TORTURE_SEED / TORTURE_SCHEDULES (and
+# the WAL replay twins WAL_TORTURE_SEED / WAL_TORTURE_SCHEDULES) to
 # reproduce a failure or dial intensity
 TORTURE_SEED ?= 1337
 TORTURE_SCHEDULES ?= 200
+WAL_TORTURE_SEED ?= 1337
+WAL_TORTURE_SCHEDULES ?= 120
 
 chaos:
 	TORTURE_SEED=$(TORTURE_SEED) TORTURE_SCHEDULES=$(TORTURE_SCHEDULES) \
+	WAL_TORTURE_SEED=$(WAL_TORTURE_SEED) \
+	WAL_TORTURE_SCHEDULES=$(WAL_TORTURE_SCHEDULES) \
 	python -m pytest tests/test_fault_injection.py tests/test_torture.py \
-	tests/test_objstore_middleware.py -q
+	tests/test_objstore_middleware.py tests/test_wal.py -q
 
 # stdlib AST lint gate (the reference CI runs fmt+clippy -D warnings;
 # this image ships no ruff/flake8, so the gate is tools/lint.py)

@@ -22,8 +22,9 @@ backend — such numbers are NOT device numbers.
 
 Env knobs: BENCH_ROWS (default 10_000_000), BENCH_ITERS (default 20),
 BENCH_CONFIG (default 1 = end-to-end engine; 0 = device kernel
-microbench; 2-7 delegate to horaedb_tpu.bench.suite, 6 being the
-manifest snapshot codec and 7 the mixed read/write churn workload).
+microbench; 2-8 delegate to horaedb_tpu.bench.suite, 6 being the
+manifest snapshot codec, 7 the mixed read/write churn workload, and
+8 the durable-ingest WAL group-commit bench).
 """
 
 import asyncio
@@ -517,7 +518,7 @@ def main() -> None:
     try:
         config = int(os.environ.get("BENCH_CONFIG", 1))
     except ValueError:
-        sys.exit(f"BENCH_CONFIG must be 0-7, got "
+        sys.exit(f"BENCH_CONFIG must be 0-8, got "
                  f"{os.environ.get('BENCH_CONFIG')!r}")
 
     ensure_responsive_backend()
@@ -533,7 +534,7 @@ def main() -> None:
         from horaedb_tpu.bench.suite import RUNNERS
 
         if config not in RUNNERS:
-            sys.exit(f"BENCH_CONFIG must be 0-7, got {config}")
+            sys.exit(f"BENCH_CONFIG must be 0-8, got {config}")
         result = RUNNERS[config](rows, iters)
     # a config's own backend/fallback labels win (config 6 is pure host
     # work and must never read as a device number)

@@ -941,13 +941,141 @@ def run_config7(rows: int, iters: int) -> dict:
     }
 
 
+# ---------------------------------------------------------------------------
+# config 8: durable ingest — WAL group commit vs one-SST-per-write
+# ---------------------------------------------------------------------------
+
+
+def run_config8(rows: int, iters: int) -> dict:
+    """Acked-writes/s and p99 ack latency at batch size 1 under 32
+    concurrent writers, on a REAL local filesystem (fsyncs included):
+    the one-SST-per-write baseline (every ack pays parquet + object put
+    + manifest delta) vs the WAL+memtable front end across group-commit
+    coalescing windows.  vs_baseline here is wal_rate / baseline_rate —
+    HIGHER is better (the ISSUE 3 acceptance floor is 5x).  `iters` is
+    unused: each variant is one sustained run (`rows` scales the write
+    count)."""
+    import shutil
+    import tempfile
+
+    import pyarrow as pa
+
+    from horaedb_tpu.common import ReadableDuration
+    from horaedb_tpu.objstore import LocalObjectStore
+    from horaedb_tpu.storage.config import StorageConfig, from_dict
+    from horaedb_tpu.storage.storage import CloudObjectStorage, WriteRequest
+    from horaedb_tpu.storage.types import TimeRange
+    from horaedb_tpu.wal import IngestStorage, WalConfig
+
+    del iters
+    seg_ms = 3_600_000
+    schema = pa.schema([("k", pa.string()), ("ts", pa.int64()),
+                        ("v", pa.float64())])
+    n_writes = max(64, min(rows // 5000, 2000))
+    concurrency = 32
+
+    def storage_cfg():
+        c = from_dict(StorageConfig, {
+            "scheduler": {"schedule_interval": "1h"}})
+        c.manifest.merge_interval = ReadableDuration.parse("1h")
+        c.scrub.interval = ReadableDuration.parse("1h")
+        return c
+
+    async def drive(s, n):
+        lat = []
+
+        async def worker(w):
+            for i in range(w, n, concurrency):
+                ts = 10 + i
+                b = pa.record_batch(
+                    [pa.array([f"k{i % 97}"]),
+                     pa.array([ts], type=pa.int64()),
+                     pa.array([float(i)], type=pa.float64())],
+                    schema=schema)
+                t0 = time.perf_counter()
+                await s.write(WriteRequest(b, TimeRange.new(ts, ts + 1)))
+                lat.append(time.perf_counter() - t0)
+
+        t_start = time.perf_counter()
+        await asyncio.gather(*[worker(w) for w in range(concurrency)])
+        elapsed = time.perf_counter() - t_start
+        return n / elapsed, float(np.percentile(lat, 99) * 1e3)
+
+    async def bench():
+        out = {}
+        tmp = tempfile.mkdtemp(prefix="ingest-bench-base-")
+        try:
+            s = await CloudObjectStorage.open(
+                "db", seg_ms, LocalObjectStore(tmp), schema, 2,
+                storage_cfg())
+            # the baseline pays a full object-store round trip per ack;
+            # a shorter sustained run measures the same steady state
+            base_n = min(n_writes, 256)
+            base_rate, base_p99 = await drive(s, base_n)
+            await s.close()
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+        _log(f"config8 baseline: {base_rate:.0f} acked writes/s "
+             f"(p99 ack {base_p99:.2f} ms, {base_n} writes)")
+        out["baseline_writes_per_s"] = round(base_rate, 1)
+        out["baseline_p99_ack_ms"] = round(base_p99, 3)
+
+        best = None
+        variants = {}
+        for wait_ms in (0, 1, 4):
+            tmp = tempfile.mkdtemp(prefix="ingest-bench-wal-")
+            try:
+                inner = await CloudObjectStorage.open(
+                    "db", seg_ms,
+                    LocalObjectStore(tmp + "/data"), schema, 2,
+                    storage_cfg())
+                wc = WalConfig(
+                    enabled=True, dir=tmp + "/wal",
+                    max_group_wait=ReadableDuration.from_millis(wait_ms),
+                    flush_rows=1 << 30, flush_bytes=1 << 40,
+                    flush_age=ReadableDuration.parse("1h"),
+                    flush_interval=ReadableDuration.parse("1h"))
+                s = await IngestStorage.open(inner, wc.dir, wc)
+                rate, p99 = await drive(s, n_writes)
+                # the final flush drains outside the timed region
+                await s.close()
+            finally:
+                shutil.rmtree(tmp, ignore_errors=True)
+            _log(f"config8 wal group_wait={wait_ms}ms: {rate:.0f} acked "
+                 f"writes/s (p99 ack {p99:.2f} ms, {n_writes} writes)")
+            variants[f"group_wait_{wait_ms}ms"] = {
+                "writes_per_s": round(rate, 1),
+                "p99_ack_ms": round(p99, 3)}
+            if best is None or rate > best[0]:
+                best = (rate, p99, wait_ms)
+        out["variants"] = variants
+        out["best_group_wait_ms"] = best[2]
+        out["p99_ack_ms"] = round(best[1], 3)
+        out["writes"] = n_writes
+        out["concurrency"] = concurrency
+        return out, best[0]
+
+    out, wal_rate = asyncio.run(bench())
+    return {
+        "metric": (f"durable ingest: acked writes/s at batch size 1, "
+                   f"WAL group commit vs one-SST-per-write, "
+                   f"{concurrency} writers"),
+        "value": round(wal_rate, 1),
+        "unit": "writes/s",
+        # higher is better for THIS config (throughput multiple)
+        "vs_baseline": round(wal_rate / out["baseline_writes_per_s"], 2),
+        **out,
+    }
+
+
 RUNNERS = {2: run_config2, 3: run_config3, 4: run_config4, 5: run_config5,
-           6: run_config6, 7: run_config7}
+           6: run_config6, 7: run_config7, 8: run_config8}
 
 
 def main() -> None:
     parser = argparse.ArgumentParser("horaedb-tpu bench suite")
-    parser.add_argument("--config", type=int, required=True, choices=[2, 3, 4, 5, 6, 7])
+    parser.add_argument("--config", type=int, required=True,
+                        choices=sorted(RUNNERS))
     parser.add_argument("--rows", type=int, default=2_000_000)
     parser.add_argument("--iters", type=int, default=10)
     args = parser.parse_args()

@@ -47,8 +47,14 @@ class Runtimes:
             self._pools[pool], functools.partial(fn, *args, **kwargs))
 
     def close(self) -> None:
+        # wait=True is load-bearing: shutdown(wait=False) leaves an
+        # in-flight parquet encode/merge running on the worker thread
+        # AFTER the owner tears down the engine — the job then races
+        # object teardown and corrupts the heap (observed as later
+        # segfaults/aborts inside pyarrow).  Queued-but-unstarted jobs
+        # are cancelled; the bounded in-flight ones finish first.
         for pool in self._pools.values():
-            pool.shutdown(wait=False, cancel_futures=False)
+            pool.shutdown(wait=True, cancel_futures=True)
 
 
 def from_config(threads) -> Runtimes:

@@ -485,7 +485,8 @@ class MetricEngine:
                    segment_ms: int = 2 * 3600 * 1000,
                    config: Optional[StorageConfig] = None,
                    chunked_data: bool = False,
-                   chunk_window_ms: int = 30 * 60 * 1000) -> "MetricEngine":
+                   chunk_window_ms: int = 30 * 60 * 1000,
+                   wal_config=None) -> "MetricEngine":
         import dataclasses
 
         if chunked_data:
@@ -507,6 +508,11 @@ class MetricEngine:
         # reference's StorageRuntimes are likewise engine-wide
         shared_runtimes = runtimes_mod.from_config(
             (config or StorageConfig()).threads)
+        wal_on = wal_config is not None and wal_config.enabled
+        if wal_on:
+            ensure(wal_config.dir,
+                   "[wal] enabled requires wal.dir (or a Local object "
+                   "store the server can derive it from)")
         try:
             for name, (schema, num_pks) in schemas.items():
                 cfg = config or StorageConfig()
@@ -515,9 +521,29 @@ class MetricEngine:
 
                     cfg = dataclasses.replace(cfg,
                                               update_mode=UpdateMode.APPEND)
-                tables[name] = await CloudObjectStorage.open(
+                table = await CloudObjectStorage.open(
                     f"{root_path}/{name}", segment_ms, store, schema,
                     num_pks, cfg, runtimes=shared_runtimes)
+                tables[name] = table
+                if wal_on:
+                    from horaedb_tpu.storage.config import UpdateMode
+                    from horaedb_tpu.wal import IngestStorage
+
+                    if table.schema().update_mode is UpdateMode.OVERWRITE:
+                        import os
+
+                        tables[name] = await IngestStorage.open(
+                            table, os.path.join(wal_config.dir, name),
+                            wal_config)
+                    else:
+                        # Append tables (the chunked data layout) have
+                        # no __seq__ dedup, so replay could duplicate
+                        # rows — they keep the direct write path
+                        import logging as _logging
+
+                        _logging.getLogger(__name__).info(
+                            "wal: table %r is Append-mode; ingest WAL "
+                            "skipped", name)
         except BaseException:
             # close whatever opened so a failed open leaks neither
             # schedulers nor worker pools
@@ -538,9 +564,14 @@ class MetricEngine:
 
     async def stats(self) -> dict:
         """Data volume actually stored (rows/bytes per table, from the
-        manifests) — the cluster's rebalancing load signal."""
+        manifests) plus the ingest plane's buffered state (memtables +
+        WAL backlog) — the cluster's rebalancing load signal and the
+        operator's durability dashboard."""
         tables = {}
-        rows = size = 0
+        rows = size = sst_count = 0
+        mem_rows = mem_bytes = wal_backlog = 0
+        last_flush_age = None
+        wal_enabled = False
         for name, t in self.tables.items():
             ssts = await t.manifest.all_ssts()
             t_rows = sum(f.meta.num_rows for f in ssts)
@@ -549,7 +580,37 @@ class MetricEngine:
                             "bytes": t_size}
             rows += t_rows
             size += t_size
-        return {"rows": rows, "bytes": size, "tables": tables}
+            sst_count += len(ssts)
+            ingest = getattr(t, "ingest_stats", None)
+            if ingest is not None:
+                wal_enabled = True
+                ing = ingest()
+                tables[name]["ingest"] = ing
+                mem_rows += ing["memtable_rows"]
+                mem_bytes += ing["memtable_bytes"]
+                wal_backlog += ing["wal_backlog_bytes"]
+                age = ing["last_flush_age_s"]
+                if age is not None and (last_flush_age is None
+                                        or age > last_flush_age):
+                    last_flush_age = age  # the most stale table
+        out = {"rows": rows, "bytes": size, "ssts": sst_count,
+               "tables": tables}
+        if wal_enabled:
+            out["memtable_rows"] = mem_rows
+            out["memtable_bytes"] = mem_bytes
+            out["wal_backlog_bytes"] = wal_backlog
+            out["last_flush_age_s"] = last_flush_age
+        return out
+
+    async def flush(self) -> dict:
+        """Force-drain every WAL-fronted table's memtables to SSTs
+        (POST /admin/flush).  Returns rows flushed per table."""
+        out = {}
+        for name, t in self.tables.items():
+            flush_all = getattr(t, "flush_all", None)
+            if flush_all is not None:
+                out[name] = {"flushed_rows": await flush_all()}
+        return out
 
     # ---- write ------------------------------------------------------------
 
@@ -702,17 +763,32 @@ class MetricEngine:
                     out,
                     TimeRange.new(int(seg_ts.min()), int(seg_ts.max()) + 1)))
 
-        try:
-            async with asyncio.TaskGroup() as tg:
-                for seg in np.unique(seg_ids):
-                    tg.create_task(write_segment(int(seg)))
-        except ExceptionGroup as eg:
-            # preserve the pre-TaskGroup error surface: callers catching
-            # concrete types (Error, pa.ArrowInvalid, OSError, ...) must
-            # not be handed an ExceptionGroup.  A plain `except` (not
-            # except*) so mixed-type failures still collapse to ONE
-            # exception instead of re-combining into a group.
-            raise eg.exceptions[0]
+        if hasattr(asyncio, "TaskGroup"):  # py3.11+
+            try:
+                async with asyncio.TaskGroup() as tg:
+                    for seg in np.unique(seg_ids):
+                        tg.create_task(write_segment(int(seg)))
+            except BaseException as eg:
+                # preserve the pre-TaskGroup error surface: callers
+                # catching concrete types (Error, pa.ArrowInvalid,
+                # OSError, ...) must not be handed an ExceptionGroup;
+                # mixed-type failures still collapse to ONE exception
+                # instead of re-combining into a group.
+                if hasattr(eg, "exceptions"):
+                    raise eg.exceptions[0]
+                raise
+        else:
+            # py3.10: no TaskGroup/ExceptionGroup.  gather with
+            # return_exceptions settles EVERY sibling before the first
+            # failure propagates — the same no-write-still-running
+            # guarantee (leaking an in-flight parquet encode past the
+            # caller corrupts later work on the shared pools).
+            tasks = [asyncio.ensure_future(write_segment(int(seg)))
+                     for seg in np.unique(seg_ids)]
+            results = await asyncio.gather(*tasks, return_exceptions=True)
+            for r in results:
+                if isinstance(r, BaseException):
+                    raise r
 
     async def _write_arrow_chunked(self, mid, fid, codes, tsid_of_code,
                                    ts_np, val_np) -> None:

@@ -22,7 +22,15 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+
+try:
+    from jax import shard_map
+except ImportError:  # older jax: pre-promotion experimental namespace
+    from jax.experimental.shard_map import shard_map as _shard_map_compat
+
+    def shard_map(f, *, check_vma=True, **kw):
+        # the experimental API spells replication checking `check_rep`
+        return _shard_map_compat(f, check_rep=check_vma, **kw)
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from horaedb_tpu.common.error import Error

@@ -20,6 +20,7 @@ from horaedb_tpu.common import Error, ReadableDuration, ensure
 from horaedb_tpu.cluster.breaker import BreakerConfig
 from horaedb_tpu.storage.config import StorageConfig, _check_scalar
 from horaedb_tpu.storage.config import from_dict as storage_from_dict
+from horaedb_tpu.wal.config import WalConfig
 
 
 @dataclass
@@ -104,6 +105,9 @@ class ServerConfig:
     # circuit breaker / RPC policy for a cluster-backed server's
     # scatter-gather plane (applied when the served engine is a Cluster)
     breaker: BreakerConfig = field(default_factory=BreakerConfig)
+    # durable ingest: WAL + memtable front end (wal/ingest.py); with an
+    # empty dir and a Local object store, `<data_dir>/wal` is derived
+    wal: WalConfig = field(default_factory=WalConfig)
     metric_engine: MetricEngineConfig = field(default_factory=MetricEngineConfig)
 
 
@@ -137,6 +141,9 @@ def _dc_from_dict(cls: type, data: dict[str, Any]) -> Any:
         elif key == "breaker":
             ensure(isinstance(value, dict), f"{where} expects a config table")
             kwargs[key] = _dc_from_dict(BreakerConfig, value)
+        elif key == "wal":
+            ensure(isinstance(value, dict), f"{where} expects a config table")
+            kwargs[key] = _dc_from_dict(WalConfig, value)
         elif key == "metric_engine":
             ensure(isinstance(value, dict), f"{where} expects a config table")
             kwargs[key] = _dc_from_dict(MetricEngineConfig, value)
@@ -158,7 +165,13 @@ def _dc_from_dict(cls: type, data: dict[str, Any]) -> Any:
 def load_config(path: Optional[str] = None) -> ServerConfig:
     if path is None:
         return ServerConfig()
-    import tomllib
+    try:
+        import tomllib  # stdlib on py3.11+
+    except ModuleNotFoundError:
+        # py3.10: tomllib IS tomli, and pip always vendors tomli — use
+        # it rather than making config files unloadable (no installs
+        # available in the deployment image)
+        from pip._vendor import tomli as tomllib
 
     with open(path, "rb") as f:
         data = tomllib.load(f)
@@ -173,4 +186,10 @@ def load_config(path: Optional[str] = None) -> ServerConfig:
                and s3.key_id and s3.key_secret,
                "S3Like object store requires [metric_engine.object_store.s3] "
                "with endpoint, bucket, key_id, and key_secret")
+    if cfg.wal.enabled and not cfg.wal.dir:
+        # the WAL lives on local disk beside the object-store root; a
+        # remote store has no local root to derive it from
+        ensure(kind == "Local",
+               "[wal] with an empty dir requires a Local object store "
+               "(it derives <data_dir>/wal); set wal.dir explicitly")
     return cfg

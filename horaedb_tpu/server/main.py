@@ -386,9 +386,26 @@ def build_app(state: ServerState) -> web.Application:
 
     @routes.get("/stats")
     async def stats(_req: web.Request) -> web.Response:
-        # data-volume load signal for cluster rebalancing (rows/bytes
-        # per table from the manifests)
+        # data-volume load signal for cluster rebalancing (rows/bytes/
+        # SSTs per table from the manifests) + the ingest plane's
+        # buffered state (memtable rows/bytes, WAL backlog, flush age)
         return web.json_response(await state.engine.stats())
+
+    @routes.post("/admin/flush")
+    async def admin_flush(_req: web.Request) -> web.Response:
+        """Force-drain every WAL-fronted memtable to SSTs now (and
+        advance WAL truncation).  No-op tables report nothing; a
+        cluster-front server has no local tables to flush."""
+        flush = getattr(state.engine, "flush", None)
+        if flush is None:
+            return web.json_response(
+                {"error": "flush is a per-node operation; this server "
+                          "fronts a cluster — flush each region's own "
+                          "server"}, status=501)
+        try:
+            return web.json_response(await flush())
+        except Error as e:
+            return _error_response(e)
 
     @routes.post("/write")
     async def write(req: web.Request) -> web.Response:
@@ -690,13 +707,25 @@ def _build_store(config: ServerConfig):
 
 async def run_server(config: ServerConfig,
                      ready: Optional[asyncio.Event] = None) -> None:
+    import dataclasses
+    import os
+
     store = _build_store(config)
+    wal_config = config.wal
+    if wal_config.enabled and not wal_config.dir:
+        # the WAL lives beside the Local object-store root (load_config
+        # rejects empty-dir WAL on remote stores)
+        wal_config = dataclasses.replace(
+            wal_config,
+            dir=os.path.join(config.metric_engine.object_store.data_dir,
+                             "wal"))
     engine = await MetricEngine.open(
         "metrics", store,
         segment_ms=config.metric_engine.segment_duration.millis,
         config=config.metric_engine.time_merge_storage,
         chunked_data=config.metric_engine.chunked_data,
-        chunk_window_ms=config.metric_engine.chunk_window.millis)
+        chunk_window_ms=config.metric_engine.chunk_window.millis,
+        wal_config=wal_config)
     state = ServerState(engine, config)
     if config.test.enable_write:
         state.start_generators()

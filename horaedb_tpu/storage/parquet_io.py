@@ -160,6 +160,28 @@ async def write_sst_streaming(store: ObjectStore, path: str, batches,
     async def chunks():
         nonlocal rows
         closed = False
+        pending = None  # the in-flight pool job using `writer`
+
+        async def run_writer(fn, *args, **kwargs):
+            # shielded so a CANCELLED caller leaves `pending` visible:
+            # the pool job keeps executing after cancellation, and the
+            # finally below must wait it out before touching the writer
+            # — ParquetWriter is not thread-safe, and closing it while
+            # write_batch runs on a pool thread corrupts the heap
+            # (observed as intermittent SIGSEGV/SIGABRT under the
+            # concurrency stress when scheduler.stop() cancels a
+            # compaction mid-row-group).
+            nonlocal pending
+            import asyncio
+
+            pending = asyncio.ensure_future(
+                _run(runtimes, pool, fn, *args, **kwargs))
+            try:
+                return await asyncio.shield(pending)
+            finally:
+                if pending.done():
+                    pending = None
+
         try:
             async for batch in batches:
                 rows += batch.num_rows
@@ -168,18 +190,22 @@ async def write_sst_streaming(store: ObjectStore, path: str, batches,
                 # batch must not accumulate in the sink
                 step = max(1, config.max_row_group_size)
                 for off in range(0, batch.num_rows, step):
-                    await _run(runtimes, pool, writer.write_batch,
-                               batch.slice(off, step),
-                               row_group_size=step)
+                    await run_writer(writer.write_batch,
+                                     batch.slice(off, step),
+                                     row_group_size=step)
                     data = sink.drain()
                     if data:
                         yield data
-            await _run(runtimes, pool, writer.close)
+            await run_writer(writer.close)
             closed = True
             tail = sink.drain()
             if tail:
                 yield tail
         finally:
+            if pending is not None and not pending.done():
+                import asyncio
+
+                await asyncio.gather(pending, return_exceptions=True)
             if not closed:
                 writer.close()
 

@@ -349,16 +349,7 @@ class ParquetReader:
     def build_plan(self, ssts: list[SstFile], request: ScanRequest,
                    keep_builtin: bool = False,
                    use_cache: bool = True, pool: str = "sst") -> ScanPlan:
-        projections = self.schema.fill_required_projections(request.projections)
-        if projections is None:
-            columns = list(self.schema.arrow_schema.names)
-        else:
-            columns = [self.schema.arrow_schema.names[i] for i in projections]
-        # __reserved__ is never read (all-null, unused); __seq__ must be
-        # read for dedup even when it will be stripped from the output.
-        columns = [c for c in columns if c != RESERVED_COLUMN_NAME]
-        if SEQ_COLUMN_NAME not in columns:
-            columns.append(SEQ_COLUMN_NAME)
+        columns = plan_columns(self.schema, request.projections)
 
         by_segment: dict[int, list[SstFile]] = {}
         for f in ssts:
@@ -2851,6 +2842,79 @@ def _eval_predicate_host(pred, batch: pa.RecordBatch) -> np.ndarray:
         return ~_eval_predicate_host(pred.child, batch)
     col = batch.column(batch.schema.names.index(pred.column))
     return F.leaf_mask_host(pred, col.to_numpy(zero_copy_only=False))
+
+
+def plan_columns(schema: StorageSchema,
+                 projections: Optional[list[int]]) -> list[str]:
+    """THE column set a merge plan reads for a projection — shared by
+    build_plan and the memtable-overlay path (wal/ingest.py) so hybrid
+    and pure-SST scans cannot disagree on shape."""
+    proj = schema.fill_required_projections(projections)
+    if proj is None:
+        columns = list(schema.arrow_schema.names)
+    else:
+        columns = [schema.arrow_schema.names[i] for i in proj]
+    # __reserved__ is never read (all-null, unused); __seq__ must be
+    # read for dedup even when it will be stripped from the output.
+    columns = [c for c in columns if c != RESERVED_COLUMN_NAME]
+    if SEQ_COLUMN_NAME not in columns:
+        columns.append(SEQ_COLUMN_NAME)
+    return columns
+
+
+def merge_memtable_overlay(schema: StorageSchema,
+                           sst_parts: list[pa.RecordBatch],
+                           mem_batches: list[pa.RecordBatch],
+                           predicate,
+                           columns: list[str],
+                           keep_builtin: bool) -> Optional[pa.RecordBatch]:
+    """Host merge of ONE segment's already-merged SST rows with its
+    memtable overlay — the hybrid scan's last stage (wal/ingest.py).
+
+    Both sources carry per-row `__seq__` (sst_parts from a
+    keep_builtin plan, mem_batches stamped with each entry's write
+    seq), so OVERWRITE's last-value rule is one sort by (PK, __seq__)
+    keeping the final row of every PK run.  The full predicate applies
+    AFTER dedup, matching the pure-SST path (value-column leaves can
+    interact with last-value dedup, so filtering first would resurrect
+    overwritten rows); the caller therefore scans overlay segments
+    without a predicate.  Ordering invariant: seqs are preserved end to
+    end, so a replayed memtable row and its flushed SST twin tie on
+    (PK, seq) with identical values — either winning is exactly-once.
+    """
+    import pyarrow.compute as pc
+
+    from horaedb_tpu.storage.operator import LastValueOperator
+
+    target = pa.schema([schema.arrow_schema.field(
+        schema.arrow_schema.names.index(c)) for c in columns])
+    parts = []
+    for b in list(sst_parts) + list(mem_batches):
+        if b.num_rows == 0:
+            continue
+        b = b.select(columns)
+        if not b.schema.equals(target):
+            b = b.cast(target)
+        parts.append(b)
+    if not parts:
+        return None
+    table = pa.Table.from_batches(parts, schema=target)
+    sort_keys = [(n, "ascending") for n in schema.primary_key_names]
+    sort_keys.append((SEQ_COLUMN_NAME, "ascending"))
+    table = table.take(pc.sort_indices(table, sort_keys=sort_keys))
+    batch = table.combine_chunks().to_batches()[0]
+    # keep-last-of-PK-run is THE LastValue rule — reuse the operator
+    # (native run-detection kernel included) so overlay and SST merges
+    # cannot drift
+    pk_indices = [columns.index(n) for n in schema.primary_key_names]
+    batch = LastValueOperator().merge_sorted_batch(batch, pk_indices)
+    if predicate is not None and batch.num_rows:
+        mask = _eval_predicate_host(predicate, batch)
+        batch = batch.take(np.flatnonzero(mask))
+    if not keep_builtin:
+        batch = batch.select([c for c in batch.schema.names
+                              if not StorageSchema.is_builtin_name(c)])
+    return batch
 
 
 def describe_plan(plan: ScanPlan) -> str:

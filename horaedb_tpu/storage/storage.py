@@ -191,7 +191,9 @@ class CloudObjectStorage(TimeMergeStorage):
         keys = [(n, "ascending") for n in self._schema.primary_key_names]
         return batch.take(pc.sort_indices(batch, sort_keys=keys))
 
-    async def write(self, req: WriteRequest) -> WriteResult:
+    def validate_write(self, req: WriteRequest) -> None:
+        """All write-path invariants, split out so the WAL ingest front
+        end (wal/ingest.py) rejects a bad batch BEFORE logging it."""
         ensure(self.manifest is not None, "storage not opened")
         ensure(req.batch.schema.equals(self._schema.user_schema),
                "write batch schema mismatch")
@@ -207,6 +209,9 @@ class CloudObjectStorage(TimeMergeStorage):
                 self.segment_duration_ms)
             ensure(start_seg == end_seg,
                    f"write batch crosses segment boundary: {req.time_range}")
+
+    async def write(self, req: WriteRequest) -> WriteResult:
+        self.validate_write(req)
         return await self._write_batch(req)
 
     async def _write_batch(self, req: WriteRequest) -> WriteResult:
@@ -219,21 +224,55 @@ class CloudObjectStorage(TimeMergeStorage):
                                                      sequence=file_id)
 
         stamped = await self.runtimes.run("sst", prep)
+        result = await self._persist_stamped(file_id, stamped,
+                                             req.time_range)
+        _WRITE_LATENCY.observe(time.perf_counter() - t0)
+        return result
+
+    async def write_stamped(self, table: pa.Table,
+                            time_range: TimeRange) -> WriteResult:
+        """Memtable-flush write path (wal/ingest.py): rows arrive with
+        `__seq__` already filled per row (each entry's original write
+        seq).  Seqs are PRESERVED — restamping would let a flush racing
+        a concurrent write elevate old rows above a newer seq — so the
+        SST is sorted by (PK, __seq__) and dedup keeps working off the
+        original write order, exactly like a compaction output (which
+        also carries heterogeneous per-row seqs).
+        """
+        ensure(self.manifest is not None, "storage not opened")
+        ensure(table.schema.names == self._schema.arrow_schema.names,
+               "write_stamped expects the full stamped schema")
+        file_id = SstFile.allocate_id()
+
+        def prep():
+            keys = [(n, "ascending") for n in self._schema.primary_key_names]
+            keys.append((self._schema.arrow_schema.names[self._schema.seq_idx],
+                         "ascending"))
+            ordered = table.take(pc.sort_indices(table, sort_keys=keys))
+            return ordered.combine_chunks().to_batches()[0]
+
+        stamped = await self.runtimes.run("sst", prep)
+        return await self._persist_stamped(file_id, stamped, time_range)
+
+    async def _persist_stamped(self, file_id: int, stamped: pa.RecordBatch,
+                               time_range: TimeRange) -> WriteResult:
+        """THE persist tail shared by the direct write path and the WAL
+        flush path (write_stamped): SST put overlapped with the sidecar
+        put, which completes BEFORE the manifest add — readers never
+        see a manifest-listed SST whose sidecar is still in flight, so
+        a sidecar miss is permanent per id (the reader memoizes misses
+        on that contract).  max_sequence tracks the file id: the
+        snapshot codec reconstructs it as the id anyway."""
         path = sst_path(self.root_path, file_id)
-        # the sidecar put overlaps the SST put and completes BEFORE the
-        # manifest add: readers never see a manifest-listed SST whose
-        # sidecar is still in flight, so a sidecar miss is permanent
-        # per id (the reader memoizes misses on that contract)
         size, _ = await asyncio.gather(
             parquet_io.write_sst(self.store, path, [stamped],
                                  self.config.write, self._schema,
                                  runtimes=self.runtimes),
             self._write_sidecar(file_id, stamped))
-        meta = FileMeta(max_sequence=file_id, num_rows=req.batch.num_rows,
-                        size=size, time_range=req.time_range)
+        meta = FileMeta(max_sequence=file_id, num_rows=stamped.num_rows,
+                        size=size, time_range=time_range)
         await self.manifest.add_file(file_id, meta)
-        _WRITE_LATENCY.observe(time.perf_counter() - t0)
-        _ROWS_WRITTEN.inc(req.batch.num_rows)
+        _ROWS_WRITTEN.inc(stamped.num_rows)
         return WriteResult(id=file_id, seq=file_id, size=size)
 
     async def _write_sidecar(self, file_id: int,
@@ -261,17 +300,37 @@ class CloudObjectStorage(TimeMergeStorage):
     _SCAN_RETRIES = 3
 
     async def scan(self, req: ScanRequest,
-                   first_plan: Optional[ScanPlan] = None
-                   ) -> AsyncIterator[pa.RecordBatch]:
+                   first_plan: Optional[ScanPlan] = None,
+                   keep_builtin: bool = False,
+                   segment_filter=None) -> AsyncIterator[pa.RecordBatch]:
+        async for _seg, batch in self.scan_segments(
+                req, first_plan=first_plan, keep_builtin=keep_builtin,
+                segment_filter=segment_filter):
+            if batch is not None:
+                yield batch
+
+    async def scan_segments(self, req: ScanRequest,
+                            first_plan: Optional[ScanPlan] = None,
+                            keep_builtin: bool = False,
+                            segment_filter=None):
+        """scan() with segment attribution: yields (segment_start,
+        batch) parts plus a (segment_start, None) completion marker per
+        segment — the hybrid WAL scan (wal/ingest.py) overlays memtable
+        rows per segment and needs to know when one is complete.
+        `segment_filter(segment_start) -> bool` restricts the scan to a
+        stable subset across compaction-race replans."""
         done: set[int] = set()
         for attempt in range(self._SCAN_RETRIES + 1):
             # attempt 0 may reuse a caller-built plan (plan_query):
             # one manifest lookup per query; a stale plan just races
             # into the NotFoundError replan below like any other scan
             plan = (first_plan if attempt == 0 and first_plan is not None
-                    else await self.build_scan_plan(req))
+                    else await self.build_scan_plan(
+                        req, keep_builtin=keep_builtin))
             plan.segments = [s for s in plan.segments
-                             if s.segment_start not in done]
+                             if s.segment_start not in done
+                             and (segment_filter is None
+                                  or segment_filter(s.segment_start))]
             try:
                 async for seg_start, batch in self.reader.execute_segments(plan):
                     if batch is None:
@@ -279,8 +338,7 @@ class CloudObjectStorage(TimeMergeStorage):
                         # segment retry-safe to skip (it may have
                         # spanned several window batches)
                         done.add(seg_start)
-                    else:
-                        yield batch
+                    yield seg_start, batch
                 return
             except NotFoundError:
                 if attempt == self._SCAN_RETRIES:

@@ -167,13 +167,14 @@ class IngestStorage(TimeMergeStorage):
 
     # ---- write ------------------------------------------------------------
 
-    def _insert(self, seq: int, batch: pa.RecordBatch, time_range) -> None:
+    def _insert(self, seq: int, batch: pa.RecordBatch, time_range) -> int:
         seg = int(time_range.start.truncate_by(
             self.inner.segment_duration_ms))
         mt = self._memtables.get(seg)
         if mt is None:
             mt = self._memtables[seg] = Memtable(seg, self._clock())
         mt.add(MemEntry(seq=seq, batch=batch, time_range=time_range))
+        return seg
 
     async def write(self, req: WriteRequest) -> WriteResult:
         self.inner.validate_write(req)
@@ -181,19 +182,19 @@ class IngestStorage(TimeMergeStorage):
         seq = SstFile.allocate_id()
         size = await self.wal.append(seq, req.time_range, req.batch)
         # the fsync ack point: the rows are durable from here on
-        self._insert(seq, req.batch, req.time_range)
-        self._maybe_wake_flusher()
+        seg = self._insert(seq, req.batch, req.time_range)
+        self._maybe_wake_flusher(self._memtables.get(seg))
         _ACK_LATENCY.observe(time.perf_counter() - t0)
         return WriteResult(id=seq, seq=seq, size=size)
 
-    def _maybe_wake_flusher(self) -> None:
-        if self._flush_wake is None:
+    def _maybe_wake_flusher(self, mt: Optional[Memtable]) -> None:
+        """O(1) on the ack hot path: only the memtable the write just
+        landed in can have newly crossed a threshold."""
+        if self._flush_wake is None or mt is None:
             return
         cfg = self.config
-        for mt in self._memtables.values():
-            if mt.rows >= cfg.flush_rows or mt.bytes >= cfg.flush_bytes:
-                self._flush_wake.set()
-                return
+        if mt.rows >= cfg.flush_rows or mt.bytes >= cfg.flush_bytes:
+            self._flush_wake.set()
 
     # ---- flush ------------------------------------------------------------
 
@@ -244,6 +245,15 @@ class IngestStorage(TimeMergeStorage):
                     and not rng.overlaps(time_range):
                 continue
             flushed += await self._flush_segment(seg)
+        if any(self._flushing.values()):
+            # barrier: a background flush already in flight popped its
+            # memtable before we looked — its SST + manifest commit
+            # must land before callers replan from the manifest, or an
+            # aggregate would silently omit acked rows.  _flush_segment
+            # holds _flush_lock for its whole duration, so acquiring it
+            # once waits the in-flight flush out.
+            async with self._flush_lock:
+                pass
         return flushed
 
     async def _flush_segment(self, seg: int) -> int:
@@ -334,9 +344,21 @@ class IngestStorage(TimeMergeStorage):
                 segment_filter=lambda s: s not in mem_segs
                 and (segment_filter is None or segment_filter(s))):
             yield b
-        # overlay segments: read WITHOUT the predicate (it must apply
-        # after the cross-source dedup) and with builtins kept
-        hybrid_req = ScanRequest(range=req.range, predicate=None,
+        # overlay segments: value-column leaves must apply AFTER the
+        # cross-source dedup (filtering first would resurrect
+        # overwritten rows), but the PK-only conjunct subtree drops
+        # whole PK groups and commutes with last-value dedup — keep its
+        # pushdown so the active segment's hybrid reads stay pruned.
+        # The full predicate still applies post-dedup in the overlay
+        # merge (mem rows of dropped groups fall to the same leaves).
+        from horaedb_tpu.ops import And
+        from horaedb_tpu.storage import parquet_io
+
+        pk_leaves, _ = parquet_io.conjunct_leaves_ex(
+            req.predicate, set(schema.primary_key_names))
+        pk_pred = (None if not pk_leaves else
+                   pk_leaves[0] if len(pk_leaves) == 1 else And(pk_leaves))
+        hybrid_req = ScanRequest(range=req.range, predicate=pk_pred,
                                  projections=req.projections)
         columns = plan_columns(schema, req.projections)
         buffered: dict[int, list] = {}

@@ -331,35 +331,36 @@ class Wal:
         os.fsync(f.fileno())
         self._op("acked")
 
-    async def _quarantine_active(self) -> None:
-        """Seal the active segment after a failed group write: its
-        intact prefix (every previously-fsynced record) stays
-        replayable and truncatable, and no future append lands past a
-        possibly-torn tail frame."""
+    def _seal_active(self):
+        """Shared quarantine bookkeeping after a failed group write:
+        seal the active segment so its intact prefix (every previously-
+        fsynced record) stays replayable and truncatable, and no future
+        append lands past a possibly-torn tail frame.  Returns the file
+        handle for the caller to close (awaited or direct)."""
         if self._active is None:
-            return
+            return None
         seg, f = self._active, self._active_file
         self._active = None
         self._active_file = None
         self._sealed[seg.id] = seg
-        try:
-            await self._run_blocking(f.close)
-        except OSError:
-            pass
+        return f
+
+    async def _quarantine_active(self) -> None:
+        f = self._seal_active()
+        if f is not None:
+            try:
+                await self._run_blocking(f.close)
+            except OSError:
+                pass
 
     def _quarantine_active_nowait(self) -> None:
-        """Cancellation-path twin (cannot await): same sealing, with a
-        direct file close."""
-        if self._active is None:
-            return
-        seg, f = self._active, self._active_file
-        self._active = None
-        self._active_file = None
-        self._sealed[seg.id] = seg
-        try:
-            f.close()
-        except OSError:
-            pass
+        """Cancellation-path twin (cannot await mid-unwind)."""
+        f = self._seal_active()
+        if f is not None:
+            try:
+                f.close()
+            except OSError:
+                pass
 
     async def _rotate(self) -> None:
         """Seal the active segment and open a fresh one (the new file

@@ -34,6 +34,16 @@ class MemEntry:
     seq: int
     batch: pa.RecordBatch  # user schema
     time_range: TimeRange
+    # stamped (full-schema, seq-filled) twin, built lazily ONCE — the
+    # hybrid scan snapshots every entry per query and the bytes never
+    # change
+    _stamped: Optional[pa.RecordBatch] = None
+
+    def stamped(self, schema: StorageSchema) -> pa.RecordBatch:
+        if self._stamped is None:
+            self._stamped = schema.fill_builtin_columns(self.batch,
+                                                        self.seq)
+        return self._stamped
 
 
 class Memtable:
@@ -81,14 +91,14 @@ class Memtable:
                     scan_range):
                 continue
             if e.batch.num_rows:
-                out.append(schema.fill_builtin_columns(e.batch, e.seq))
+                out.append(e.stamped(schema))
         return out
 
     def drain(self, schema: StorageSchema):
         """(stamped concatenated table, union range, seqs) for the
         flusher — per-row seqs preserved; the SST write sorts by
         (PK, __seq__) so equal-PK runs stay in last-value order."""
-        stamped = [schema.fill_builtin_columns(e.batch, e.seq)
+        stamped = [e.stamped(schema)
                    for e in self.entries if e.batch.num_rows]
         if not stamped:
             return None, None, self.seqs
