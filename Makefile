@@ -15,12 +15,18 @@ TORTURE_SCHEDULES ?= 200
 WAL_TORTURE_SEED ?= 1337
 WAL_TORTURE_SCHEDULES ?= 120
 
+SCANCACHE_SEED ?= 1337
+SCANCACHE_SCHEDULES ?= 40
+
 chaos:
 	TORTURE_SEED=$(TORTURE_SEED) TORTURE_SCHEDULES=$(TORTURE_SCHEDULES) \
 	WAL_TORTURE_SEED=$(WAL_TORTURE_SEED) \
 	WAL_TORTURE_SCHEDULES=$(WAL_TORTURE_SCHEDULES) \
+	SCANCACHE_SEED=$(SCANCACHE_SEED) \
+	SCANCACHE_SCHEDULES=$(SCANCACHE_SCHEDULES) \
 	python -m pytest tests/test_fault_injection.py tests/test_torture.py \
-	tests/test_objstore_middleware.py tests/test_wal.py -q
+	tests/test_objstore_middleware.py tests/test_wal.py \
+	tests/test_scan_cache.py -q
 
 # stdlib AST lint gate (the reference CI runs fmt+clippy -D warnings;
 # this image ships no ruff/flake8, so the gate is tools/lint.py)

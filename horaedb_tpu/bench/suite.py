@@ -49,6 +49,16 @@ def provenance() -> dict:
             or platform == "cpu"}
 
 
+def _clear_scan_tiers(table) -> None:
+    """TRUE-cold reset for engine legs: drop tier-1 HBM windows AND
+    tier-2 host-RAM encoded parts — write-through admission would
+    otherwise serve a 'cold' query from RAM and the leg would silently
+    measure the tier-2 path instead (config 9 measures the tiers
+    explicitly)."""
+    table.reader.scan_cache.clear()
+    table.reader.encoded_cache.clear()
+
+
 def _p50(fn, iters: int) -> float:
     times = []
     for _ in range(iters):
@@ -222,9 +232,17 @@ def _config2_engine_point(rows: int) -> dict:
         import glob
         import os
 
+        from horaedb_tpu.storage.config import StorageConfig, from_dict
+
         with tempfile.TemporaryDirectory() as root:
+            # tier-2 off: this leg meters how many sidecar BYTES cross
+            # the store boundary (block pruning) — the encoded cache
+            # would serve them from RAM and zero the metric (config 9
+            # measures the cache tiers themselves)
+            cfg = from_dict(StorageConfig, {
+                "scan": {"cache": {"tier2_max_bytes": 0}}})
             e = await MetricEngine.open("cfg2", MeteredStore(root),
-                                        segment_ms=segment_ms)
+                                        segment_ms=segment_ms, config=cfg)
             try:
                 await e.write_arrow("cpu", ["host"], pa.record_batch({
                     "host": pa.DictionaryArray.from_arrays(
@@ -400,7 +418,7 @@ def _config3_engine_multifield(rows: int, cfg, bucket: int) -> dict:
                     field=CPU_FIELDS[f])
             rng_q = TimeRange.new(ecfg.start_ms,
                                   ecfg.start_ms + ecfg.span_ms)
-            e.tables["data"].reader.scan_cache.clear()
+            _clear_scan_tiers(e.tables["data"])
             t0 = _t.perf_counter()
             multi = await e.query_downsample_multi(
                 "cpu", [], rng_q, bucket_ms=bucket,
@@ -428,7 +446,7 @@ def _config3_engine_multifield(rows: int, cfg, bucket: int) -> dict:
                                    scols[CPU_FIELDS[0]]))
             rng_q = TimeRange.new(scfg.start_ms,
                                   scfg.start_ms + scfg.span_ms)
-            e.tables["data"].reader.scan_cache.clear()
+            _clear_scan_tiers(e.tables["data"])
             t0 = _t.perf_counter()
             out = await e.query_downsample("cpu", [], rng_q,
                                            bucket_ms=bucket, aggs=("avg",))
@@ -1068,8 +1086,267 @@ def run_config8(rows: int, iters: int) -> dict:
     }
 
 
+# ---------------------------------------------------------------------------
+# config 9: tiered scan cache — post-flush / HBM-evicted / true-cold
+# ---------------------------------------------------------------------------
+
+
+def run_config9(rows: int, iters: int) -> dict:
+    """The cold-scan tier ladder: ONE downsample workload measured at
+    every cache tier of the read path.
+
+      cached      tier-1 hit (HBM-resident post-merge windows)
+      post_flush  a WAL flush just changed one segment's SST set —
+                  tier-1 misses that segment, tier-2 + write-through
+                  admission rebuild it without any object-store read
+      tier2_cold  tier-1 fully evicted, tier-2 (host-RAM encoded
+                  parts) warm — the restart-adjacent / cache-pressure
+                  shape
+      true_cold   both tiers cleared — the full object-store read
+      true_cold_tier2_off  same, on an engine with [scan.cache]
+                  tier2_max_bytes = 0 — proves disabling the tier
+                  reproduces the pre-tiering behavior
+
+    The done-bars (ISSUE 4): post_flush within 2x cached, tier2_cold
+    >= 5x faster than true_cold, stage profile showing near-zero
+    sidecar bytes on the tier2 leg."""
+    import os
+    import shutil
+    import tempfile
+
+    import pyarrow as pa
+
+    from horaedb_tpu.common import ReadableDuration
+    from horaedb_tpu.metric_engine import MetricEngine
+    from horaedb_tpu.objstore import (
+        FaultInjectingStore,
+        MemoryObjectStore,
+        WrappedObjectStore,
+    )
+    from horaedb_tpu.storage.config import StorageConfig, from_dict
+    from horaedb_tpu.storage.read import plan_stage_snapshot
+    from horaedb_tpu.storage.types import TimeRange
+    from horaedb_tpu.wal import WalConfig
+
+    class DataGetCounter(WrappedObjectStore):
+        """Counts data-plane reads (.sst/.enc get + get_range) — the
+        hard per-leg evidence that a tier served without store IO."""
+
+        def __init__(self, inner):
+            super().__init__(inner)
+            self.data_gets = 0
+
+        async def _call(self, op: str, *args):
+            if op in ("get", "get_range") and str(args[0]).endswith(
+                    (".sst", ".enc")):
+                self.data_gets += 1
+            return await super()._call(op, *args)
+
+    # seeded per-op store latency models a REAL object store (an
+    # in-memory GET is a memcpy, which no cache can beat); 25 ms is an
+    # S3-class GET time-to-first-byte, 0 disables
+    lat_s = float(os.environ.get("BENCH_STORE_LATENCY_MS", "25")) / 1e3
+
+    hosts = 100
+    interval = 10_000
+    bucket_ms = 60_000
+    per_host = max(60, rows // hosts)
+    span = per_host * interval
+    segment_ms = 2 * 3600 * 1000
+    T0 = (1_700_000_000_000 // segment_ms) * segment_ms
+    rng = np.random.default_rng(9)
+    n = per_host * hosts
+    ts = T0 + np.repeat(
+        np.arange(per_host, dtype=np.int64) * interval, hosts)
+    host_id = np.tile(np.arange(hosts, dtype=np.int32), per_host)
+    vals = (rng.random(n) * 100).astype(np.float64)
+    names = pa.array([f"host_{i:03d}" for i in range(hosts)])
+    _check_i32_span(np.asarray([span]), "config9")
+    k_cold = max(3, iters // 3)
+
+    def cfg_of(tier2: bool):
+        return from_dict(StorageConfig, {
+            "scheduler": {"schedule_interval": "1h"},
+            "scan": {"cache_max_rows": n * 4,
+                     "cache": {"tier2_max_bytes":
+                               (1 << 30) if tier2 else 0}},
+        })
+
+    async def ingest(e):
+        chunk = max(1, 1_000_000 // hosts) * hosts
+        for lo in range(0, n, chunk):
+            hi = min(n, lo + chunk)
+            await e.write_arrow("cpu", ["host"], pa.record_batch({
+                "host": pa.DictionaryArray.from_arrays(
+                    pa.array(host_id[lo:hi]), names),
+                "timestamp": pa.array(ts[lo:hi], type=pa.int64()),
+                "value": pa.array(vals[lo:hi], type=pa.float64()),
+            }))
+
+    async def query(e):
+        return await e.query_downsample(
+            "cpu", [], TimeRange.new(T0, T0 + span),
+            bucket_ms=bucket_ms, aggs=("avg",))
+
+    async def timed(e, reps: int, reset=None, profile: bool = False):
+        times, prof = [], {}
+        for i in range(reps):
+            if reset is not None:
+                reset()
+            before = plan_stage_snapshot() if profile and i == 0 else None
+            t0 = time.perf_counter()
+            await query(e)
+            times.append(time.perf_counter() - t0)
+            if before is not None:
+                after = plan_stage_snapshot()
+                prof = {kk: round(after[kk] - before[kk], 4)
+                        for kk in after if after[kk] != before[kk]}
+        return float(np.percentile(times, 50)), prof
+
+    async def go():
+        out = {}
+        store = DataGetCounter(FaultInjectingStore(
+            MemoryObjectStore(), seed=9,
+            latency_range=(lat_s, lat_s)))
+        out["store_latency_ms"] = lat_s * 1e3
+        # ingest once, tier-2 on, no WAL (bulk load path)
+        e = await MetricEngine.open("cfg9", store,
+                                    segment_ms=segment_ms,
+                                    config=cfg_of(True))
+        try:
+            await ingest(e)
+        finally:
+            await e.close()
+
+        gets_mark = store.data_gets
+
+        def leg_gets() -> int:
+            nonlocal gets_mark
+            prev, gets_mark = gets_mark, store.data_gets
+            return gets_mark - prev
+
+        wal_dir = tempfile.mkdtemp(prefix="cfg9-wal-")
+        try:
+            wc = WalConfig(
+                enabled=True, dir=wal_dir,
+                flush_rows=1 << 30, flush_bytes=1 << 40,
+                flush_age=ReadableDuration.parse("1h"),
+                flush_interval=ReadableDuration.parse("1h"))
+            e = await MetricEngine.open("cfg9", store,
+                                        segment_ms=segment_ms,
+                                        config=cfg_of(True),
+                                        wal_config=wc)
+            try:
+                table = e.tables["data"]
+                await query(e)  # compile + first read (warms both tiers)
+                leg_gets()  # flush the warmup's reads from the mark
+                cached, _ = await timed(e, iters)
+                out["cached_p50_ms"] = round(cached * 1e3, 3)
+                out["data_gets_cached"] = leg_gets()
+
+                # HBM evicted, host windows retained: under the default
+                # host_perm merge the scan cache's windows live in host
+                # RAM while the stacks/replay/memos are the
+                # HBM-resident state — drop exactly those and re-derive
+                # from the kept windows (no re-read, no re-merge)
+                hbm, _ = await timed(e, k_cold,
+                                     reset=table.reader.drop_hbm_state)
+                out["hbm_evicted_p50_ms"] = round(hbm * 1e3, 3)
+                out["data_gets_hbm_evicted"] = leg_gets()
+
+                # post-flush: a tiny write lands in segment 0's range,
+                # the WAL flusher drains it to an SST (write-through
+                # admission), and the very next query re-merges that
+                # segment from tier-2 — no object-store read
+                flush_times = []
+                for i in range(iters):
+                    await e.write_arrow("cpu", ["host"], pa.record_batch({
+                        "host": pa.DictionaryArray.from_arrays(
+                            pa.array(np.arange(hosts, dtype=np.int32)),
+                            names),
+                        "timestamp": pa.array(
+                            np.full(hosts, T0 + 1 + i, dtype=np.int64),
+                            type=pa.int64()),
+                        "value": pa.array(np.full(hosts, float(i)),
+                                          type=pa.float64()),
+                    }))
+                    await e.flush()
+                    t0 = time.perf_counter()
+                    await query(e)
+                    flush_times.append(time.perf_counter() - t0)
+                post_flush = float(np.percentile(flush_times, 50))
+                out["post_flush_p50_ms"] = round(post_flush * 1e3, 3)
+                # the headline guarantee: a flush just changed the SST
+                # set every iteration, yet the queries read NOTHING
+                # from the store (write-through + tier-2 re-merge)
+                out["data_gets_post_flush"] = leg_gets()
+
+                tier2, prof2 = await timed(
+                    e, k_cold, reset=table.reader.scan_cache.clear,
+                    profile=True)
+                out["tier2_cold_p50_ms"] = round(tier2 * 1e3, 3)
+                out["stage_profile_tier2"] = prof2
+                out["data_gets_tier2"] = leg_gets()
+
+                true_cold, prof0 = await timed(
+                    e, k_cold,
+                    reset=lambda: _clear_scan_tiers(table),
+                    profile=True)
+                out["true_cold_p50_ms"] = round(true_cold * 1e3, 3)
+                out["stage_profile_true_cold"] = prof0
+                out["data_gets_true_cold"] = leg_gets()
+                out["encoded_cache"] = table.reader.encoded_cache.stats()
+            finally:
+                await e.close()
+        finally:
+            shutil.rmtree(wal_dir, ignore_errors=True)
+
+        # the disabled-tier control: [scan.cache] tier2_max_bytes = 0
+        # reproduces the pre-tiering cold path on the same data
+        e = await MetricEngine.open("cfg9", store,
+                                    segment_ms=segment_ms,
+                                    config=cfg_of(False))
+        try:
+            table = e.tables["data"]
+            await query(e)  # compile
+            off, _ = await timed(e, k_cold,
+                                 reset=table.reader.scan_cache.clear)
+            out["true_cold_tier2_off_p50_ms"] = round(off * 1e3, 3)
+        finally:
+            await e.close()
+        return out
+
+    out = asyncio.run(go())
+    cached = out["cached_p50_ms"]
+    post_flush = out["post_flush_p50_ms"]
+    hbm = out["hbm_evicted_p50_ms"]
+    tier2 = out["tier2_cold_p50_ms"]
+    true_cold = out["true_cold_p50_ms"]
+    out["post_flush_vs_cached"] = round(post_flush / cached, 3)
+    out["hbm_evicted_speedup_vs_true_cold"] = round(true_cold / hbm, 2)
+    out["tier2_speedup_vs_true_cold"] = round(true_cold / tier2, 2)
+    _log(f"config9: cached {cached:.1f} ms | post-flush {post_flush:.1f}"
+         f" ms ({out['post_flush_vs_cached']}x cached) | hbm-evicted "
+         f"{hbm:.1f} ms ({out['hbm_evicted_speedup_vs_true_cold']}x "
+         f"faster than true-cold) | tier2-cold {tier2:.1f} ms "
+         f"({out['tier2_speedup_vs_true_cold']}x) | true-cold "
+         f"{true_cold:.1f} ms | tier2-off "
+         f"{out['true_cold_tier2_off_p50_ms']:.1f} ms")
+    return {
+        "metric": (f"tiered scan cache ladder: post-flush query p50, "
+                   f"{n / 1e6:.1f}M rows, WAL flush changing one "
+                   f"segment's SST set per query"),
+        "value": post_flush,
+        "unit": "ms",
+        # done-bar: post-flush within 2x of cached (lower is better)
+        "vs_baseline": out["post_flush_vs_cached"],
+        "rows": n,
+        **out,
+    }
+
+
 RUNNERS = {2: run_config2, 3: run_config3, 4: run_config4, 5: run_config5,
-           6: run_config6, 7: run_config7, 8: run_config8}
+           6: run_config6, 7: run_config7, 8: run_config8, 9: run_config9}
 
 
 def main() -> None:

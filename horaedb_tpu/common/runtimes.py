@@ -57,8 +57,10 @@ class Runtimes:
             pool.shutdown(wait=True, cancel_futures=True)
 
 
-def from_config(threads) -> Runtimes:
-    """Build pools from a ThreadsConfig (storage.config)."""
-    return Runtimes(sst_threads=threads.sst_thread_num,
+def from_config(threads, sst_override: int = 0) -> Runtimes:
+    """Build pools from a ThreadsConfig (storage.config).
+    `sst_override` > 0 widens/narrows the serving decode pool — the
+    [scan] decode_workers knob for cold-path tuning."""
+    return Runtimes(sst_threads=sst_override or threads.sst_thread_num,
                     compact_threads=threads.compact_thread_num,
                     manifest_threads=threads.manifest_thread_num)

@@ -505,9 +505,13 @@ class MetricEngine:
         if chunked_data:
             schemas["data"] = _CHUNKED_DATA_SCHEMA
         # one set of worker pools shared by all five tables — the
-        # reference's StorageRuntimes are likewise engine-wide
+        # reference's StorageRuntimes are likewise engine-wide.  The
+        # [scan] decode_workers override must be applied HERE: tables
+        # receive these shared pools, so CloudObjectStorage's own
+        # from_config never runs under the engine
+        eng_cfg = config or StorageConfig()
         shared_runtimes = runtimes_mod.from_config(
-            (config or StorageConfig()).threads)
+            eng_cfg.threads, sst_override=eng_cfg.scan.decode_workers)
         wal_on = wal_config is not None and wal_config.enabled
         if wal_on:
             ensure(wal_config.dir,
@@ -593,8 +597,28 @@ class MetricEngine:
                 if age is not None and (last_flush_age is None
                                         or age > last_flush_age):
                     last_flush_age = age  # the most stale table
+            # per-table cache tiers (HBM windows / host-RAM encoded
+            # parts / HBM stacks) — the operator's residency dashboard
+            reader = getattr(t, "reader", None)
+            if reader is not None and hasattr(reader, "cache_stats"):
+                tables[name]["cache"] = reader.cache_stats()
         out = {"rows": rows, "bytes": size, "ssts": sst_count,
                "tables": tables}
+        cache_tables = [v["cache"] for v in tables.values()
+                        if "cache" in v]
+        if cache_tables:
+            out["cache"] = {
+                "scan_cache_bytes": sum(
+                    c["scan_cache"]["bytes"] for c in cache_tables),
+                "encoded_cache_bytes": sum(
+                    c["encoded_cache"]["bytes"] for c in cache_tables),
+                "encoded_cache_entries": sum(
+                    c["encoded_cache"]["entries"] for c in cache_tables),
+                "encoded_cache_hits": sum(
+                    c["encoded_cache"]["hits"] for c in cache_tables),
+                "encoded_cache_misses": sum(
+                    c["encoded_cache"]["misses"] for c in cache_tables),
+            }
         if wal_enabled:
             out["memtable_rows"] = mem_rows
             out["memtable_bytes"] = mem_bytes

@@ -303,6 +303,14 @@ def chunk_decode_batch(payloads):
     return ts[:int(total)], vals[:int(total)], counts
 
 
+def _arrow_buffers(payloads):
+    """Seam over Array.buffers(): some pyarrow builds hand back a None
+    data buffer for all-empty binary arrays (tests patch this to pin
+    the fallback behavior — pa.Array.from_buffers validates the shape
+    away, so it cannot be constructed directly)."""
+    return payloads.buffers()
+
+
 def _payload_buffers(payloads):
     """(holder, data_ptr, int64 offsets (n+1), n) for the C ABI.
     `holder` keeps the underlying buffer alive; data_ptr is None when
@@ -319,7 +327,12 @@ def _payload_buffers(payloads):
             pa.types.is_binary(payloads.type):
         if payloads.null_count:
             return None, None, None, 0
-        _validity, off_buf, data_buf = payloads.buffers()
+        _validity, off_buf, data_buf = _arrow_buffers(payloads)
+        if data_buf is None:
+            # an all-empty binary array carries no data buffer at all;
+            # .address would raise — fall back to the Python decoder,
+            # which the caller's contract promises on unsupported shapes
+            return None, None, None, 0
         offs = np.frombuffer(off_buf, dtype=np.int32)[
             payloads.offset:payloads.offset + len(payloads) + 1]
         return (data_buf, ctypes.c_void_p(data_buf.address),

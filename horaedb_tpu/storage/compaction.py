@@ -203,6 +203,10 @@ class Executor:
         """Best-effort parallel SST object deletes (manifest already
         updated, so errors are logged, never raised —
         ref: executor.rs:224-253)."""
+        # tier-2 entries for deleted ids go first: the SSTs will never
+        # be read again, and per-SST invalidation is the WHOLE eviction
+        # story — every surviving SST's part stays resident
+        self.storage.reader.encoded_cache.invalidate(file_ids)
         results = await asyncio.gather(
             *(self.storage.store.delete(
                 sst_path(self.storage.root_path, fid))
@@ -320,12 +324,23 @@ class Executor:
             storage.schema(), runtimes=storage.runtimes, pool="compact")
         if sc_parts:
             try:
-                data = await storage.runtimes.run(
-                    "compact", sidecar.build_multi, sc_parts)
-                if data is not None:
-                    await storage.store.put(
-                        sidecar.sidecar_path(storage.root_path, file_id),
-                        data)
+                merged = await storage.runtimes.run(
+                    "compact", sidecar.merge_parts, sc_parts)
+                if merged is not None:
+                    cols, n_enc = merged
+                    # write-through admission: the compactor holds the
+                    # output's encoded columns in hand — insert them
+                    # into tier-2 now, so the first post-compaction
+                    # query rebuilds from host RAM, not the store
+                    storage.reader.encoded_cache.admit(file_id, cols,
+                                                       n_enc)
+                    data = await storage.runtimes.run(
+                        "compact", sidecar.serialize, cols, n_enc)
+                    if data is not None:
+                        await storage.store.put(
+                            sidecar.sidecar_path(storage.root_path,
+                                                 file_id),
+                            data)
             except Exception as exc:  # noqa: BLE001 — cache write only
                 logger.warning("sidecar write failed for compacted sst "
                                "%s: %s", file_id, exc)

@@ -144,6 +144,24 @@ class ScrubConfig:
 
 
 @dataclass
+class ScanCacheConfig:
+    """Tier-2 scan cache: host-RAM per-SST encoded sidecar parts under
+    the HBM windows cache (see storage/encoded_cache.py).  An HBM miss
+    rebuilds windows from host memory, and a flush/compaction
+    invalidates nothing but the SSTs it actually removed — steady
+    writes no longer re-cliff reads."""
+
+    # host-RAM byte budget for per-SST encoded parts (0 disables tier 2
+    # entirely: every HBM miss re-reads the object store, the
+    # pre-tiering behavior)
+    tier2_max_bytes: int = 256 << 20
+    # write-through admission: the WAL flusher and the compactor insert
+    # freshly-encoded parts at write time, so a query landing right
+    # after a flush never touches the object store
+    write_through: bool = True
+
+
+@dataclass
 class ScanConfig:
     """Device scan execution knobs (no reference analogue — the TPU
     build's HBM-budget control, SURVEY.md hard part #5)."""
@@ -190,6 +208,17 @@ class ScanConfig:
     # segment reads when present (see storage/sidecar.py); disable to
     # force the parquet decode path
     use_sidecar: bool = True
+    # segment tables/parts held in memory ahead of the merge position:
+    # deeper prefetch overlaps more object-store reads with device work
+    # on true-cold scans, at the cost of host RAM for the in-flight
+    # segments
+    prefetch_segments: int = 4
+    # width of the "sst" decode pool (parquet/sidecar deserialize,
+    # window prep); 0 = threads.sst_thread_num.  A [scan]-level
+    # override so cold-path tuning lives next to prefetch_segments.
+    decode_workers: int = 0
+    # tiered scan-cache knobs ([scan.cache])
+    cache: ScanCacheConfig = field(default_factory=ScanCacheConfig)
 
 
 @dataclass
@@ -227,6 +256,7 @@ _NESTED = {
     "manifest": ManifestConfig,
     "scheduler": SchedulerConfig,
     "scan": ScanConfig,
+    "cache": ScanCacheConfig,
     "threads": ThreadsConfig,
     "retry": RetryConfig,
     "scrub": ScrubConfig,

@@ -107,6 +107,10 @@ _COLSTACK_HITS = registry.counter(
 _COLSTACK_MISSES = registry.counter(
     "scan_colstack_cache_misses_total",
     "range-independent column-stack LRU misses")
+_INCR_REMERGE = registry.counter(
+    "scan_incremental_remerge_total",
+    "segments re-merged from tier-2-resident parts with only the "
+    "missing SSTs fetched (the post-flush path)")
 
 
 def _stack_counters(key: tuple):
@@ -146,7 +150,8 @@ def plan_stage_snapshot() -> dict:
         out[f"{s}_bytes"] = int(c.value)
     return out
 # segment tables held in memory at once by _prefetch_tables (bounds BOTH
-# the row-scan and aggregate paths — including compaction's scan)
+# the row-scan and aggregate paths — including compaction's scan);
+# fallback when scan.prefetch_segments is 0/unset
 _PREFETCH_SEGMENTS = 4
 # rows -> bytes conversion for the legacy cache_max_rows knob: a typical
 # engine window is ~4 int32/f32 columns (16B) plus the memo allowance
@@ -332,10 +337,15 @@ class ParquetReader:
         # case there is 2x the configured budget; see ScanConfig.)
         self._stack_cache_max = cache_bytes
         self._stack_cache_lock = threading.Lock()
-        # SST ids known to lack a sidecar (pre-feature files, failed
-        # best-effort writes): permanent per id, so a memo'd miss saves
-        # the whole segment's sidecar GETs on every later cold scan
-        self._sidecar_missing: set = set()
+        # tier 2: host-RAM per-SST encoded parts under the HBM windows
+        # cache — an HBM miss rebuilds from host memory, and a changed
+        # SST set re-merges incrementally (only missing SSTs fetched).
+        # Also owns the per-SST sidecar-missing negative memo.
+        from horaedb_tpu.storage.encoded_cache import EncodedSegmentCache
+
+        self.encoded_cache = EncodedSegmentCache(
+            config.scan.cache.tier2_max_bytes,
+            write_through=config.scan.cache.write_through)
         self.mesh = None
         self._mesh_agg_fns: dict = {}
         self._mesh_merge_fns: dict = {}
@@ -515,7 +525,11 @@ class ParquetReader:
         # the shared _segment_feed owns the streamed/bulk split and the
         # prefetch priming; pump() adds the merge-dispatch LOOKAHEAD on
         # top (bulk merges dispatch ahead of the yield position so the
-        # device pipeline never drains)
+        # device pipeline never drains).  Encodes stay SERIAL on the
+        # pump: running lookahead encodes as concurrent tasks was
+        # measured a net loss on low-core hosts (GIL + memory-bandwidth
+        # contention with the prefetch deserializes outweighed the
+        # overlap; 2-core A/B showed cold +36%).
         feed = self._segment_feed(plan, to_read).__aiter__()
         pending: "deque[tuple[SegmentPlan, str, list, float]]" = deque()
         exhausted = False
@@ -796,10 +810,12 @@ class ParquetReader:
                                plan: ScanPlan):
         """Bounded segment prefetch shared by the row and aggregate paths:
         object-store reads overlap downstream device work while at most
-        _PREFETCH_SEGMENTS tables are in memory (the permit is released
-        only after the consumer finishes with a segment).  Yields
-        (segment, table, read_seconds)."""
-        sem = asyncio.Semaphore(_PREFETCH_SEGMENTS)
+        scan.prefetch_segments tables are in memory (the permit is
+        released only after the consumer finishes with a segment).
+        Yields (segment, table, read_seconds)."""
+        sem = asyncio.Semaphore(
+            max(1, self.config.scan.prefetch_segments
+                or _PREFETCH_SEGMENTS))
 
         async def read(seg: SegmentPlan):
             await sem.acquire()
@@ -844,30 +860,49 @@ class ParquetReader:
 
     async def _read_segment_encoded(self, seg: SegmentPlan, plan: ScanPlan
                                     ) -> Optional[sidecar.EncodedSegment]:
-        """Segment read that never touches parquet: fetch each SST's
-        sidecar and assemble filtered, concatenated encoded columns.
-        None (→ parquet fallback) when any SST lacks a valid sidecar."""
-        if any(f.id in self._sidecar_missing for f in seg.ssts):
+        """Segment read that never touches parquet: serve each SST's
+        encoded part from tier 2 when resident, fetch only the missing
+        SSTs' sidecars, and assemble filtered, concatenated encoded
+        columns.  This is the incremental re-merge: after a flush (one
+        new small SST in an otherwise-unchanged segment) only that SST
+        crosses the wire — and with write-through admission not even
+        that.  None (→ parquet fallback) when any SST lacks a valid
+        sidecar."""
+        if any(self.encoded_cache.is_missing(f.id) for f in seg.ssts):
             return None  # known-missing sidecar: skip the GETs entirely
+        seg_ids = frozenset(f.id for f in seg.ssts)
+        if self.encoded_cache.is_assembly_failed(seg_ids):
+            return None  # this exact composition is known unassemblable
         leaves = plan.prune_leaves
         want = set(seg.columns) | {lf.column for lf in leaves or []}
 
         def runner(fn, *args):  # CPU-bound deserialize off the loop
             return self._run_pool(plan.pool, fn, *args)
 
+        parts: list = [None] * len(seg.ssts)
+        fetch: list[tuple[int, SstFile]] = []
+        for i, f in enumerate(seg.ssts):
+            part = self.encoded_cache.get(f.id, want)
+            if part is None:
+                fetch.append((i, f))
+            else:
+                parts[i] = part
+        if fetch and len(fetch) < len(seg.ssts):
+            _INCR_REMERGE.inc()
+        # per-SST GETs overlap WITHIN the segment (one gather), and the
+        # prefetch pipeline overlaps segments on top
         got = await asyncio.gather(*(
             sidecar.load_sst_encoded(
                 self.store, sidecar.sidecar_path(self.root_path, f.id),
                 want, leaves, runner=runner)
-            for f in seg.ssts), return_exceptions=True)
-        parts = []
-        for f, res in zip(seg.ssts, got):
+            for _i, f in fetch), return_exceptions=True)
+        for (i, f), res in zip(fetch, got):
             if isinstance(res, NotFoundError):
                 # permanent for this id (SSTs/ids are immutable and the
                 # sidecar is written before the SST becomes visible):
                 # memo the miss so later cold scans of this segment
                 # don't re-fetch the siblings' blobs just to fall back
-                self._memo_sidecar_missing((f.id,))
+                self.encoded_cache.mark_missing(f.id)
                 return None
             if isinstance(res, BaseException):
                 # transient store failure: the sidecar is a cache — fall
@@ -876,11 +911,15 @@ class ParquetReader:
                                f.id, res)
                 return None
             if res is None:
-                self._memo_sidecar_missing((f.id,))
+                self.encoded_cache.mark_missing(f.id)
                 logger.warning("invalid sidecar for sst %s; using "
                                "parquet", f.id)
                 return None
-            parts.append(res)
+            parts[i] = res
+            # only COMPLETE parts are cacheable: a block-pruned load
+            # returned a row subset tied to this plan's leaves
+            if res[1] == f.meta.num_rows:
+                self.encoded_cache.put(f.id, res[0], res[1])
         try:
             es = await self._run_pool(
                 plan.pool, sidecar.assemble_parts, parts,
@@ -893,12 +932,18 @@ class ParquetReader:
                            seg.segment_start, exc)
             es = None
         if es is None:
-            # a downloaded blob failed to parse/concat — as permanent as
-            # a missing one (objects are immutable), so memo the whole
-            # SST set and stop re-downloading it every cold scan
-            self._memo_sidecar_missing(f.id for f in seg.ssts)
-            logger.warning("invalid sidecar(s) for segment %s; using "
-                           "parquet", seg.segment_start)
+            # cross-SST assembly failed (e.g. an irreconcilable column
+            # type across parts).  Do NOT memoize the member SSTs as
+            # sidecar-missing — each part deserialized fine on its own,
+            # and the same ids may assemble cleanly in other
+            # compositions (the old whole-set memo permanently disabled
+            # every valid sibling).  Memoize the COMPOSITION instead,
+            # so repeat cold scans of this unchanged segment skip the
+            # doomed sidecar GETs; any flush/compaction changes the set
+            # and retries naturally.
+            self.encoded_cache.mark_assembly_failed(seg_ids)
+            logger.warning("sidecar assembly failed for segment %s; "
+                           "using parquet", seg.segment_start)
         return es
 
     async def _open_sidecar_stream(self, seg: SegmentPlan, plan: ScanPlan):
@@ -911,7 +956,7 @@ class ParquetReader:
         (the parquet streamer serves the segment instead)."""
         if not self._sidecar_plan_ok(plan):
             return None
-        if any(f.id in self._sidecar_missing for f in seg.ssts):
+        if any(self.encoded_cache.is_missing(f.id) for f in seg.ssts):
             return None
         leaves = plan.prune_leaves or []
         want = set(seg.columns) | {lf.column for lf in leaves}
@@ -929,7 +974,7 @@ class ParquetReader:
             if isinstance(res, NotFoundError) or res is None:
                 # permanent per immutable id — same memo as the bulk
                 # path, so later streamed scans skip the probes
-                self._memo_sidecar_missing((f.id,))
+                self.encoded_cache.mark_missing(f.id)
                 return None
             if isinstance(res, BaseException):
                 logger.warning("sidecar stream open failed for sst "
@@ -972,12 +1017,45 @@ class ParquetReader:
 
         return gen()
 
-    def _memo_sidecar_missing(self, ids) -> None:
-        """Record permanently-sidecar-less SST ids, bounded (clear-all on
-        overflow: re-learning misses is cheap, unbounded growth is not)."""
-        if len(self._sidecar_missing) > 65536:
-            self._sidecar_missing.clear()
-        self._sidecar_missing.update(ids)
+    def drop_hbm_state(self) -> None:
+        """Evict everything HBM-RESIDENT that derives from cached
+        windows — round stacks, fused-replay plans, per-window memos
+        (device column copies, aggregation grids) — while KEEPING the
+        post-merge windows themselves, which live in host RAM under the
+        default host_perm merge.  This is the 'HBM evicted' state the
+        bench ladder measures: the next query re-stacks/re-uploads from
+        host windows instead of re-reading and re-merging.  (Tests and
+        benchmarks only; production eviction is the LRUs' own.)"""
+        with self._stack_cache_lock:
+            self._stack_cache.clear()
+            self._stack_cache_bytes = 0
+        self._replay_cache.clear()
+        with _MEMO_LOCK:
+            for windows in self.scan_cache.values():
+                for w in windows:
+                    w.memo.clear()
+                    w.memo_bytes = 0
+
+    def cache_stats(self) -> dict:
+        """The /stats cache section: every reader-owned cache tier's
+        residency and effectiveness, one dict per tier."""
+        return {
+            "scan_cache": {
+                "entries": len(self.scan_cache),
+                "bytes": self.scan_cache.total_bytes,
+                "max_bytes": self.scan_cache.max_bytes,
+                "hits": self.scan_cache.hits,
+                "misses": self.scan_cache.misses,
+            },
+            "encoded_cache": self.encoded_cache.stats(),
+            "stack_cache": {
+                "entries": len(self._stack_cache),
+                "bytes": self._stack_cache_bytes,
+                "max_bytes": self._stack_cache_max,
+                "hits": self._stack_cache_hits,
+                "misses": self._stack_cache_misses,
+            },
+        }
 
     async def _read_segment_table(self, seg: SegmentPlan,
                                   pushdown=None,

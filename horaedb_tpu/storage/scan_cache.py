@@ -104,6 +104,11 @@ class ByteLRU:
         self._entries.clear()
         self._total_bytes = 0
 
+    def values(self):
+        """Resident values in LRU order (no recency update) — the
+        reader's HBM-eviction sweep walks cached windows through this."""
+        return [v for v, _nbytes in self._entries.values()]
+
     @property
     def total_bytes(self) -> int:
         return self._total_bytes

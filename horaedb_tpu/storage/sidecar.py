@@ -42,6 +42,7 @@ from typing import Optional
 import numpy as np
 import pyarrow as pa
 
+from horaedb_tpu.common.error import Error
 from horaedb_tpu.objstore import NotFoundError
 from horaedb_tpu.ops import encode
 from horaedb_tpu.storage.types import RESERVED_COLUMN_NAME
@@ -101,6 +102,13 @@ def encode_columns(batch: pa.RecordBatch) -> Optional[dict]:
     return out or None
 
 
+# largest storable blob-dictionary payload: offsets are int32 on disk,
+# so a dictionary whose concatenated bytes reach 2^31 cannot be
+# represented — the writer must refuse (silent int32 cumsum wraparound
+# would serve WRONG VALUES on read)
+_DICT_BLOB_MAX = 2**31
+
+
 def _dict_sections(dictionary: np.ndarray) -> Optional[tuple[dict, list]]:
     """(meta, sections) for one dictionary: numeric dicts as one raw
     int64 section, string/bytes dicts as int32 offsets + blob."""
@@ -116,8 +124,14 @@ def _dict_sections(dictionary: np.ndarray) -> Optional[tuple[dict, list]]:
                 blobs.append(v.encode("utf-8"))
             else:
                 return None
-        offsets = np.zeros(len(blobs) + 1, dtype=np.int32)
-        np.cumsum([len(b) for b in blobs], out=offsets[1:])
+        lens = [len(b) for b in blobs]
+        if sum(lens) >= _DICT_BLOB_MAX:
+            # int32 offsets would wrap: not storable (caller falls back
+            # to parquet-only for this SST)
+            return None
+        offsets = np.zeros(len(blobs) + 1, dtype=np.int64)
+        np.cumsum(lens, out=offsets[1:])
+        offsets = offsets.astype(np.int32)
         return ({"dict_kind": "blob", "dict_len": len(dictionary)},
                 [offsets.tobytes(), b"".join(blobs)])
     return None
@@ -277,6 +291,11 @@ def _load_dict(buf: bytes, m: dict, data_start: int,
         offs = np.frombuffer(buf, dtype=np.int32, count=dlen + 1,
                              offset=data_start + offsets[sec])
         base = data_start + offsets[sec + 1]
+        # a wrapped/corrupt offsets section must read as INVALID, not
+        # slice garbage: offsets are non-decreasing from 0 and the blob
+        # must actually contain the last offset (truncated objects)
+        if not _blob_offsets_ok(offs, len(buf) - base):
+            return None
         is_binary = m["arrow"] == "binary"
         out = np.empty(dlen, dtype=object)
         for i in range(dlen):
@@ -284,6 +303,18 @@ def _load_dict(buf: bytes, m: dict, data_start: int,
             out[i] = raw if is_binary else raw.decode("utf-8")
         return out
     return None
+
+
+def _blob_offsets_ok(offs: np.ndarray, blob_len: int) -> bool:
+    """Validate a blob dictionary's offsets section: starts at 0,
+    non-decreasing (an int32 cumsum wraparound in a pre-fix writer shows
+    up as a decrease or a negative), and the final offset fits the
+    available blob bytes."""
+    if len(offs) == 0 or int(offs[0]) != 0:
+        return False
+    if bool(np.any(offs[1:] < offs[:-1])):
+        return False
+    return int(offs[-1]) <= blob_len
 
 
 # ---------------------------------------------------------------------------
@@ -298,6 +329,13 @@ def _materialize_i64(arr: np.ndarray, enc: encode.ColumnEncoding
     if enc.kind == "dict":
         return enc.dictionary[arr]
     return arr.astype(np.int64)
+
+
+# max dictionary size after a cross-SST union remap, matching
+# encode._dictionary_encode: the merge kernel reserves INT32_MAX as its
+# padding sentinel, so the largest code must stay strictly below it —
+# a sentinel-sized union would alias real codes with padding
+_MAX_DICT_CODES = 2**31 - 1
 
 
 def concat_encoded(parts: list[dict], names: list[str]
@@ -342,12 +380,16 @@ def concat_encoded(parts: list[dict], names: list[str]
                 enc = encode.ColumnEncoding("offset", arrow_t, epoch=lo)
             else:
                 out, enc = _concat_as_dict(arrs, encs, arrow_t)
+                if enc is None:
+                    return None
         elif kinds <= {"dict", "offset"} and all(
                 e.kind == "offset" or e.dictionary.dtype == np.int64
                 for e in encs):
             if kinds == {"dict"}:
                 union = np.unique(np.concatenate(
                     [e.dictionary for e in encs]))
+                if len(union) >= _MAX_DICT_CODES:
+                    return None  # codes would alias the pad sentinel
                 out = np.concatenate([
                     np.searchsorted(union, e.dictionary).astype(
                         np.int32)[a]
@@ -356,10 +398,16 @@ def concat_encoded(parts: list[dict], names: list[str]
                                             dictionary=union)
             else:
                 out, enc = _concat_as_dict(arrs, encs, arrow_t)
+                if enc is None:
+                    return None
         elif kinds == {"dict"}:
             # string/bytes dictionaries: object-dtype union keeps codes
-            # order-preserving (np.unique sorts)
+            # order-preserving (np.unique sorts); re-check the union
+            # bound after remap — per-part dictionaries each fit, their
+            # union may not
             union = np.unique(np.concatenate([e.dictionary for e in encs]))
+            if len(union) >= _MAX_DICT_CODES:
+                return None  # codes would alias the pad sentinel
             out = np.concatenate([
                 np.searchsorted(union, e.dictionary).astype(np.int32)[a]
                 for a, e in zip(arrs, encs)])
@@ -374,17 +422,26 @@ def concat_encoded(parts: list[dict], names: list[str]
 
 def _concat_as_dict(arrs: list, encs: list, arrow_t) -> tuple:
     """Fallback: materialize int64 values and dictionary-encode the
-    concatenation (sorted-run fast path inside _dictionary_encode)."""
+    concatenation (sorted-run fast path inside _dictionary_encode).
+    (None, None) when the combined dictionary would reach the merge
+    kernel's pad sentinel — caller returns None → parquet fallback."""
     values = np.concatenate([
         _materialize_i64(a, e) for a, e in zip(arrs, encs)])
-    codes, dictionary = encode._dictionary_encode(values)
+    try:
+        codes, dictionary = encode._dictionary_encode(values)
+    except Error:
+        return None, None  # dictionary overflow: not representable
+    if len(dictionary) >= _MAX_DICT_CODES:
+        return None, None
     return codes, encode.ColumnEncoding("dict", arrow_t,
                                         dictionary=dictionary)
 
 
-def build_multi(parts: list[dict]) -> Optional[bytes]:
-    """Write-side helper for streamed writers (compaction): concat the
-    per-batch encoded parts and serialize one sidecar, or None."""
+def merge_parts(parts: list[dict]) -> Optional[tuple[dict, int]]:
+    """Concat per-batch encoded parts into ONE part ({name: (arr,
+    enc)}, n_rows), or None when the parts aren't mergeable.  Streamed
+    writers (compaction) serialize the result into a sidecar AND admit
+    it into the tier-2 encoded cache — same columns, one concat."""
     if not parts:
         return None
     names = list(parts[0].keys())
@@ -394,7 +451,7 @@ def build_multi(parts: list[dict]) -> Optional[bytes]:
     if cc is None:
         return None
     cols, encs, n = cc
-    return serialize({nm: (cols[nm], encs[nm]) for nm in names}, n)
+    return {nm: (cols[nm], encs[nm]) for nm in names}, n
 
 
 # ---------------------------------------------------------------------------
@@ -590,7 +647,12 @@ async def _dict_for(meta: dict, header: dict, secs: _Sections,
     if meta.get("dict_kind") == "blob":
         raw = await secs.fetch(offsets[sec], (dlen + 1) * 4)
         offs = np.frombuffer(raw, dtype=np.int32, count=dlen + 1)
+        if len(offs) == 0 or int(offs[0]) != 0 \
+                or bool(np.any(offs[1:] < offs[:-1])):
+            return None  # wrapped/corrupt offsets: invalid, not garbage
         blob = await secs.fetch(offsets[sec + 1], int(offs[-1]))
+        if len(blob) < int(offs[-1]):
+            return None  # truncated object
         is_binary = meta["arrow"] == "binary"
         if runner is not None:
             # per-entry Python decode loop: CPU-bound, off the loop

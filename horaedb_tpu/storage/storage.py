@@ -107,7 +107,8 @@ class CloudObjectStorage(TimeMergeStorage):
         # dedicated worker pools (ref: StorageRuntimes, storage.rs:91-104);
         # shared when a parent (e.g. MetricEngine) passes its own
         self._own_runtimes = runtimes is None
-        self.runtimes = runtimes or runtimes_mod.from_config(config.threads)
+        self.runtimes = runtimes or runtimes_mod.from_config(
+            config.threads, sst_override=config.scan.decode_workers)
         self.reader = ParquetReader(store, self.root_path, self._schema,
                                     config, segment_duration_ms,
                                     runtimes=self.runtimes)
@@ -177,6 +178,9 @@ class CloudObjectStorage(TimeMergeStorage):
             await self.compact_scheduler.stop()
         if self.manifest is not None:
             await self.manifest.close()
+        # release tier-2 residency (and its process-wide byte gauge):
+        # a closed table's entries can never be read again
+        self.reader.encoded_cache.clear()
         if self._own_runtimes:
             self.runtimes.close()
 
@@ -279,16 +283,33 @@ class CloudObjectStorage(TimeMergeStorage):
                              stamped: pa.RecordBatch) -> None:
         """Best-effort device-layout sidecar next to the SST (see
         storage/sidecar.py): pure cache — any failure is logged and
-        swallowed, reads fall back to parquet."""
+        swallowed, reads fall back to parquet.  The freshly-encoded
+        columns are write-through-admitted into the reader's tier-2
+        cache (storage/encoded_cache.py): both the direct write path
+        and the WAL flusher land here (_persist_stamped), so a query
+        right after a write/flush rebuilds its segment without a single
+        object-store read."""
         if (self._schema.update_mode is not UpdateMode.OVERWRITE
                 or not self.config.write.enable_sidecar
                 or stamped.num_rows > self.config.write.sidecar_max_rows):
             return
         try:
-            data = await self.runtimes.run("sst", sidecar.build, stamped)
-            if data is not None:
-                await self.store.put(
-                    sidecar.sidecar_path(self.root_path, file_id), data)
+            def build():
+                cols = sidecar.encode_columns(stamped)
+                if cols is None:
+                    return None, None
+                return cols, sidecar.serialize(cols, stamped.num_rows)
+
+            cols, data = await self.runtimes.run("sst", build)
+            if data is None:
+                return
+            # admit BEFORE the put: the entry is valid the instant the
+            # columns exist (ids are immutable), and the SST only
+            # becomes reader-visible after the manifest add anyway
+            self.reader.encoded_cache.admit(file_id, cols,
+                                            stamped.num_rows)
+            await self.store.put(
+                sidecar.sidecar_path(self.root_path, file_id), data)
         except Exception as exc:  # noqa: BLE001 — cache write only
             logger.warning("sidecar write failed for sst %s: %s",
                            file_id, exc)

@@ -19,6 +19,11 @@ Checks, all hard failures:
     file bytes must also os.fsync (an fsync-free WAL write is not an
     ack point), and bare `time.time()` is banned (replay must order by
     the persisted id clock; tests inject clocks)
+  - tiered scan-cache discipline under horaedb_tpu/: direct
+    `scan_cache.put/get` / `encoded_cache.put/get` calls are the
+    reader's alone — writers insert through the tiered admission API
+    (EncodedSegmentCache.admit), so cache-coherence reasoning lives in
+    exactly one module (storage/encoded_cache.py's docstring)
 
 Usage: python tools/lint.py [paths...]   (default: horaedb_tpu tests
 bench.py __graft_entry__.py)
@@ -98,6 +103,32 @@ def _session_call_without_timeout(node: ast.Call) -> bool:
     return not any(kw.arg == "timeout" for kw in node.keywords)
 
 
+# modules that OWN the scan-cache tiers: the reader (lookup + read-path
+# population) and the tier implementations themselves.  Everyone else
+# goes through the tiered API (admit/invalidate/clear/stats/
+# mark_missing) — direct put/get elsewhere bypasses the admission
+# discipline and the byte accounting
+_CACHE_OWNERS = {"read.py", "scan_cache.py", "encoded_cache.py"}
+_CACHE_TOKENS = ("scan_cache", "encoded_cache")
+
+
+def _tiered_cache_violation(node: ast.Call) -> bool:
+    """True for `<...scan_cache|encoded_cache...>.put/get(...)` calls —
+    the lookup/population surface only the reader may touch."""
+    func = node.func
+    if not isinstance(func, ast.Attribute) or func.attr not in ("put",
+                                                                "get"):
+        return False
+    chain = []
+    cur = func.value
+    while isinstance(cur, ast.Attribute):
+        chain.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        chain.append(cur.id)
+    return any(tok in part for part in chain for tok in _CACHE_TOKENS)
+
+
 def lint_file(path: pathlib.Path) -> list[str]:
     problems: list[str] = []
     text = path.read_text()
@@ -159,6 +190,16 @@ def lint_file(path: pathlib.Path) -> list[str]:
                     f"{path}:{node.lineno}: aiohttp session call without "
                     "an explicit timeout= (would inherit the 5-minute "
                     "default; derive one from the deadline)")
+        elif (isinstance(node, ast.Call) and "horaedb_tpu" in path.parts
+                and path.name not in _CACHE_OWNERS
+                and _tiered_cache_violation(node)):
+            src = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+            if "noqa" not in src:
+                problems.append(
+                    f"{path}:{node.lineno}: direct scan-cache put/get "
+                    "outside the reader — writers go through the tiered "
+                    "admission API (EncodedSegmentCache.admit); see "
+                    "storage/encoded_cache.py")
     if "wal" in path.parts and "horaedb_tpu" in path.parts:
         problems.extend(_lint_wal_module(path, tree, lines))
     return problems
