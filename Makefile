@@ -1,4 +1,4 @@
-.PHONY: test native bench clean verify lint chaos
+.PHONY: test native bench clean verify lint chaos trace-demo
 
 # mirrors the tier-1 invocation (fast variants of the slow suites stay
 # in-tier; `make chaos` runs the full slow schedules)
@@ -32,6 +32,11 @@ chaos:
 # this image ships no ruff/flake8, so the gate is tools/lint.py)
 lint:
 	python tools/lint.py
+
+# end-to-end tracing demo (docs/observability.md): run a query against
+# a throwaway local server and pretty-print its span tree + counters
+trace-demo:
+	JAX_PLATFORMS=cpu python tools/trace_demo.py
 
 # the driver-facing deliverables, end to end: lint + full suite + the
 # fixed-seed chaos gate + the multi-chip dryrun on the virtual CPU mesh

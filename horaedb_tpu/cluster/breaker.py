@@ -31,18 +31,15 @@ from dataclasses import dataclass, field
 from horaedb_tpu.common.time_ext import ReadableDuration
 from horaedb_tpu.utils import registry
 
-_OPENED = registry.counter(
-    "cluster_breaker_opened_total",
-    "circuit breaker transitions into the open state")
-_HALF_OPENED = registry.counter(
-    "cluster_breaker_half_open_total",
-    "circuit breaker transitions into the half-open (probe) state")
-_CLOSED = registry.counter(
-    "cluster_breaker_closed_total",
-    "circuit breaker recoveries back to the closed state")
+# one labeled family per event kind (docs/observability.md label
+# conventions): per-region + per-target-state series replace the old
+# per-state metric-name one-offs
+_TRANSITIONS = registry.counter(
+    "cluster_breaker_transitions_total",
+    "circuit breaker state transitions by region and target state")
 _REJECTED = registry.counter(
     "cluster_breaker_rejected_total",
-    "region calls skipped because the circuit was open")
+    "region calls skipped because the circuit was open, by region")
 
 CLOSED = "closed"
 OPEN = "open"
@@ -90,6 +87,11 @@ class CircuitBreaker:
         self._failures = 0
         self._opened_at = 0.0
         self._probe_inflight = False
+        # labeled children bound once (label lookup off the hot path)
+        self._m_opened = _TRANSITIONS.labels(region=name, to=OPEN)
+        self._m_half_open = _TRANSITIONS.labels(region=name, to=HALF_OPEN)
+        self._m_closed = _TRANSITIONS.labels(region=name, to=CLOSED)
+        self._m_rejected = _REJECTED.labels(region=name)
 
     @property
     def state(self) -> str:
@@ -113,12 +115,12 @@ class CircuitBreaker:
                 return True
             if self._state == OPEN:
                 if not self._cooldown_elapsed():
-                    _REJECTED.inc()
+                    self._m_rejected.inc()
                     return False
                 self._to_half_open_locked()
             # half-open: admit a single probe
             if self._probe_inflight:
-                _REJECTED.inc()
+                self._m_rejected.inc()
                 return False
             self._probe_inflight = True
             return True
@@ -129,7 +131,7 @@ class CircuitBreaker:
             self._probe_inflight = False
             if self._state != CLOSED:
                 self._state = CLOSED
-                _CLOSED.inc()
+                self._m_closed.inc()
 
     def record_failure(self) -> None:
         if not self.config.enabled:
@@ -177,12 +179,12 @@ class CircuitBreaker:
         self._failures = 0
         self._opened_at = self._clock()
         self._probe_inflight = False
-        _OPENED.inc()
+        self._m_opened.inc()
 
     def _to_half_open_locked(self) -> None:
         self._state = HALF_OPEN
         self._probe_inflight = False
-        _HALF_OPENED.inc()
+        self._m_half_open.inc()
 
     def __repr__(self) -> str:
         return f"CircuitBreaker({self.name}: {self.state})"

@@ -32,7 +32,7 @@ from horaedb_tpu.metric_engine import MetricEngine, Sample
 from horaedb_tpu.objstore import ObjectStore
 from horaedb_tpu.storage.config import StorageConfig
 from horaedb_tpu.storage.types import TimeRange
-from horaedb_tpu.utils import registry
+from horaedb_tpu.utils import registry, span
 
 logger = logging.getLogger(__name__)
 
@@ -502,14 +502,18 @@ class Cluster:
         br = self.breakers.get(rid)
         if isinstance(backend, MetricEngine):
             # local engines are bounded by the deadline checkpoints in
-            # the storage read path, not by an RPC timeout
-            return await factory()
+            # the storage read path, not by an RPC timeout.  The span
+            # keeps gather traces region-attributed either way (a
+            # remote backend's RPC span nests under this one).
+            with span("region_call", region=rid, local=True):
+                return await factory()
         cfg = self.breaker_config
         cap = cfg.rpc_timeout.seconds or None
         attempts = 1 + max(0, cfg.retries)
         try:
-            return await self._call_region_attempts(rid, factory, br, cap,
-                                                    attempts)
+            with span("region_call", region=rid, local=False):
+                return await self._call_region_attempts(rid, factory, br,
+                                                        cap, attempts)
         except (asyncio.CancelledError, deadline_mod.DeadlineExceeded):
             # exits that record NO outcome must still release a
             # half-open probe slot this call may have claimed, or the

@@ -28,6 +28,7 @@ from horaedb_tpu.common.deadline import current_deadline, remaining_budget
 from horaedb_tpu.common.error import Error
 from horaedb_tpu.metric_engine.types import Sample
 from horaedb_tpu.storage.types import TimeRange
+from horaedb_tpu.utils import span, tracing
 
 # default per-RPC total timeout when no deadline is bound and no
 # override is configured; generous for bulk ingest, far below aiohttp's
@@ -67,6 +68,12 @@ class RemoteRegion:
             # is never LONGER than ours
             headers["X-Deadline-Ms"] = str(
                 max(1, math.floor((budget or 0.0) * 1000)))
+        # the trace context rides the same plumbing as the deadline: the
+        # peer traces its share of the work under OUR trace id and hands
+        # its spans back on X-Trace-Export for stitching
+        trace = tracing.active_trace()
+        if trace is not None and not trace.finished:
+            headers[tracing.TRACE_HEADER] = trace.trace_id
         return aiohttp.ClientTimeout(total=budget), headers
 
     async def _post_raw(self, path: str, **kwargs) -> bytes:
@@ -74,16 +81,20 @@ class RemoteRegion:
         raw response body.  Every call carries an explicit timeout
         derived from the propagated deadline (capped by `timeout_s`)."""
         session = await self._ensure_session()
-        timeout, dl_headers = self._rpc_budget()
-        headers = {**dl_headers, **kwargs.pop("headers", {})}
-        async with session.post(self.base_url + path, timeout=timeout,
-                                headers=headers, **kwargs) as resp:
-            if resp.status != 200:
-                # body may be a non-JSON error page (404 text, 500 html)
-                text = await resp.text()
-                raise Error(f"remote region {self.base_url}{path} "
-                            f"returned {resp.status}: {text[:200]}")
-            return await resp.read()
+        with span("rpc", path=path, url=self.base_url):
+            timeout, dl_headers = self._rpc_budget()
+            headers = {**dl_headers, **kwargs.pop("headers", {})}
+            async with session.post(self.base_url + path, timeout=timeout,
+                                    headers=headers, **kwargs) as resp:
+                if resp.status != 200:
+                    # body may be a non-JSON error page (404, 500 html)
+                    text = await resp.text()
+                    raise Error(f"remote region {self.base_url}{path} "
+                                f"returned {resp.status}: {text[:200]}")
+                # stitch the peer's spans under this RPC span
+                tracing.ingest_export(
+                    resp.headers.get(tracing.EXPORT_HEADER))
+                return await resp.read()
 
     async def _post(self, path: str, body: dict) -> dict:
         import json
@@ -179,18 +190,23 @@ class RemoteRegion:
     async def label_values(self, metric: str, tag_key: str,
                            time_range: TimeRange) -> list[str]:
         session = await self._ensure_session()
-        timeout, dl_headers = self._rpc_budget()
-        # status FIRST (the _post_raw contract): a non-JSON error page
-        # (404 text, 500 html) must surface as Error, not as a
-        # ContentTypeError from reading the body as JSON
-        async with session.get(self.base_url + "/label_values", params={
-                "metric": metric, "key": tag_key,
-                "start": str(int(time_range.start)),
-                "end": str(int(time_range.end))},
-                timeout=timeout, headers=dl_headers) as resp:
-            if resp.status != 200:
-                text = await resp.text()
-                raise Error(f"remote region {self.base_url}/label_values "
-                            f"returned {resp.status}: {text[:200]}")
-            data = await resp.json()
-            return data["values"]
+        with span("rpc", path="/label_values", url=self.base_url):
+            timeout, dl_headers = self._rpc_budget()
+            # status FIRST (the _post_raw contract): a non-JSON error
+            # page (404 text, 500 html) must surface as Error, not as a
+            # ContentTypeError from reading the body as JSON
+            async with session.get(self.base_url + "/label_values",
+                                   params={
+                    "metric": metric, "key": tag_key,
+                    "start": str(int(time_range.start)),
+                    "end": str(int(time_range.end))},
+                    timeout=timeout, headers=dl_headers) as resp:
+                if resp.status != 200:
+                    text = await resp.text()
+                    raise Error(
+                        f"remote region {self.base_url}/label_values "
+                        f"returned {resp.status}: {text[:200]}")
+                tracing.ingest_export(
+                    resp.headers.get(tracing.EXPORT_HEADER))
+                data = await resp.json()
+                return data["values"]

@@ -20,6 +20,7 @@ run_in_executor.  Pools:
 from __future__ import annotations
 
 import asyncio
+import contextvars
 import functools
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable
@@ -41,10 +42,17 @@ class Runtimes:
         }
 
     async def run(self, pool: str, fn: Callable, *args, **kwargs):
-        """Run fn(*args, **kwargs) on the named pool; await the result."""
+        """Run fn(*args, **kwargs) on the named pool; await the result.
+        The caller's contextvars context rides along (run_in_executor,
+        unlike asyncio.to_thread, does not copy it) so request-scoped
+        state — the ambient trace, deadline — stays visible to stage
+        attribution inside pool work."""
         loop = asyncio.get_running_loop()
+        ctx = contextvars.copy_context()
         return await loop.run_in_executor(
-            self._pools[pool], functools.partial(fn, *args, **kwargs))
+            self._pools[pool],
+            functools.partial(ctx.run,
+                              functools.partial(fn, *args, **kwargs)))
 
     def close(self) -> None:
         # wait=True is load-bearing: shutdown(wait=False) leaves an

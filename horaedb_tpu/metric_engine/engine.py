@@ -38,7 +38,7 @@ from horaedb_tpu.storage.config import StorageConfig
 from horaedb_tpu.storage.read import AggregateSpec, ScanRequest
 from horaedb_tpu.storage.storage import CloudObjectStorage, WriteRequest
 from horaedb_tpu.storage.types import TimeRange, Timestamp
-from horaedb_tpu.utils import registry
+from horaedb_tpu.utils import registry, span
 from horaedb_tpu.metric_engine.types import (
     Sample,
     field_id_of,
@@ -476,7 +476,7 @@ class MetricEngine:
             self._chunk_cache = ByteLRU(
                 tables["data"].reader.cache_budget_bytes,
                 hits=_CHUNK_CACHE_HITS, misses=_CHUNK_CACHE_MISSES,
-                evictions=_CHUNK_CACHE_EVICTIONS)
+                evictions=_CHUNK_CACHE_EVICTIONS, trace_tier="chunk")
         else:
             self._chunk_cache = None
 
@@ -642,13 +642,14 @@ class MetricEngine:
         """The three-stage pipeline (ref: metric_engine README diagram)."""
         if not samples:
             return
-        await self.metric_manager.populate_metric_ids(samples)
-        await self.index_manager.populate_series_ids(samples)
-        if self.chunked_data:
-            await self.sample_manager.persist_chunked(samples,
-                                                      self.chunk_window_ms)
-        else:
-            await self.sample_manager.persist(samples)
+        with span("engine.write", samples=len(samples)):
+            await self.metric_manager.populate_metric_ids(samples)
+            await self.index_manager.populate_series_ids(samples)
+            if self.chunked_data:
+                await self.sample_manager.persist_chunked(
+                    samples, self.chunk_window_ms)
+            else:
+                await self.sample_manager.persist(samples)
 
     async def write_arrow(self, metric: str, tag_columns: list[str],
                           batch: pa.RecordBatch,
@@ -909,17 +910,20 @@ class MetricEngine:
                     time_range: TimeRange, field: str = "value") -> pa.Table:
         """Raw samples of one field of a metric matching all label filters,
         as an Arrow table (tsid, timestamp, value)."""
-        pred = await self._resolve_data_predicate(metric, filters,
-                                                 time_range, field)
+        with span("resolve", metric=metric):
+            pred = await self._resolve_data_predicate(metric, filters,
+                                                      time_range, field)
         if pred is None:
             return _empty_result()
-        qp = await self.tables["data"].plan_query(ScanRequest(
-            range=time_range, predicate=pred))
-        batches = await _collect(self.tables["data"].execute_plan(qp))
+        with span("scan", metric=metric):
+            qp = await self.tables["data"].plan_query(ScanRequest(
+                range=time_range, predicate=pred))
+            batches = await _collect(self.tables["data"].execute_plan(qp))
         if not batches:
             return _empty_result()
         if self.chunked_data:
-            return self._decode_chunk_batches(batches, time_range)
+            with span("chunk_decode"):
+                return self._decode_chunk_batches(batches, time_range)
         tbl = pa.Table.from_batches(batches)
         return tbl.select(["tsid", "timestamp", "value"])
 
@@ -1006,14 +1010,19 @@ class MetricEngine:
         """
         num_buckets, aligned = self._downsample_grid(time_range, bucket_ms)
         if self.chunked_data:
-            return await self._downsample_chunked(
-                metric, filters, time_range, bucket_ms, num_buckets,
-                field=field, which=tuple(aggs))
-        pred = await self._resolve_data_predicate(metric, filters,
-                                                  time_range, field,
-                                                  ts_leaf=not aligned)
-        return await self._scan_downsample(pred, time_range, bucket_ms,
-                                           num_buckets, aggs)
+            with span("downsample_chunked", metric=metric,
+                      bucket_ms=bucket_ms):
+                return await self._downsample_chunked(
+                    metric, filters, time_range, bucket_ms, num_buckets,
+                    field=field, which=tuple(aggs))
+        with span("resolve", metric=metric):
+            pred = await self._resolve_data_predicate(metric, filters,
+                                                      time_range, field,
+                                                      ts_leaf=not aligned)
+        with span("downsample", metric=metric, bucket_ms=bucket_ms):
+            return await self._scan_downsample(pred, time_range,
+                                               bucket_ms, num_buckets,
+                                               aggs)
 
     def _downsample_grid(self, time_range: TimeRange,
                          bucket_ms: int) -> tuple[int, bool]:

@@ -37,7 +37,7 @@ from typing import Optional
 from horaedb_tpu.common.error import Error
 from horaedb_tpu.objstore.api import NotFoundError, ObjectMeta, ObjectStore
 from horaedb_tpu.objstore.memory import MemoryObjectStore
-from horaedb_tpu.utils import registry
+from horaedb_tpu.utils import registry, tracing
 
 OPS = ("put", "get", "get_range", "head", "delete", "list", "put_stream")
 
@@ -371,7 +371,13 @@ class InstrumentedStore(WrappedObjectStore):
         objstore_<op>_seconds (histogram)
 
     NotFoundError counts in _total but not _errors_total — a missing key
-    is an answer, not a failure."""
+    is an answer, not a failure.
+
+    When a request trace is ambient (utils.tracing), each op is ALSO
+    attributed to it: `objstore_<op>_total`, wall ms, and — for
+    get/get_range — `objstore_get_bytes`, so `/debug/traces/{id}`
+    shows exactly how much store IO one query paid.  Ops after the
+    trace finished attribute to nothing (the Trace drops late adds)."""
 
     def __init__(self, inner: ObjectStore, metrics=None,
                  prefix: str = "objstore"):
@@ -392,12 +398,21 @@ class InstrumentedStore(WrappedObjectStore):
         total, errors, seconds = self._ops[op]
         total.inc()
         t0 = time.perf_counter()
+        result = None
         try:
-            return await super()._call(op, *args)
+            result = await super()._call(op, *args)
+            return result
         except NotFoundError:
             raise
         except BaseException:
             errors.inc()
             raise
         finally:
-            seconds.observe(time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            seconds.observe(dt)
+            if tracing.active_trace() is not None:
+                tracing.trace_add(f"objstore_{op}_total")
+                tracing.trace_add(f"objstore_{op}_ms", dt * 1e3)
+                if op in ("get", "get_range") and isinstance(
+                        result, (bytes, bytearray)):
+                    tracing.trace_add("objstore_get_bytes", len(result))

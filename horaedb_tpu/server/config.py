@@ -54,6 +54,25 @@ class AdmissionConfig:
 
 
 @dataclass
+class TraceConfig:
+    """[trace]: request-scoped tracing (docs/observability.md).  Every
+    query/write gets an X-Trace-Id; sampled traces record a span tree
+    into a bounded in-memory ring served at /debug/traces, and traces
+    over `slow_threshold` (or deadline-exceeded ones) hit the
+    slow-query log + the slow_queries_total counter."""
+
+    enabled: bool = True
+    # completed traces kept in memory (FIFO eviction)
+    ring_size: int = 256
+    # at/over this duration a completed trace is logged as a slow query
+    slow_threshold: ReadableDuration = field(
+        default_factory=lambda: ReadableDuration.parse("1s"))
+    # fraction of requests that record spans (the X-Trace-Id header is
+    # minted regardless; an upstream-traced request is always recorded)
+    sample_rate: float = 1.0
+
+
+@dataclass
 class TestConfig:
     """Write-load generator (ref: config.rs:48-57)."""
 
@@ -108,6 +127,8 @@ class ServerConfig:
     # durable ingest: WAL + memtable front end (wal/ingest.py); with an
     # empty dir and a Local object store, `<data_dir>/wal` is derived
     wal: WalConfig = field(default_factory=WalConfig)
+    # request-scoped tracing: ring size, slow-query threshold, sampling
+    trace: TraceConfig = field(default_factory=TraceConfig)
     metric_engine: MetricEngineConfig = field(default_factory=MetricEngineConfig)
 
 
@@ -144,6 +165,9 @@ def _dc_from_dict(cls: type, data: dict[str, Any]) -> Any:
         elif key == "wal":
             ensure(isinstance(value, dict), f"{where} expects a config table")
             kwargs[key] = _dc_from_dict(WalConfig, value)
+        elif key == "trace":
+            ensure(isinstance(value, dict), f"{where} expects a config table")
+            kwargs[key] = _dc_from_dict(TraceConfig, value)
         elif key == "metric_engine":
             ensure(isinstance(value, dict), f"{where} expects a config table")
             kwargs[key] = _dc_from_dict(MetricEngineConfig, value)
@@ -192,4 +216,7 @@ def load_config(path: Optional[str] = None) -> ServerConfig:
         ensure(kind == "Local",
                "[wal] with an empty dir requires a Local object store "
                "(it derives <data_dir>/wal); set wal.dir explicitly")
+    ensure(0.0 <= cfg.trace.sample_rate <= 1.0,
+           "[trace] sample_rate must be in [0, 1]")
+    ensure(cfg.trace.ring_size >= 1, "[trace] ring_size must be >= 1")
     return cfg

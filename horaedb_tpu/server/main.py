@@ -44,7 +44,8 @@ from horaedb_tpu.objstore import LocalObjectStore
 from horaedb_tpu.server.config import (AdmissionConfig, ServerConfig,
                                        load_config)
 from horaedb_tpu.storage.types import TimeRange
-from horaedb_tpu.utils import registry
+from horaedb_tpu.utils import registry, span
+from horaedb_tpu.utils import tracing
 
 logger = logging.getLogger(__name__)
 
@@ -149,6 +150,13 @@ class ServerState:
         self.config = config
         self.write_enabled = True
         self.admission = AdmissionController(config.admission)
+        # [trace] applies to the process-wide recorder (the ring and
+        # slow-query log are one per process, like the registry)
+        tracing.recorder.configure(
+            enabled=config.trace.enabled,
+            ring_size=config.trace.ring_size,
+            slow_threshold_s=config.trace.slow_threshold.seconds,
+            sample_rate=config.trace.sample_rate)
         # a cluster-backed server applies its [breaker] section to the
         # engine's scatter-gather policy (the setter re-points breakers
         # of already-attached remote regions too)
@@ -194,6 +202,61 @@ class ServerState:
                 logger.exception("write-load generator failed")
 
 
+def _tracing_middleware(state: ServerState):
+    """Request-scoped tracing (docs/observability.md), outermost so the
+    trace sees everything including the admission wait: mint (or adopt
+    from X-Trace-Id — a coordinating region already traced this
+    request) a trace id for every query/write, bind the trace as
+    ambient context for the handler, and on completion record it into
+    the trace ring, fire the slow-query log on threshold breach or a
+    504, and answer with X-Trace-Id + an X-Trace-Summary stage
+    breakdown.  A downstream region also exports its recorded spans on
+    X-Trace-Export so the coordinator stitches ONE distributed trace."""
+
+    del state  # config is applied to the process-global recorder
+
+    @web.middleware
+    async def middleware(request: web.Request, handler):
+        path = request.path
+        if path not in _QUERY_ENDPOINTS and path not in _WRITE_ENDPOINTS:
+            return await handler(request)
+        incoming = request.headers.get(tracing.TRACE_HEADER)
+        trace_id = incoming or tracing.new_trace_id()
+        trace = tracing.recorder.start(path, trace_id=trace_id,
+                                       forced=incoming is not None)
+        if trace is None:
+            # unsampled: the id still travels (response header +
+            # downstream propagation via the ambient contextvars being
+            # unset is fine — peers mint their own)
+            resp = await handler(request)
+            resp.headers[tracing.TRACE_HEADER] = trace_id
+            return resp
+        status = "ok"
+        with tracing.trace_scope(trace):
+            try:
+                resp = await handler(request)
+            except DeadlineExceeded:
+                tracing.recorder.finish(trace, status="timeout")
+                raise
+            except Exception:
+                tracing.recorder.finish(trace, status="error")
+                raise
+        if resp.status == 504:
+            status = "timeout"
+        elif resp.status >= 400:
+            status = "error"
+        done = tracing.recorder.finish(trace, status=status)
+        resp.headers[tracing.TRACE_HEADER] = trace.trace_id
+        resp.headers["X-Trace-Summary"] = tracing.summarize(done)
+        if incoming is not None:
+            # we are a downstream region of a traced request: hand our
+            # spans back for stitching
+            resp.headers[tracing.EXPORT_HEADER] = tracing.export_payload(done)
+        return resp
+
+    return middleware
+
+
 def _resilience_middleware(state: ServerState):
     """Request-lifecycle robustness (docs/robustness.md): mint ONE
     Deadline per request at ingress (per-endpoint default, shrinkable
@@ -233,7 +296,9 @@ def _resilience_middleware(state: ServerState):
                 wait_s = cfg.queue_timeout.seconds
                 if deadline is not None:
                     wait_s = deadline.budget(wait_s)
-                outcome = await state.admission.acquire(wait_s)
+                with span("admission_wait",
+                          queued=state.admission.queued):
+                    outcome = await state.admission.acquire(wait_s)
                 if outcome == "shed":
                     _SHED.inc()
                     return web.json_response(
@@ -382,6 +447,33 @@ def build_app(state: ServerState) -> web.Application:
         for name, table in tables.items():
             report = await table.scrub(grace_override_s=grace_s)
             out[name] = report.as_dict()
+        return web.json_response(out)
+
+    @routes.get("/debug/traces")
+    async def debug_traces(req: web.Request) -> web.Response:
+        """Newest-first summaries of recently completed traces
+        (?limit=N, default 50; docs/observability.md)."""
+        try:
+            limit = int(req.query.get("limit", "50"))
+        except ValueError:
+            return web.json_response(
+                {"error": f"bad limit: {req.query.get('limit')!r}"},
+                status=400)
+        return web.json_response({"traces": tracing.recorder.list(limit)})
+
+    @routes.get("/debug/traces/{trace_id}")
+    async def debug_trace(req: web.Request) -> web.Response:
+        """One trace as a JSON span tree: per-stage durations, cache
+        tier hits, object-store GETs/bytes — stitched across regions
+        when the query scatter-gathered."""
+        trace_id = req.match_info["trace_id"]
+        d = tracing.recorder.get(trace_id)
+        if d is None:
+            return web.json_response(
+                {"error": f"trace {trace_id!r} not in the ring (expired "
+                          "or never sampled)"}, status=404)
+        out = tracing.span_tree(d)
+        out["summary"] = tracing.summarize(d)
         return web.json_response(out)
 
     @routes.get("/stats")
@@ -664,9 +756,11 @@ def build_app(state: ServerState) -> web.Application:
         return web.json_response({"values": vals})
 
     # sized for the Arrow-IPC bulk data plane (default 1 MiB would 413
-    # any real ingest batch)
+    # any real ingest batch); tracing is OUTERMOST so the trace covers
+    # the admission wait and the 504 mapping
     app = web.Application(client_max_size=256 * 1024 * 1024,
-                          middlewares=[_resilience_middleware(state)])
+                          middlewares=[_tracing_middleware(state),
+                                       _resilience_middleware(state)])
     app.add_routes(routes)
     return app
 

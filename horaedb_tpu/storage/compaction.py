@@ -44,7 +44,7 @@ from horaedb_tpu.storage.types import (
 if TYPE_CHECKING:
     from horaedb_tpu.storage.storage import CloudObjectStorage
 
-from horaedb_tpu.utils import registry, span
+from horaedb_tpu.utils import WIDE_BUCKETS, registry, span
 
 logger = logging.getLogger(__name__)
 
@@ -243,7 +243,10 @@ class Executor:
                 self._unmark(task)
 
     async def _do_compaction(self, task: Task) -> None:
-        with span("compaction.execute", inputs=len(task.inputs),
+        # compaction rewrites routinely outlast the default 10 s bucket
+        # ceiling — the wide layout keeps their histogram informative
+        with span("compaction.execute", buckets=WIDE_BUCKETS,
+                  inputs=len(task.inputs),
                   expireds=len(task.expireds), bytes=task.input_size):
             await self._do_compaction_traced(task)
 

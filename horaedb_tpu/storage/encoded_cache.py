@@ -38,24 +38,31 @@ from __future__ import annotations
 from collections import OrderedDict
 from typing import Optional
 
-from horaedb_tpu.utils import registry
+from horaedb_tpu.utils import registry, trace_add
 
+# tier-labeled children of the shared scan-cache families (the hbm
+# tier lives in storage/scan_cache.py); admissions/invalidated are
+# tier-2-only concepts but carry the label for a uniform query surface
 _HITS = registry.counter(
-    "encoded_cache_hits_total",
-    "tier-2 encoded-part cache hits (segment rebuilt without store IO)")
+    "scan_cache_hits_total",
+    "scan cache hits by tier").labels(tier="tier2")
 _MISSES = registry.counter(
-    "encoded_cache_misses_total", "tier-2 encoded-part cache misses")
+    "scan_cache_misses_total",
+    "scan cache misses by tier").labels(tier="tier2")
 _EVICTIONS = registry.counter(
-    "encoded_cache_evictions_total", "tier-2 byte-LRU evictions")
+    "scan_cache_evictions_total",
+    "scan cache evictions by tier").labels(tier="tier2")
 _ADMISSIONS = registry.counter(
-    "encoded_cache_admissions_total",
-    "write-through insertions from flush/compaction sidecar builds")
+    "scan_cache_admissions_total",
+    "write-through insertions from flush/compaction sidecar builds"
+    ).labels(tier="tier2")
 _INVALIDATED = registry.counter(
-    "encoded_cache_invalidated_total",
-    "tier-2 entries dropped because their SST was deleted")
+    "scan_cache_invalidated_total",
+    "cache entries dropped because their SST was deleted"
+    ).labels(tier="tier2")
 _BYTES = registry.gauge(
-    "encoded_cache_bytes",
-    "resident tier-2 bytes across all tables (host RAM)")
+    "scan_cache_bytes",
+    "resident cache bytes by tier (host RAM)").labels(tier="tier2")
 
 # negative-entry bound: clear-all on overflow (re-learning a miss costs
 # one GET; unbounded growth costs RAM forever)
@@ -152,11 +159,14 @@ class EncodedSegmentCache:
         if entry is None or not set(want) <= entry[0].keys():
             self.misses += 1
             _MISSES.inc()
+            trace_add("cache_tier2_misses")
             return None
         self._entries.move_to_end(sst_id)
         self.hits += 1
         _HITS.inc()
-        cols, n, _ = entry
+        cols, n, nbytes = entry
+        trace_add("cache_tier2_hits")
+        trace_add("cache_tier2_bytes", nbytes)
         return {nm: cols[nm] for nm in want}, n
 
     def put(self, sst_id: int, cols: dict, n_rows: int) -> None:

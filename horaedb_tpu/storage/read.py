@@ -54,7 +54,7 @@ from horaedb_tpu.storage.types import (
     TimeRange,
 )
 from horaedb_tpu.storage import parquet_io, sidecar
-from horaedb_tpu.utils import registry
+from horaedb_tpu.utils import registry, trace_add
 
 logger = logging.getLogger(__name__)
 
@@ -67,21 +67,25 @@ _ROWS_SCANNED = registry.counter(
 # through its reader, read.rs:84; ours records real numbers): seconds,
 # rows, and bytes per pipeline stage, cumulative in the registry and
 # diffable around a query for a per-query profile (bench.py does this).
+# One labeled family per unit (stage= label) instead of a metric name
+# per stage; per-QUERY attribution additionally lands on the ambient
+# trace via tracing.trace_add (docs/observability.md).
 _PLAN_STAGES = ("parquet_read", "sidecar_read", "encode_merge",
                 "stack_build", "device_aggregate", "combine")
 _STAGE_SECONDS = {
-    s: registry.histogram(f"scan_stage_{s}_seconds",
-                          f"wall seconds spent in the {s} stage")
+    s: registry.histogram("scan_stage_seconds",
+                          "wall seconds per merge-scan plan stage"
+                          ).labels(stage=s)
     for s in _PLAN_STAGES
 }
 _STAGE_ROWS = {
-    s: registry.counter(f"scan_stage_{s}_rows_total",
-                        f"rows entering the {s} stage")
+    s: registry.counter("scan_stage_rows_total",
+                        "rows entering each plan stage").labels(stage=s)
     for s in ("parquet_read", "sidecar_read", "encode_merge")
 }
 _STAGE_BYTES = {
-    s: registry.counter(f"scan_stage_{s}_bytes_total",
-                        f"bytes entering the {s} stage")
+    s: registry.counter("scan_stage_bytes_total",
+                        "bytes entering each plan stage").labels(stage=s)
     for s in ("parquet_read", "sidecar_read", "stack_build")
 }
 # cache-effectiveness counters (ops parity with scan_cache_*): the
@@ -123,7 +127,10 @@ def _stack_counters(key: tuple):
 
 
 def _timed_stage(stage: str):
-    """Decorator: attribute a function's wall time to a plan stage."""
+    """Decorator: attribute a function's wall time to a plan stage —
+    both the cumulative registry histogram and (when a request trace is
+    ambient; runtimes.run copies the context onto pool threads) the
+    per-query trace profile."""
     def deco(fn):
         @functools.wraps(fn)
         def wrapper(*args, **kwargs):
@@ -131,7 +138,9 @@ def _timed_stage(stage: str):
             try:
                 return fn(*args, **kwargs)
             finally:
-                _STAGE_SECONDS[stage].observe(time.perf_counter() - t0)
+                dt = time.perf_counter() - t0
+                _STAGE_SECONDS[stage].observe(dt)
+                trace_add(f"stage_{stage}_ms", dt * 1e3)
         return wrapper
     return deco
 
@@ -833,6 +842,9 @@ class ParquetReader:
             _STAGE_SECONDS[stage].observe(read_s)
             _STAGE_ROWS[stage].inc(table.num_rows)
             _STAGE_BYTES[stage].inc(table.nbytes)
+            trace_add(f"stage_{stage}_ms", read_s * 1e3)
+            trace_add(f"stage_{stage}_rows", table.num_rows)
+            trace_add(f"stage_{stage}_bytes", table.nbytes)
             return table, read_s
 
         tasks = [asyncio.create_task(read(seg)) for seg in segments]
@@ -1014,6 +1026,8 @@ class ParquetReader:
             # otherwise double-count the already-yielded windows
             _STAGE_ROWS["sidecar_read"].inc(rows)
             _STAGE_BYTES["sidecar_read"].inc(nbytes)
+            trace_add("stage_sidecar_read_rows", rows)
+            trace_add("stage_sidecar_read_bytes", nbytes)
 
         return gen()
 

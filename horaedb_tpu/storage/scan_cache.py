@@ -23,12 +23,18 @@ from __future__ import annotations
 
 from collections import OrderedDict
 
-from horaedb_tpu.utils import registry
+from horaedb_tpu.utils import registry, trace_add
 
-_HITS = registry.counter("scan_cache_hits_total", "scan cache hits")
-_MISSES = registry.counter("scan_cache_misses_total", "scan cache misses")
+# shared labeled families across the cache tiers (tier="hbm" here,
+# tier="tier2" in storage/encoded_cache.py) — one series per tier
+# instead of per-tier metric names
+_HITS = registry.counter("scan_cache_hits_total",
+                         "scan cache hits by tier").labels(tier="hbm")
+_MISSES = registry.counter("scan_cache_misses_total",
+                           "scan cache misses by tier").labels(tier="hbm")
 _EVICTIONS = registry.counter("scan_cache_evictions_total",
-                              "scan cache evictions")
+                              "scan cache evictions by tier"
+                              ).labels(tier="hbm")
 
 CacheKey = tuple
 
@@ -63,7 +69,7 @@ class ByteLRU:
     core is operator-visible on /metrics."""
 
     def __init__(self, max_bytes: int, hits=None, misses=None,
-                 evictions=None):
+                 evictions=None, trace_tier: str = ""):
         self.max_bytes = max_bytes
         self._entries: "OrderedDict[CacheKey, tuple[object, int]]" = \
             OrderedDict()
@@ -73,6 +79,11 @@ class ByteLRU:
         self._evictions = evictions
         self.hits = 0
         self.misses = 0
+        # per-query attribution name ("cache_<tier>_*" trace counters
+        # on the ambient trace); "" = no trace attribution — each LRU
+        # built on this core must name its own tier, exactly like it
+        # passes its own registry counters
+        self.trace_tier = trace_tier
 
     def get(self, key: CacheKey):
         entry = self._entries.get(key)
@@ -80,11 +91,16 @@ class ByteLRU:
             self.misses += 1
             if self._misses is not None:
                 self._misses.inc()
+            if self.trace_tier:
+                trace_add(f"cache_{self.trace_tier}_misses")
             return None
         self._entries.move_to_end(key)
         self.hits += 1
         if self._hits is not None:
             self._hits.inc()
+        if self.trace_tier:
+            trace_add(f"cache_{self.trace_tier}_hits")
+            trace_add(f"cache_{self.trace_tier}_bytes", entry[1])
         return entry[0]
 
     def put(self, key: CacheKey, value, nbytes: int) -> None:
@@ -123,7 +139,7 @@ class ScanCache(ByteLRU):
 
     def __init__(self, max_bytes: int):
         super().__init__(max_bytes, hits=_HITS, misses=_MISSES,
-                         evictions=_EVICTIONS)
+                         evictions=_EVICTIONS, trace_tier="hbm")
 
     def put(self, key: CacheKey, windows: list) -> None:  # type: ignore[override]
         super().put(key, windows, windows_nbytes(windows))

@@ -1,8 +1,13 @@
 """Cross-cutting utilities: observability registry + tracing spans."""
 
-from horaedb_tpu.utils.metrics import (Counter, Gauge, Histogram,
-                                       MetricsRegistry, registry)
-from horaedb_tpu.utils.tracing import current_span, span
+from horaedb_tpu.utils.metrics import (WIDE_BUCKETS, Counter, Gauge,
+                                       Histogram, MetricsRegistry, registry)
+from horaedb_tpu.utils.tracing import (active_trace, current_span,
+                                       current_trace_id, new_trace_id,
+                                       recorder, span, trace_add,
+                                       trace_scope)
 
-__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
-           "current_span", "registry", "span"]
+__all__ = ["WIDE_BUCKETS", "Counter", "Gauge", "Histogram",
+           "MetricsRegistry", "active_trace", "current_span",
+           "current_trace_id", "new_trace_id", "recorder", "registry",
+           "span", "trace_add", "trace_scope"]

@@ -1,9 +1,17 @@
-"""Minimal counters/histograms registry.
+"""Minimal counters/histograms registry with Prometheus-style labels.
 
 The reference has logging only (SURVEY.md section 5: "Our build should
 add a minimal counters/histograms registry from day one since the
 north-star metric is a latency").  Exposed by the server at /metrics in
 Prometheus text format.
+
+Labels: every metric is a *family*; `family.labels(table="cpu")`
+returns a child series keyed by the sorted label set, rendered as
+`name{table="cpu"} value`.  The family object itself doubles as the
+label-less series (back-compat: call sites that never use labels are
+unchanged), but once a family has children the bare series is only
+rendered if it was actually touched — a purely-labeled family must not
+scrape a phantom `name 0` line.
 """
 
 from __future__ import annotations
@@ -16,73 +24,171 @@ from typing import Optional
 _DEFAULT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
                     0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
 
+# long-running operations (compaction rewrites, memtable flushes, cold
+# object-store scans): the default buckets top out at 10 s, which
+# flattens everything slower into +Inf — these extend to 10 minutes
+WIDE_BUCKETS = (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                10.0, 30.0, 60.0, 120.0, 300.0, 600.0)
 
-class Counter:
-    __slots__ = ("name", "help", "_value", "_lock")
 
-    def __init__(self, name: str, help_: str = ""):
+def _escape(value: object) -> str:
+    return (str(value).replace("\\", r"\\").replace('"', r'\"')
+            .replace("\n", r"\n"))
+
+
+def _label_str(labels: tuple) -> str:
+    return "{" + ",".join(f'{k}="{_escape(v)}"' for k, v in labels) + "}"
+
+
+class _Family:
+    """Shared label plumbing: child creation + series naming.  A child
+    is a full metric instance of the same class with `_labels` set; it
+    renders series lines only (HELP/TYPE come from the family)."""
+
+    __slots__ = ()
+
+    def _init_family(self, labels: tuple) -> None:
+        self._labels = labels
+        self._children: Optional[dict] = None
+        self._touched = False
+
+    def _series(self, suffix: str = "") -> str:
+        if self._labels:
+            return f"{self.name}{suffix}" + _label_str(self._labels)
+        return f"{self.name}{suffix}"
+
+    def labels(self, **kv):
+        """Child series for this label set (created on first use).
+        Children are cached — `family.labels(table="x")` is cheap enough
+        for per-call use, but hot paths should bind the child once."""
+        if not kv:
+            return self
+        assert not self._labels, "labels() on a labeled child"
+        key = tuple(sorted(kv.items()))
+        with self._lock:
+            if self._children is None:
+                self._children = {}
+            child = self._children.get(key)
+            if child is None:
+                child = self._new_child(key)
+                self._children[key] = child
+            return child
+
+    def _snapshot_children(self) -> list:
+        with self._lock:
+            return [] if not self._children else list(
+                self._children.values())
+
+    def _render_base(self) -> bool:
+        """Whether the label-less series line should be emitted: always
+        for a never-labeled metric (back-compat), only-if-touched once
+        labeled children exist."""
+        return self._children is None or self._touched
+
+    def _header(self, kind: str) -> list:
+        return [f"# HELP {self.name} {self.help}",
+                f"# TYPE {self.name} {kind}"]
+
+
+class Counter(_Family):
+    __slots__ = ("name", "help", "_value", "_lock", "_labels", "_children",
+                 "_touched")
+
+    def __init__(self, name: str, help_: str = "", labels: tuple = ()):
         self.name = name
         self.help = help_
         self._value = 0.0
         self._lock = threading.Lock()
+        self._init_family(labels)
+
+    def _new_child(self, key: tuple) -> "Counter":
+        return Counter(self.name, self.help, labels=key)
 
     def inc(self, amount: float = 1.0) -> None:
         with self._lock:
             self._value += amount
+            self._touched = True
 
     @property
     def value(self) -> float:
         return self._value
 
+    @property
+    def total(self) -> float:
+        """Family-wide sum: the bare series plus every labeled child."""
+        return self._value + sum(c._value
+                                 for c in self._snapshot_children())
+
+    def _series_lines(self) -> list:
+        return [f"{self._series()} {self._value}"]
+
     def render(self) -> str:
-        return (f"# HELP {self.name} {self.help}\n"
-                f"# TYPE {self.name} counter\n"
-                f"{self.name} {self._value}\n")
+        out = self._header("counter")
+        if self._render_base():
+            out += self._series_lines()
+        for child in self._snapshot_children():
+            out += child._series_lines()
+        return "\n".join(out) + "\n"
 
 
-class Gauge:
+class Gauge(_Family):
     """A value that goes up and down (queue depth, active queries,
     breaker state).  Rendered with the Prometheus `gauge` type."""
 
-    __slots__ = ("name", "help", "_value", "_lock")
+    __slots__ = ("name", "help", "_value", "_lock", "_labels", "_children",
+                 "_touched")
 
-    def __init__(self, name: str, help_: str = ""):
+    def __init__(self, name: str, help_: str = "", labels: tuple = ()):
         self.name = name
         self.help = help_
         self._value = 0.0
         self._lock = threading.Lock()
+        self._init_family(labels)
+
+    def _new_child(self, key: tuple) -> "Gauge":
+        return Gauge(self.name, self.help, labels=key)
 
     def set(self, value: float) -> None:
         with self._lock:
             self._value = value
+            self._touched = True
 
     def inc(self, amount: float = 1.0) -> None:
         with self._lock:
             self._value += amount
+            self._touched = True
 
     def dec(self, amount: float = 1.0) -> None:
         with self._lock:
             self._value -= amount
+            self._touched = True
 
     @property
     def value(self) -> float:
         return self._value
 
+    def _series_lines(self) -> list:
+        return [f"{self._series()} {self._value}"]
+
     def render(self) -> str:
-        return (f"# HELP {self.name} {self.help}\n"
-                f"# TYPE {self.name} gauge\n"
-                f"{self.name} {self._value}\n")
+        out = self._header("gauge")
+        if self._render_base():
+            out += self._series_lines()
+        for child in self._snapshot_children():
+            out += child._series_lines()
+        return "\n".join(out) + "\n"
 
 
 _RESERVOIR_SIZE = 4096
 
 
-class Histogram:
+class Histogram(_Family):
     __slots__ = ("name", "help", "buckets", "_counts", "_sum", "_count",
-                 "_lock", "_samples", "_rng")
+                 "_lock", "_samples", "_rng", "_labels", "_children",
+                 "_touched")
 
     def __init__(self, name: str, help_: str = "",
-                 buckets: tuple = _DEFAULT_BUCKETS):
+                 buckets: tuple = _DEFAULT_BUCKETS, labels: tuple = ()):
         self.name = name
         self.help = help_
         self.buckets = buckets
@@ -95,6 +201,12 @@ class Histogram:
         # quantiles track steady state, not start-up
         self._samples: list[float] = []
         self._rng = random.Random(0x5EA)
+        self._init_family(labels)
+
+    def _new_child(self, key: tuple) -> "Histogram":
+        # children share the family's bucket layout so the le= grid is
+        # consistent across every series of the family
+        return Histogram(self.name, self.help, self.buckets, labels=key)
 
     def observe(self, value: float) -> None:
         with self._lock:
@@ -102,6 +214,7 @@ class Histogram:
             self._counts[idx] += 1
             self._sum += value
             self._count += 1
+            self._touched = True
             if len(self._samples) < _RESERVOIR_SIZE:
                 self._samples.append(value)
             else:
@@ -124,16 +237,25 @@ class Histogram:
             s = sorted(self._samples)
             return s[min(len(s) - 1, int(q * len(s)))]
 
-    def render(self) -> str:
-        out = [f"# HELP {self.name} {self.help}",
-               f"# TYPE {self.name} histogram"]
+    def _series_lines(self) -> list:
+        out = []
         acc = 0
+        base = (_label_str(self._labels)[1:-1] + ","
+                if self._labels else "")
         for b, c in zip(self.buckets, self._counts):
             acc += c
-            out.append(f'{self.name}_bucket{{le="{b}"}} {acc}')
-        out.append(f'{self.name}_bucket{{le="+Inf"}} {self._count}')
-        out.append(f"{self.name}_sum {self._sum}")
-        out.append(f"{self.name}_count {self._count}")
+            out.append(f'{self.name}_bucket{{{base}le="{b}"}} {acc}')
+        out.append(f'{self.name}_bucket{{{base}le="+Inf"}} {self._count}')
+        out.append(f"{self._series('_sum')} {self._sum}")
+        out.append(f"{self._series('_count')} {self._count}")
+        return out
+
+    def render(self) -> str:
+        out = self._header("histogram")
+        if self._render_base():
+            out += self._series_lines()
+        for child in self._snapshot_children():
+            out += child._series_lines()
         return "\n".join(out) + "\n"
 
 
@@ -171,8 +293,13 @@ class MetricsRegistry:
             return m
 
     def render(self) -> str:
+        # snapshot the metric list under the registry lock, render
+        # OUTSIDE it (each metric takes its own lock) — a scrape must
+        # never serialize against metric registration — and sort by
+        # name so scrapes are stable/diffable
         with self._lock:
-            return "".join(m.render() for m in self._metrics.values())
+            metrics = sorted(self._metrics.items())
+        return "".join(m.render() for _name, m in metrics)
 
 
 registry = MetricsRegistry()

@@ -51,7 +51,7 @@ from horaedb_tpu.storage.storage import (
     WriteRequest,
     WriteResult,
 )
-from horaedb_tpu.utils import registry
+from horaedb_tpu.utils import WIDE_BUCKETS, registry, span, trace_add
 from horaedb_tpu.wal.config import WalConfig
 from horaedb_tpu.wal.log import Wal
 from horaedb_tpu.wal.memtable import MemEntry, Memtable
@@ -180,9 +180,14 @@ class IngestStorage(TimeMergeStorage):
         self.inner.validate_write(req)
         t0 = time.perf_counter()
         seq = SstFile.allocate_id()
-        size = await self.wal.append(seq, req.time_range, req.batch)
+        # the span covers frame + enqueue + the group-commit fsync wait
+        # (the ack point) — the write path's per-query profile
+        with span("wal_append_fsync", rows=req.batch.num_rows):
+            size = await self.wal.append(seq, req.time_range, req.batch)
+        trace_add("wal_append_bytes", size)
         # the fsync ack point: the rows are durable from here on
-        seg = self._insert(seq, req.batch, req.time_range)
+        with span("memtable_insert"):
+            seg = self._insert(seq, req.batch, req.time_range)
         self._maybe_wake_flusher(self._memtables.get(seg))
         _ACK_LATENCY.observe(time.perf_counter() - t0)
         return WriteResult(id=seq, seq=seq, size=size)
@@ -278,7 +283,11 @@ class IngestStorage(TimeMergeStorage):
                 if table is not None:
                     if self._on_op is not None:
                         self._on_op("flush")
-                    await self.inner.write_stamped(table, rng)
+                    # flushes run seconds-to-minutes on big memtables:
+                    # the wide buckets keep them out of the +Inf bin
+                    with span("memtable_flush", buckets=WIDE_BUCKETS,
+                              segment=seg, rows=mt.rows):
+                        await self.inner.write_stamped(table, rng)
             except BaseException:
                 # the rows are acked: put them back so reads keep
                 # serving them; the WAL still covers them for replay
@@ -368,17 +377,21 @@ class IngestStorage(TimeMergeStorage):
             if batch is not None:
                 buffered.setdefault(seg, []).append(batch)
                 continue
-            out = merge_memtable_overlay(
-                schema, buffered.pop(seg, []), overlay.pop(seg, []),
-                req.predicate, columns, keep_builtin)
+            with span("memtable_overlay", segment=seg):
+                out = merge_memtable_overlay(
+                    schema, buffered.pop(seg, []), overlay.pop(seg, []),
+                    req.predicate, columns, keep_builtin)
             if out is not None and out.num_rows:
+                trace_add("memtable_overlay_rows", out.num_rows)
                 yield out
         # segments living only in memtables (no SSTs yet)
         for seg in sorted(overlay):
-            out = merge_memtable_overlay(
-                schema, [], overlay[seg], req.predicate, columns,
-                keep_builtin)
+            with span("memtable_overlay", segment=seg):
+                out = merge_memtable_overlay(
+                    schema, [], overlay[seg], req.predicate, columns,
+                    keep_builtin)
             if out is not None and out.num_rows:
+                trace_add("memtable_overlay_rows", out.num_rows)
                 yield out
 
     async def scan_aggregate(self, req: ScanRequest, spec,

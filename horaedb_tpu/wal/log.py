@@ -48,24 +48,28 @@ logger = logging.getLogger(__name__)
 _HEADER = struct.Struct("<II")   # payload_len, crc32
 _META = struct.Struct("<Qqq")    # seq, range_start, range_end
 
+# label conventions (docs/observability.md): every per-log series
+# carries log=<dir basename> so multi-table nodes separate cleanly;
+# each Wal instance binds its children once in __init__
 _APPENDS = registry.counter(
-    "wal_appends_total", "records appended to the WAL")
+    "wal_appends_total", "records appended to the WAL, by log")
 _GROUP_COMMITS = registry.counter(
-    "wal_group_commits_total", "group commits (one fsync each)")
+    "wal_group_commits_total", "group commits (one fsync each), by log")
 _BYTES_WRITTEN = registry.counter(
-    "wal_bytes_written_total", "bytes appended to WAL segments")
+    "wal_bytes_written_total", "bytes appended to WAL segments, by log")
 _REPLAYED_RECORDS = registry.counter(
-    "wal_replayed_records_total", "records recovered by replay")
+    "wal_replayed_records_total", "records recovered by replay, by log")
 _REPLAY_CORRUPT = registry.counter(
     "wal_replay_corrupt_records_total",
     "torn/corrupt records skipped during replay")
 _TRUNCATED_SEGMENTS = registry.counter(
-    "wal_truncated_segments_total", "fully-flushed WAL segments deleted")
+    "wal_truncated_segments_total",
+    "fully-flushed WAL segments deleted, by log")
 _BACKLOG = registry.gauge(
     "wal_backlog_bytes",
-    "bytes in WAL segments of open logs not yet truncated")
+    "bytes in WAL segments of open logs not yet truncated, by log")
 _SEGMENTS = registry.gauge(
-    "wal_segments", "live WAL segment files of open logs")
+    "wal_segments", "live WAL segment files of open logs, by log")
 
 
 class WalError(Error):
@@ -151,6 +155,14 @@ class Wal:
                  on_op: Optional[Callable[[str], None]] = None):
         self.dir = wal_dir
         self.config = config
+        lab = {"log": os.path.basename(os.path.normpath(wal_dir)) or "wal"}
+        self._m_appends = _APPENDS.labels(**lab)
+        self._m_group_commits = _GROUP_COMMITS.labels(**lab)
+        self._m_bytes_written = _BYTES_WRITTEN.labels(**lab)
+        self._m_replayed = _REPLAYED_RECORDS.labels(**lab)
+        self._m_truncated = _TRUNCATED_SEGMENTS.labels(**lab)
+        self._m_backlog = _BACKLOG.labels(**lab)
+        self._m_segments = _SEGMENTS.labels(**lab)
         self._run_blocking = run_blocking or asyncio.to_thread
         self._on_op = on_op
         self._active: Optional[_Segment] = None
@@ -194,10 +206,10 @@ class Wal:
                 seg.pending.add(rec.seq)
                 out.append(rec)
             self._sealed[seg_id] = seg
-            _BACKLOG.inc(seg.size)
-            _SEGMENTS.inc()
+            self._m_backlog.inc(seg.size)
+            self._m_segments.inc()
         self._next_id = max(ids, default=0) + 1
-        _REPLAYED_RECORDS.inc(len(out))
+        self._m_replayed.inc(len(out))
         return out
 
     def start(self) -> None:
@@ -229,11 +241,11 @@ class Wal:
         # the backlog gauge tracks OPEN logs; the on-disk bytes persist
         # and re-register at the next replay
         for seg in list(self._sealed.values()):
-            _BACKLOG.inc(-seg.size)
-            _SEGMENTS.inc(-1)
+            self._m_backlog.inc(-seg.size)
+            self._m_segments.inc(-1)
         if self._active is not None:
-            _BACKLOG.inc(-self._active.size)
-            _SEGMENTS.inc(-1)
+            self._m_backlog.inc(-self._active.size)
+            self._m_segments.inc(-1)
         self._sealed = {}
         self._active = None
 
@@ -310,10 +322,10 @@ class Wal:
         seg.size += size
         for blob, seq, _ in group:
             seg.pending.add(seq)
-        _APPENDS.inc(len(group))
-        _GROUP_COMMITS.inc()
-        _BYTES_WRITTEN.inc(size)
-        _BACKLOG.inc(size)
+        self._m_appends.inc(len(group))
+        self._m_group_commits.inc()
+        self._m_bytes_written.inc(size)
+        self._m_backlog.inc(size)
         for blob, _, fut in group:
             if not fut.done():
                 fut.set_result(len(blob))
@@ -377,7 +389,7 @@ class Wal:
         f = await self._run_blocking(self._open_segment_blocking, path)
         self._active = _Segment(id=seg_id, path=path, size=0)
         self._active_file = f
-        _SEGMENTS.inc()
+        self._m_segments.inc()
 
     def _open_segment_blocking(self, path: str):
         os.makedirs(self.dir, exist_ok=True)
@@ -426,9 +438,9 @@ class Wal:
             for seg in dead:
                 await self._run_blocking(self._unlink_blocking, seg.path)
                 self._sealed.pop(seg.id, None)
-                _TRUNCATED_SEGMENTS.inc()
-                _BACKLOG.inc(-seg.size)
-                _SEGMENTS.inc(-1)
+                self._m_truncated.inc()
+                self._m_backlog.inc(-seg.size)
+                self._m_segments.inc(-1)
             return len(dead)
 
     def _unlink_blocking(self, path: str) -> None:

@@ -3,7 +3,12 @@ randomized invariants beyond the example-based suites."""
 
 import numpy as np
 import pyarrow as pa
-from hypothesis import given, settings, strategies as st
+import pytest
+
+# the deployment image has no hypothesis; the module must SKIP cleanly
+# rather than fail tier-1 collection
+pytest.importorskip("hypothesis")
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from horaedb_tpu.metric_engine import chunks
 from horaedb_tpu.ops import encode_batch, decode_to_arrow, merge_dedup_last, pad_capacity

@@ -57,10 +57,16 @@ def _empty_table() -> pa.Table:
 
 
 def metric_value(text: str, name: str):
+    """Sum the series of `name` in Prometheus text: a bare series
+    matches exactly; a labeled family (`name{...}` lines) sums across
+    its label sets.  `name` may itself carry a label prefix to pin one
+    series (e.g. 'x_total{region="7"')."""
+    total = None
     for line in text.splitlines():
-        if line.startswith(name + " "):
-            return float(line.split()[1])
-    return None
+        if line.startswith(name) and len(line) > len(name) \
+                and line[len(name)] in ' {,}':
+            total = (total or 0.0) + float(line.split()[-1])
+    return total
 
 
 # ---------------------------------------------------------------------------
@@ -161,18 +167,22 @@ class TestCircuitBreaker:
         assert br.state == CLOSED
 
     def test_transitions_feed_metrics_counters(self):
-        opened0 = breaker_mod._OPENED.value
-        half0 = breaker_mod._HALF_OPENED.value
-        closed0 = breaker_mod._CLOSED.value
+        # per-region + per-target-state labeled series on ONE family
+        fam = breaker_mod._TRANSITIONS
+        opened0 = fam.labels(region="r", to=OPEN).value
+        half0 = fam.labels(region="r", to=HALF_OPEN).value
+        closed0 = fam.labels(region="r", to=CLOSED).value
+        total0 = fam.total
         br = CircuitBreaker("r", _breaker_cfg())
         br.record_failure()
         br.record_failure()
         br.on_ping_ok()
         assert br.allow()
         br.record_success()
-        assert breaker_mod._OPENED.value == opened0 + 1
-        assert breaker_mod._HALF_OPENED.value == half0 + 1
-        assert breaker_mod._CLOSED.value == closed0 + 1
+        assert fam.labels(region="r", to=OPEN).value == opened0 + 1
+        assert fam.labels(region="r", to=HALF_OPEN).value == half0 + 1
+        assert fam.labels(region="r", to=CLOSED).value == closed0 + 1
+        assert fam.total == total0 + 3
 
     def test_disabled_breaker_always_allows(self):
         br = CircuitBreaker("r", _breaker_cfg(enabled=False))
@@ -817,7 +827,8 @@ class TestOverloadChaos:
                 assert metric_value(
                     m, "cluster_gather_partial_total") >= 1
                 assert metric_value(
-                    m, "cluster_breaker_opened_total") >= 1
+                    m, 'cluster_breaker_transitions_total{region="7",'
+                       'to="open"}') >= 1
                 assert metric_value(
                     m, "cluster_breaker_rejected_total") >= 1
             finally:

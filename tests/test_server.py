@@ -45,9 +45,11 @@ class TestEndpoints:
                 assert r.status == 200
                 body = await r.text()
                 # per-plan-stage attribution is exported (VERDICT r2 #9)
+                # as ONE labeled family (docs/observability.md)
+                assert "scan_stage_seconds" in body
                 for stage in ("parquet_read", "encode_merge",
                               "device_aggregate", "combine"):
-                    assert f"scan_stage_{stage}_seconds" in body, stage
+                    assert f'stage="{stage}"' in body, stage
             finally:
                 await client.close()
                 await engine.close()

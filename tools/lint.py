@@ -24,6 +24,10 @@ Checks, all hard failures:
     reader's alone — writers insert through the tiered admission API
     (EncodedSegmentCache.admit), so cache-coherence reasoning lives in
     exactly one module (storage/encoded_cache.py's docstring)
+  - metric registration hygiene under horaedb_tpu/: every
+    `registry.counter/gauge/histogram(...)` call must pass non-empty
+    help text (docs/observability.md — /metrics is an operator
+    surface; a bare series name is not documentation)
 
 Usage: python tools/lint.py [paths...]   (default: horaedb_tpu tests
 bench.py __graft_entry__.py)
@@ -129,6 +133,43 @@ def _tiered_cache_violation(node: ast.Call) -> bool:
     return any(tok in part for part in chain for tok in _CACHE_TOKENS)
 
 
+# metric-factory methods on a registry object; any such call under
+# horaedb_tpu/ must pass non-empty help text (positional or help_=)
+_METRIC_FACTORIES = {"counter", "gauge", "histogram"}
+
+
+def _metric_call_without_help(node: ast.Call) -> bool:
+    """True for `<...registry...>.counter/gauge/histogram(...)` calls
+    whose help text is missing or an empty string literal.  Receivers
+    are matched on the token "registry"/"metrics" (registry,
+    self.registry, metrics, ...) so unrelated .counter() methods on
+    other objects don't trip the rule."""
+    func = node.func
+    if not isinstance(func, ast.Attribute) \
+            or func.attr not in _METRIC_FACTORIES:
+        return False
+    chain = []
+    cur = func.value
+    while isinstance(cur, ast.Attribute):
+        chain.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        chain.append(cur.id)
+    if not any("registry" in part.lower() or part.lower() == "metrics"
+               for part in chain):
+        return False
+    help_arg = None
+    if len(node.args) >= 2:
+        help_arg = node.args[1]
+    else:
+        for kw in node.keywords:
+            if kw.arg == "help_":
+                help_arg = kw.value
+    if help_arg is None:
+        return True
+    return isinstance(help_arg, ast.Constant) and help_arg.value == ""
+
+
 def lint_file(path: pathlib.Path) -> list[str]:
     problems: list[str] = []
     text = path.read_text()
@@ -200,6 +241,15 @@ def lint_file(path: pathlib.Path) -> list[str]:
                     "outside the reader — writers go through the tiered "
                     "admission API (EncodedSegmentCache.admit); see "
                     "storage/encoded_cache.py")
+        elif (isinstance(node, ast.Call) and "horaedb_tpu" in path.parts
+                and _metric_call_without_help(node)):
+            src = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+            if "noqa" not in src:
+                problems.append(
+                    f"{path}:{node.lineno}: registry metric registered "
+                    "with empty help text — /metrics is an operator "
+                    "surface; describe the series "
+                    "(docs/observability.md)")
     if "wal" in path.parts and "horaedb_tpu" in path.parts:
         problems.extend(_lint_wal_module(path, tree, lines))
     return problems

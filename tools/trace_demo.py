@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""`make trace-demo`: start a local server, write + query a metric,
+then fetch the query's trace and pretty-print its span tree.
+
+Usage: python tools/trace_demo.py [--port N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _print_tree(node: dict, depth: int = 0) -> None:
+    pad = "  " * depth
+    fields = " ".join(f"{k}={v}" for k, v in
+                      (node.get("fields") or {}).items())
+    status = node.get("status", "?")
+    mark = "" if status == "ok" else f" [{status.upper()}]"
+    print(f"{pad}{node.get('name', '?'):<28s} "
+          f"{node.get('duration_ms', 0):>9.2f} ms{mark}"
+          f"{('  ' + fields) if fields else ''}")
+    for child in node.get("children", []):
+        _print_tree(child, depth + 1)
+
+
+async def main(port: int) -> int:
+    import aiohttp
+
+    from horaedb_tpu.server.config import ServerConfig, load_config
+    from horaedb_tpu.server.main import run_server
+
+    t0 = 1_700_000_000_000
+    with tempfile.TemporaryDirectory(prefix="trace-demo-") as tmp:
+        config = load_config(None)
+        config = ServerConfig(
+            port=port, test=config.test, admission=config.admission,
+            breaker=config.breaker, wal=config.wal, trace=config.trace,
+            metric_engine=config.metric_engine)
+        config.metric_engine.object_store.data_dir = tmp
+        ready = asyncio.Event()
+        server = asyncio.create_task(run_server(config, ready=ready))
+        await asyncio.wait_for(ready.wait(), 30)
+        base = f"http://127.0.0.1:{port}"
+        async with aiohttp.ClientSession() as s:
+            timeout = aiohttp.ClientTimeout(total=30)
+            samples = [{"name": "demo.cpu",
+                        "labels": {"host": f"h{i % 4}"},
+                        "timestamp": t0 + i * 1000, "value": float(i)}
+                       for i in range(400)]
+            async with s.post(f"{base}/write",
+                              json={"samples": samples},
+                              timeout=timeout) as r:
+                assert r.status == 200, await r.text()
+                print(f"write trace:  {r.headers.get('X-Trace-Id')}  "
+                      f"({r.headers.get('X-Trace-Summary')})")
+            async with s.post(f"{base}/query", json={
+                    "metric": "demo.cpu", "start": t0,
+                    "end": t0 + 400_000, "bucket_ms": 60_000},
+                    timeout=timeout) as r:
+                assert r.status == 200, await r.text()
+                trace_id = r.headers["X-Trace-Id"]
+                print(f"query trace:  {trace_id}  "
+                      f"({r.headers.get('X-Trace-Summary')})")
+            async with s.get(f"{base}/debug/traces/{trace_id}",
+                             timeout=timeout) as r:
+                assert r.status == 200, await r.text()
+                trace = await r.json()
+        print(f"\n== span tree for {trace_id} "
+              f"(status={trace['status']}, slow={trace.get('slow')}) ==")
+        _print_tree(trace["tree"])
+        counters = {k: round(v, 2)
+                    for k, v in sorted(trace.get("counters", {}).items())}
+        print("\n== per-trace counters ==")
+        print(json.dumps(counters, indent=2))
+        server.cancel()
+        try:
+            await server
+        except (asyncio.CancelledError, Exception):
+            pass
+    return 0
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser("trace-demo")
+    parser.add_argument("--port", type=int, default=5123)
+    sys.exit(asyncio.run(main(parser.parse_args().port)))
