@@ -1,4 +1,4 @@
-.PHONY: test native bench clean verify lint chaos trace-demo
+.PHONY: test native bench clean verify lint chaos trace-demo multichip
 
 # mirrors the tier-1 invocation (fast variants of the slow suites stay
 # in-tier; `make chaos` runs the full slow schedules)
@@ -18,15 +18,20 @@ WAL_TORTURE_SCHEDULES ?= 120
 SCANCACHE_SEED ?= 1337
 SCANCACHE_SCHEDULES ?= 40
 
+ROLLUP_SEED ?= 1337
+ROLLUP_SCHEDULES ?= 24
+
 chaos:
 	TORTURE_SEED=$(TORTURE_SEED) TORTURE_SCHEDULES=$(TORTURE_SCHEDULES) \
 	WAL_TORTURE_SEED=$(WAL_TORTURE_SEED) \
 	WAL_TORTURE_SCHEDULES=$(WAL_TORTURE_SCHEDULES) \
 	SCANCACHE_SEED=$(SCANCACHE_SEED) \
 	SCANCACHE_SCHEDULES=$(SCANCACHE_SCHEDULES) \
+	ROLLUP_SEED=$(ROLLUP_SEED) \
+	ROLLUP_SCHEDULES=$(ROLLUP_SCHEDULES) \
 	python -m pytest tests/test_fault_injection.py tests/test_torture.py \
 	tests/test_objstore_middleware.py tests/test_wal.py \
-	tests/test_scan_cache.py -q
+	tests/test_scan_cache.py tests/test_rollup.py -q
 
 # stdlib AST lint gate (the reference CI runs fmt+clippy -D warnings;
 # this image ships no ruff/flake8, so the gate is tools/lint.py)
@@ -37,6 +42,12 @@ lint:
 # a throwaway local server and pretty-print its span tree + counters
 trace-demo:
 	JAX_PLATFORMS=cpu python tools/trace_demo.py
+
+# multichip dryrun with a GUARANTEED result record: even a wedged run
+# (rc=124) writes bench_results/multichip_rNN.json with an explicit
+# timeout status instead of silence (ROADMAP item 3 recording gap)
+multichip:
+	python tools/multichip_run.py --devices 8 --timeout 600
 
 # the driver-facing deliverables, end to end: lint + full suite + the
 # fixed-seed chaos gate + the multi-chip dryrun on the virtual CPU mesh

@@ -1470,9 +1470,229 @@ def run_config10(rows: int, iters: int) -> dict:
     }
 
 
+def run_config11(rows: int, iters: int) -> dict:
+    """Dashboard-mix workload: standing rollups vs the raw scan path
+    (ISSUE 6).  One engine holds `rows` of TSBS-shaped data behind a
+    seeded-latency object store; a standing (cpu, value) rollup is
+    registered and backfilled, then a dashboard mix — rotating 6h @ 1m
+    zoom windows plus full-span @ 1h overviews — is measured twice:
+
+      rollup leg  engine routing through the rollup tiers (steady
+                  state; the tier tables' HBM cache is dropped every
+                  iteration so the number is not a replay artifact)
+      raw leg     the same queries forced down the raw path with the
+                  data table's BOTH cache tiers cleared per iteration
+                  — the cold-scan cost every dashboard refresh would
+                  pay without rollups
+
+    Done-bars: rollup-served mix p50 at least 5x faster than the raw
+    cold leg, ZERO object-store data-plane reads on the rollup leg,
+    and a bit-identical cross-check of one query per shape."""
+    import os
+
+    import pyarrow as pa
+
+    from horaedb_tpu.common import ReadableDuration
+    from horaedb_tpu.metric_engine import MetricEngine
+    from horaedb_tpu.objstore import (
+        FaultInjectingStore,
+        MemoryObjectStore,
+        WrappedObjectStore,
+    )
+    from horaedb_tpu.rollup import RollupConfig
+    from horaedb_tpu.storage.config import StorageConfig, from_dict
+    from horaedb_tpu.storage.types import TimeRange
+
+    class DataGetCounter(WrappedObjectStore):
+        def __init__(self, inner):
+            super().__init__(inner)
+            self.data_gets = 0
+
+        async def _call(self, op: str, *args):
+            if op in ("get", "get_range") and str(args[0]).endswith(
+                    (".sst", ".enc")):
+                self.data_gets += 1
+            return await super()._call(op, *args)
+
+    lat_s = float(os.environ.get("BENCH_STORE_LATENCY_MS", "25")) / 1e3
+    hosts = 100
+    interval = 10_000
+    per_host = max(2160, rows // hosts)  # >= one 6h zoom window
+    span = per_host * interval
+    segment_ms = 2 * 3600 * 1000
+    T0 = (1_700_000_000_000 // segment_ms) * segment_ms
+    _check_i32_span(np.asarray([span]), "config11")
+    rng = np.random.default_rng(11)
+    n = per_host * hosts
+    ts = T0 + np.repeat(
+        np.arange(per_host, dtype=np.int64) * interval, hosts)
+    host_id = np.tile(np.arange(hosts, dtype=np.int32), per_host)
+    vals = (rng.random(n) * 100).astype(np.float64)
+    names = pa.array([f"host_{i:03d}" for i in range(hosts)])
+
+    zoom_ms = 6 * 3600 * 1000
+    hour = 3600 * 1000
+    over_span = (span // hour) * hour
+    zoom_starts = [T0 + k * ((span - zoom_ms) // 11 // hour * hour)
+                   for k in range(12)] if span > zoom_ms else [T0]
+
+    cfg = from_dict(StorageConfig, {
+        "scheduler": {"schedule_interval": "1h"},
+        "scan": {"cache_max_rows": n * 4,
+                 "cache": {"tier2_max_bytes": 2 << 30}},
+    })
+    rollup_cfg = RollupConfig(enabled=True, tiers=["1m", "1h"],
+                              specs=["cpu"],
+                              roll_interval=ReadableDuration.parse("1h"))
+
+    async def ingest(e):
+        chunk = max(1, 1_000_000 // hosts) * hosts
+        for lo in range(0, n, chunk):
+            hi = min(n, lo + chunk)
+            await e.write_arrow("cpu", ["host"], pa.record_batch({
+                "host": pa.DictionaryArray.from_arrays(
+                    pa.array(host_id[lo:hi]), names),
+                "timestamp": pa.array(ts[lo:hi], type=pa.int64()),
+                "value": pa.array(vals[lo:hi], type=pa.float64()),
+            }))
+
+    def mix_queries(e, use_rollup: bool):
+        """The dashboard mix as (shape, coroutine-factory) pairs."""
+        def zoom(k):
+            s = zoom_starts[k % len(zoom_starts)]
+            return e.query_downsample(
+                "cpu", [], TimeRange.new(s, s + min(zoom_ms, over_span)),
+                bucket_ms=60_000, aggs=("avg",), use_rollup=use_rollup)
+
+        def over(_k):
+            return e.query_downsample(
+                "cpu", [], TimeRange.new(T0, T0 + over_span),
+                bucket_ms=hour, aggs=("avg",), use_rollup=use_rollup)
+
+        return [("zoom", zoom), ("overview", over)]
+
+    async def timed_mix(e, use_rollup: bool, reps: int, reset=None):
+        times: dict[str, list] = {"zoom": [], "overview": []}
+        shapes = mix_queries(e, use_rollup)
+        for i in range(reps):
+            for shape, q in shapes:
+                if reset is not None:
+                    reset()
+                t0 = time.perf_counter()
+                await q(i)
+                times[shape].append(time.perf_counter() - t0)
+        return times
+
+    async def go():
+        out: dict = {"store_latency_ms": lat_s * 1e3}
+        store = DataGetCounter(FaultInjectingStore(
+            MemoryObjectStore(), seed=11, latency_range=(lat_s, lat_s)))
+        e = await MetricEngine.open("cfg11", store, segment_ms=segment_ms,
+                                    config=cfg, rollup_config=rollup_cfg)
+        try:
+            t0 = time.perf_counter()
+            await ingest(e)
+            out["ingest_s"] = round(time.perf_counter() - t0, 1)
+            t0 = time.perf_counter()
+            rolled = await e.rollups.roll_now()
+            out["backfill_roll_s"] = round(time.perf_counter() - t0, 1)
+            out["backfill_segments"] = rolled["cpu:value"]
+            st = (await e.rollups.stats())["specs"]["cpu:value"]
+            out["lag_seqs_after_roll"] = st["lag_seqs"]
+            out["coverage_after_roll"] = st["coverage"]
+
+            # bit-identical cross-check, one query per dashboard shape
+            for shape, q in mix_queries(e, True):
+                a = await q(0)
+                b_fns = dict(mix_queries(e, False))
+                b = await b_fns[shape](0)
+                assert a["tsids"] == b["tsids"], shape
+                for k in b["aggs"]:
+                    assert (np.asarray(a["aggs"][k]).tobytes()
+                            == np.asarray(b["aggs"][k]).tobytes()), \
+                        (shape, k)
+
+            gets_mark = store.data_gets
+
+            def leg_gets() -> int:
+                nonlocal gets_mark
+                prev, gets_mark = gets_mark, store.data_gets
+                return gets_mark - prev
+
+            data_reader = e.tables["data"].reader
+
+            def drop_tier_hbm():
+                for t in e.rollups.tiers.values():
+                    t.reader.scan_cache.clear()
+
+            def drop_data_tiers():
+                data_reader.scan_cache.clear()
+                data_reader.encoded_cache.clear()
+
+            # rollup-served leg: tier HBM dropped per query so the
+            # number is a real cell read, not a replay artifact
+            roll_times = await timed_mix(e, True, max(iters, 10),
+                                         reset=drop_tier_hbm)
+            out["data_gets_rollup_leg"] = leg_gets()
+            # raw cold leg: both data-table cache tiers cleared per
+            # query — the no-rollup dashboard-refresh cost
+            k_cold = max(3, iters // 3)
+            raw_times = await timed_mix(e, False, k_cold,
+                                        reset=drop_data_tiers)
+            out["data_gets_raw_cold_leg"] = leg_gets()
+            served = e.rollups.specs[("cpu", "value")].served_queries
+            out["rollup_served_queries"] = served
+            for shape in ("zoom", "overview"):
+                rt, ct = roll_times[shape], raw_times[shape]
+                out[f"rollup_{shape}_p50_ms"] = round(
+                    float(np.percentile(rt, 50)) * 1e3, 3)
+                out[f"rollup_{shape}_p99_ms"] = round(
+                    float(np.percentile(rt, 99)) * 1e3, 3)
+                out[f"raw_cold_{shape}_p50_ms"] = round(
+                    float(np.percentile(ct, 50)) * 1e3, 3)
+                out[f"raw_cold_{shape}_p99_ms"] = round(
+                    float(np.percentile(ct, 99)) * 1e3, 3)
+                out[f"{shape}_speedup_p50"] = round(
+                    np.percentile(ct, 50) / np.percentile(rt, 50), 2)
+            mix_roll = roll_times["zoom"] + roll_times["overview"]
+            mix_raw = raw_times["zoom"] + raw_times["overview"]
+            out["rollup_mix_p50_ms"] = round(
+                float(np.percentile(mix_roll, 50)) * 1e3, 3)
+            out["rollup_mix_p99_ms"] = round(
+                float(np.percentile(mix_roll, 99)) * 1e3, 3)
+            out["raw_cold_mix_p50_ms"] = round(
+                float(np.percentile(mix_raw, 50)) * 1e3, 3)
+            out["raw_cold_mix_p99_ms"] = round(
+                float(np.percentile(mix_raw, 99)) * 1e3, 3)
+            out["mix_speedup_p50"] = round(
+                out["raw_cold_mix_p50_ms"] / out["rollup_mix_p50_ms"], 2)
+        finally:
+            await e.close()
+        return out
+
+    out = asyncio.run(go())
+    _log(f"config11: rollup mix p50 {out['rollup_mix_p50_ms']:.1f} ms "
+         f"(p99 {out['rollup_mix_p99_ms']:.1f}) vs raw cold "
+         f"{out['raw_cold_mix_p50_ms']:.1f} ms "
+         f"({out['mix_speedup_p50']}x) | rollup-leg data GETs "
+         f"{out['data_gets_rollup_leg']} | backfill "
+         f"{out['backfill_segments']} segs in {out['backfill_roll_s']}s")
+    return {
+        "metric": (f"dashboard mix (6h@1m zooms + full-span@1h "
+                   f"overview) served from standing rollups, "
+                   f"{n / 1e6:.1f}M rows, p50"),
+        "value": out["rollup_mix_p50_ms"],
+        "unit": "ms",
+        # done-bar: raw cold p50 / rollup p50 >= 5 (higher is better)
+        "vs_baseline": out["mix_speedup_p50"],
+        "rows": n,
+        **out,
+    }
+
+
 RUNNERS = {2: run_config2, 3: run_config3, 4: run_config4, 5: run_config5,
            6: run_config6, 7: run_config7, 8: run_config8, 9: run_config9,
-           10: run_config10}
+           10: run_config10, 11: run_config11}
 
 
 def main() -> None:

@@ -463,6 +463,9 @@ class MetricEngine:
         self.index_manager = IndexManager(tables["series"], tables["tags"],
                                           tables["index"], segment_ms)
         self.sample_manager = SampleManager(tables["data"], segment_ms)
+        # standing rollup tiers (rollup/manager.py); populated by open()
+        # when a [rollup] config enables them
+        self.rollups = None
         # chunked layout: the Append-mode data table bypasses the
         # reader's scan cache (host merge, uncached), so decoded sample
         # arrays get their own byte-budgeted LRU — keyed by (predicate,
@@ -486,13 +489,22 @@ class MetricEngine:
                    config: Optional[StorageConfig] = None,
                    chunked_data: bool = False,
                    chunk_window_ms: int = 30 * 60 * 1000,
-                   wal_config=None) -> "MetricEngine":
+                   wal_config=None, rollup_config=None) -> "MetricEngine":
         import dataclasses
 
         if chunked_data:
             ensure(chunk_window_ms <= segment_ms
                    and segment_ms % chunk_window_ms == 0,
                    "chunk window must evenly divide the segment duration")
+        # argument-only check, BEFORE any table/pool opens so a bad
+        # combination cannot leak schedulers or worker pools: the
+        # rollup maintenance/serve contract is per-cell bit equality
+        # with the row-layout downsample pushdown; the chunked (Append)
+        # layout has no such pushdown to mirror
+        if rollup_config is not None and rollup_config.enabled:
+            ensure(not chunked_data,
+                   "[rollup] requires the row data layout "
+                   "(chunked_data = false)")
         from horaedb_tpu.common import runtimes as runtimes_mod
         from horaedb_tpu.utils.compile_cache import enable_compile_cache
 
@@ -558,9 +570,27 @@ class MetricEngine:
         self = cls(tables, segment_ms, chunked_data=chunked_data,
                    chunk_window_ms=chunk_window_ms)
         self._runtimes = shared_runtimes
+        if rollup_config is not None and rollup_config.enabled:
+            from horaedb_tpu.rollup import RollupManager
+
+            try:
+                self.rollups = await RollupManager.open(
+                    root_path, store, segment_ms, rollup_config,
+                    config, shared_runtimes, tables["data"])
+            except BaseException:
+                await self.close()
+                raise
+            self.rollups.attach(self)
+            # flush completions make segments rollable (wal/ingest.py)
+            data = tables["data"]
+            if hasattr(data, "memtable_segments"):
+                data.on_flush = self.rollups.note_flush
         return self
 
     async def close(self) -> None:
+        if self.rollups is not None:
+            await self.rollups.close()
+            self.rollups = None
         for t in self.tables.values():
             await t.close()
         if getattr(self, "_runtimes", None) is not None:
@@ -624,6 +654,10 @@ class MetricEngine:
             out["memtable_bytes"] = mem_bytes
             out["wal_backlog_bytes"] = wal_backlog
             out["last_flush_age_s"] = last_flush_age
+        if self.rollups is not None:
+            # per-rollup lag (newest raw seq vs newest rolled-up seq)
+            # and segment coverage — the stale-tier alerting surface
+            out["rollups"] = await self.rollups.stats()
         return out
 
     async def flush(self) -> dict:
@@ -642,14 +676,29 @@ class MetricEngine:
         """The three-stage pipeline (ref: metric_engine README diagram)."""
         if not samples:
             return
-        with span("engine.write", samples=len(samples)):
-            await self.metric_manager.populate_metric_ids(samples)
-            await self.index_manager.populate_series_ids(samples)
-            if self.chunked_data:
-                await self.sample_manager.persist_chunked(
-                    samples, self.chunk_window_ms)
-            else:
-                await self.sample_manager.persist(samples)
+        try:
+            with span("engine.write", samples=len(samples)):
+                await self.metric_manager.populate_metric_ids(samples)
+                await self.index_manager.populate_series_ids(samples)
+                if self.chunked_data:
+                    await self.sample_manager.persist_chunked(
+                        samples, self.chunk_window_ms)
+                else:
+                    await self.sample_manager.persist(samples)
+        finally:
+            # the delta feed, noted AFTER the writes so a maintenance
+            # pass cannot consume the note while the rows are still
+            # uncommitted (acked rows then get read-your-writes
+            # dirtiness) — and in the finally so a PARTIALLY-failed
+            # multi-segment write still dirties whatever may have
+            # committed (over-dirtying is harmless, staleness is not)
+            if self.rollups is not None:
+                by_metric: dict[str, set] = {}
+                for s in samples:
+                    by_metric.setdefault(s.name, set()).add(
+                        int(Timestamp(s.timestamp).truncate_by(
+                            self.segment_ms)))
+                self.rollups.note_write(by_metric)
 
     async def write_arrow(self, metric: str, tag_columns: list[str],
                           batch: pa.RecordBatch,
@@ -788,32 +837,42 @@ class MetricEngine:
                     out,
                     TimeRange.new(int(seg_ts.min()), int(seg_ts.max()) + 1)))
 
-        if hasattr(asyncio, "TaskGroup"):  # py3.11+
-            try:
-                async with asyncio.TaskGroup() as tg:
-                    for seg in np.unique(seg_ids):
-                        tg.create_task(write_segment(int(seg)))
-            except BaseException as eg:
-                # preserve the pre-TaskGroup error surface: callers
-                # catching concrete types (Error, pa.ArrowInvalid,
-                # OSError, ...) must not be handed an ExceptionGroup;
-                # mixed-type failures still collapse to ONE exception
-                # instead of re-combining into a group.
-                if hasattr(eg, "exceptions"):
-                    raise eg.exceptions[0]
-                raise
-        else:
-            # py3.10: no TaskGroup/ExceptionGroup.  gather with
-            # return_exceptions settles EVERY sibling before the first
-            # failure propagates — the same no-write-still-running
-            # guarantee (leaking an in-flight parquet encode past the
-            # caller corrupts later work on the shared pools).
-            tasks = [asyncio.ensure_future(write_segment(int(seg)))
-                     for seg in np.unique(seg_ids)]
-            results = await asyncio.gather(*tasks, return_exceptions=True)
-            for r in results:
-                if isinstance(r, BaseException):
-                    raise r
+        try:
+            if hasattr(asyncio, "TaskGroup"):  # py3.11+
+                try:
+                    async with asyncio.TaskGroup() as tg:
+                        for seg in np.unique(seg_ids):
+                            tg.create_task(write_segment(int(seg)))
+                except BaseException as eg:
+                    # preserve the pre-TaskGroup error surface: callers
+                    # catching concrete types (Error, pa.ArrowInvalid,
+                    # OSError, ...) must not be handed an
+                    # ExceptionGroup; mixed-type failures still
+                    # collapse to ONE exception instead of re-combining
+                    # into a group.
+                    if hasattr(eg, "exceptions"):
+                        raise eg.exceptions[0]
+                    raise
+            else:
+                # py3.10: no TaskGroup/ExceptionGroup.  gather with
+                # return_exceptions settles EVERY sibling before the
+                # first failure propagates — the same
+                # no-write-still-running guarantee (leaking an
+                # in-flight parquet encode past the caller corrupts
+                # later work on the shared pools).
+                tasks = [asyncio.ensure_future(write_segment(int(seg)))
+                         for seg in np.unique(seg_ids)]
+                results = await asyncio.gather(*tasks,
+                                               return_exceptions=True)
+                for r in results:
+                    if isinstance(r, BaseException):
+                        raise r
+        finally:
+            # noted AFTER the writes, in the finally: see write() — a
+            # partially-failed batch still dirties whatever committed
+            if self.rollups is not None:
+                self.rollups.note_write(
+                    {metric: {int(s) for s in np.unique(seg_ids)}})
 
     async def _write_arrow_chunked(self, mid, fid, codes, tsid_of_code,
                                    ts_np, val_np) -> None:
@@ -1000,13 +1059,20 @@ class MetricEngine:
                                filters: list[tuple[str, str]],
                                time_range: TimeRange, bucket_ms: int,
                                field: str = "value",
-                               aggs: tuple = ALL_AGGS) -> dict:
+                               aggs: tuple = ALL_AGGS,
+                               use_rollup: bool = True) -> dict:
         """GROUP BY series, time(bucket) — the north-star query, executed
         as an aggregate pushdown: the data-table merge output is
         downsampled on device without ever materializing rows as Arrow.
         `aggs` restricts which aggregates are computed (count always
         rides along).  Returns {tsids, num_buckets,
         aggs: {agg -> (series, bucket) grid}}.
+
+        When a standing rollup covers (metric, field, bucket), the grid
+        is assembled from pre-aggregated tier cells plus a raw-computed
+        tail for the not-yet-rolled segments — bit-identical to the
+        from-raw path (docs/rollups.md).  `use_rollup=False` forces the
+        raw path (the equivalence tests' recompute side).
         """
         num_buckets, aligned = self._downsample_grid(time_range, bucket_ms)
         if self.chunked_data:
@@ -1015,14 +1081,73 @@ class MetricEngine:
                 return await self._downsample_chunked(
                     metric, filters, time_range, bucket_ms, num_buckets,
                     field=field, which=tuple(aggs))
+        if use_rollup:
+            out, resolved = await self._try_rollup_serve(
+                metric, filters, time_range, bucket_ms, num_buckets,
+                field, tuple(aggs))
+            if out is not None:
+                return out
+        else:
+            resolved = None
         with span("resolve", metric=metric):
-            pred = await self._resolve_data_predicate(metric, filters,
-                                                      time_range, field,
-                                                      ts_leaf=not aligned)
+            pred = await self._resolved_or_build_predicate(
+                metric, filters, time_range, field, not aligned, resolved)
         with span("downsample", metric=metric, bucket_ms=bucket_ms):
             return await self._scan_downsample(pred, time_range,
                                                bucket_ms, num_buckets,
                                                aggs)
+
+    def _pred_from_resolved(self, resolved, field: str,
+                            time_range: TimeRange, ts_leaf: bool):
+        """The _data_pred_parts leaf shape, rebuilt from an
+        already-resolved (mid, tsids) pair — same leaves in the same
+        order, so scan-cache keys cannot drift between the paths."""
+        mid, tsids = resolved
+        preds = [Eq("metric_id", mid), Eq("field_id", field_id_of(field))]
+        if ts_leaf:
+            preds.append(TimeRangePred("timestamp", int(time_range.start),
+                                       int(time_range.end)))
+        if tsids is not None:
+            preds.append(In("tsid", sorted(tsids)))
+        return And(preds)
+
+    async def _resolved_or_build_predicate(self, metric, filters,
+                                           time_range, field: str,
+                                           ts_leaf: bool, resolved):
+        """Raw-path predicate, reusing the rollup probe's resolve +
+        index lookup when one ran (a covered-but-lagging query must not
+        pay the index resolution twice)."""
+        if resolved is not None:
+            return self._pred_from_resolved(resolved, field, time_range,
+                                            ts_leaf)
+        return await self._resolve_data_predicate(metric, filters,
+                                                  time_range, field,
+                                                  ts_leaf=ts_leaf)
+
+    async def _try_rollup_serve(self, metric, filters, time_range,
+                                bucket_ms: int, num_buckets: int,
+                                field: str, aggs: tuple):
+        """Rollup coverage check + serve.  Returns (result, resolved):
+        result None means take the raw path; resolved carries the
+        probe's (mid, tsids) for the raw path to reuse.  All
+        rollup-tier reads route through here (the planner's coverage
+        API — tools/lint.py enforces it)."""
+        if self.rollups is None or not self.rollups.covers(
+                metric, field, bucket_ms, time_range):
+            return None, None
+        with span("rollup_plan", metric=metric, bucket_ms=bucket_ms):
+            mid = await self.metric_manager.resolve(metric, time_range)
+            if mid is None:
+                return {"tsids": [], "num_buckets": num_buckets,
+                        "aggs": {}}, None
+            tsids = await self.index_manager.find_tsids(mid, filters,
+                                                        time_range)
+            if tsids is not None and not tsids:
+                return {"tsids": [], "num_buckets": num_buckets,
+                        "aggs": {}}, None
+        out = await self.rollups.try_serve(metric, mid, tsids, time_range,
+                                           bucket_ms, field, aggs)
+        return out, (mid, tsids)
 
     def _downsample_grid(self, time_range: TimeRange,
                          bucket_ms: int) -> tuple[int, bool]:
@@ -1070,7 +1195,8 @@ class MetricEngine:
                          time_range: TimeRange, bucket_ms: int, k: int,
                          by: str = "max", largest: bool = True,
                          field: str = "value",
-                         aggs: tuple = ALL_AGGS) -> dict:
+                         aggs: tuple = ALL_AGGS,
+                         use_rollup: bool = True) -> dict:
         """Top-k series ranked by one aggregate over the window (BASELINE
         config 4's 'top-k hosts by max(cpu)' shape) — the downsample
         QueryPlan with a TopK stage on top.  Result rows come back best
@@ -1095,9 +1221,24 @@ class MetricEngine:
                 out["aggs"] = grids
             return out
         num_buckets, aligned = self._downsample_grid(time_range, bucket_ms)
-        pred = await self._resolve_data_predicate(metric, filters,
-                                                  time_range, field,
-                                                  ts_leaf=not aligned)
+        resolved = None
+        if use_rollup:
+            # a rollup-covered top-k is the covered downsample grid
+            # with the TopK stage applied host-side (the chunked path's
+            # shape) — same grids in, same slice out
+            out, resolved = await self._try_rollup_serve(
+                metric, filters, time_range, bucket_ms, num_buckets,
+                field, which)
+            if out is not None:
+                if out["tsids"]:
+                    values, grids = apply_top_k(
+                        np.asarray(out["tsids"], dtype=np.uint64),
+                        out["aggs"], TopKSpec(k=k, by=by, largest=largest))
+                    out["tsids"] = [int(t) for t in values]
+                    out["aggs"] = grids
+                return out
+        pred = await self._resolved_or_build_predicate(
+            metric, filters, time_range, field, not aligned, resolved)
         return await self._scan_downsample(
             pred, time_range, bucket_ms, num_buckets, which,
             top_k=TopKSpec(k=k, by=by, largest=largest))
@@ -1106,7 +1247,8 @@ class MetricEngine:
                                      filters: list[tuple[str, str]],
                                      time_range: TimeRange, bucket_ms: int,
                                      fields: list[str],
-                                     aggs: tuple = ALL_AGGS) -> dict:
+                                     aggs: tuple = ALL_AGGS,
+                                     use_rollup: bool = True) -> dict:
         """GROUP BY series, time(bucket) over SEVERAL fields of one
         metric (TSBS devops queries touch up to 10 fields) with ONE
         metric/index resolve shared by every field's scan.  Returns
@@ -1130,17 +1272,51 @@ class MetricEngine:
                 metric, filters, time_range, bucket_ms, field=f, aggs=aggs)
                 for f in fields}
         num_buckets, aligned = self._downsample_grid(time_range, bucket_ms)
-        parts = await self._data_pred_parts(metric, filters, time_range,
-                                            ts_leaf=not aligned)
         out = {}
+        remaining = list(fields)
+        resolved = None
+        covered = ([] if not use_rollup or self.rollups is None else
+                   [f for f in remaining if self.rollups.covers(
+                       metric, f, bucket_ms, time_range)])
+        if covered:
+            # per-field routing with ONE shared resolve: covered fields
+            # read their rollup tier, the rest reuse (mid, tsids) below
+            with span("rollup_plan", metric=metric, bucket_ms=bucket_ms):
+                mid = await self.metric_manager.resolve(metric,
+                                                        time_range)
+                tsids = (None if mid is None else
+                         await self.index_manager.find_tsids(
+                             mid, filters, time_range))
+            if mid is None or (tsids is not None and not tsids):
+                return {f: {"tsids": [], "num_buckets": num_buckets,
+                            "aggs": {}} for f in fields}
+            resolved = (mid, tsids)
+            for f in covered:
+                served = await self.rollups.try_serve(
+                    metric, mid, tsids, time_range, bucket_ms, f,
+                    tuple(aggs))
+                if served is not None:
+                    out[f] = served
+                    remaining.remove(f)
+            if not remaining:
+                return out
+        parts = None
+        if resolved is None:
+            parts = await self._data_pred_parts(metric, filters,
+                                                time_range,
+                                                ts_leaf=not aligned)
         # deliberately SEQUENTIAL: each scan already pipelines its own
         # IO against pool work, and gathering all fields was measured
         # 2x slower (config 3's redundancy factor 1.4x -> 2.7x) — ten
         # interleaved merges thrash the worker pool and caches
-        for f in fields:
-            pred = (None if parts is None else
-                    And([parts[0], Eq("field_id", field_id_of(f))]
-                        + parts[1:]))
+        for f in remaining:
+            if resolved is not None:
+                pred = self._pred_from_resolved(resolved, f, time_range,
+                                                not aligned)
+            else:
+                pred = (None if parts is None else
+                        And([parts[0], Eq("field_id", field_id_of(f))]
+                            + parts[1:]))
             out[f] = await self._scan_downsample(pred, time_range,
                                                  bucket_ms, num_buckets,
                                                  aggs)

@@ -1,0 +1,10 @@
+"""Continuous queries: standing downsample rollup tiers fed by the
+ingest path (see rollup/manager.py for the architecture and
+docs/rollups.md for the correctness contract)."""
+
+from horaedb_tpu.rollup.config import RollupConfig, rollup_from_dict
+from horaedb_tpu.rollup.manager import (CELL_SCHEMA, ROLLUP_AGGS,
+                                        RollupManager, RollupSpec)
+
+__all__ = ["CELL_SCHEMA", "ROLLUP_AGGS", "RollupConfig", "RollupManager",
+           "RollupSpec", "rollup_from_dict"]

@@ -18,6 +18,7 @@ from typing import Any, Optional
 
 from horaedb_tpu.common import Error, ReadableDuration, ensure
 from horaedb_tpu.cluster.breaker import BreakerConfig
+from horaedb_tpu.rollup.config import RollupConfig, rollup_from_dict
 from horaedb_tpu.storage.config import StorageConfig, _check_scalar
 from horaedb_tpu.storage.config import from_dict as storage_from_dict
 from horaedb_tpu.wal.config import WalConfig
@@ -127,6 +128,8 @@ class ServerConfig:
     # durable ingest: WAL + memtable front end (wal/ingest.py); with an
     # empty dir and a Local object store, `<data_dir>/wal` is derived
     wal: WalConfig = field(default_factory=WalConfig)
+    # standing rollup tiers fed by the ingest path (rollup/manager.py)
+    rollup: RollupConfig = field(default_factory=RollupConfig)
     # request-scoped tracing: ring size, slow-query threshold, sampling
     trace: TraceConfig = field(default_factory=TraceConfig)
     metric_engine: MetricEngineConfig = field(default_factory=MetricEngineConfig)
@@ -165,6 +168,9 @@ def _dc_from_dict(cls: type, data: dict[str, Any]) -> Any:
         elif key == "wal":
             ensure(isinstance(value, dict), f"{where} expects a config table")
             kwargs[key] = _dc_from_dict(WalConfig, value)
+        elif key == "rollup":
+            ensure(isinstance(value, dict), f"{where} expects a config table")
+            kwargs[key] = rollup_from_dict(value)
         elif key == "trace":
             ensure(isinstance(value, dict), f"{where} expects a config table")
             kwargs[key] = _dc_from_dict(TraceConfig, value)
@@ -219,4 +225,13 @@ def load_config(path: Optional[str] = None) -> ServerConfig:
     ensure(0.0 <= cfg.trace.sample_rate <= 1.0,
            "[trace] sample_rate must be in [0, 1]")
     ensure(cfg.trace.ring_size >= 1, "[trace] ring_size must be >= 1")
+    if cfg.rollup.enabled:
+        ensure(not cfg.metric_engine.chunked_data,
+               "[rollup] requires the row data layout "
+               "(chunked_data = false)")
+        seg = cfg.metric_engine.segment_duration.millis
+        for t in cfg.rollup.tier_millis():
+            ensure(seg % t == 0,
+                   f"[rollup] tier {t}ms must evenly divide "
+                   f"segment_duration ({seg}ms)")
     return cfg

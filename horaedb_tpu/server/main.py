@@ -414,6 +414,10 @@ def build_app(state: ServerState) -> web.Application:
                           "region's own server"}, status=501)
         for table in tables.values():
             await table.compact()
+        rollups = getattr(state.engine, "rollups", None)
+        if rollups is not None:
+            for table in rollups.tiers.values():
+                await table.compact()
         return web.Response(text="compaction triggered")
 
     @routes.get("/metrics")
@@ -447,6 +451,12 @@ def build_app(state: ServerState) -> web.Application:
         for name, table in tables.items():
             report = await table.scrub(grace_override_s=grace_s)
             out[name] = report.as_dict()
+        rollups = getattr(state.engine, "rollups", None)
+        if rollups is not None:
+            for tier_ms, table in rollups.tiers.items():
+                report = await table.scrub(grace_override_s=grace_s)
+                out[f"rollup_{rollups.tier_names[tier_ms]}"] = \
+                    report.as_dict()
         return web.json_response(out)
 
     @routes.get("/debug/traces")
@@ -498,6 +508,52 @@ def build_app(state: ServerState) -> web.Application:
             return web.json_response(await flush())
         except Error as e:
             return _error_response(e)
+
+    @routes.get("/admin/rollups")
+    async def admin_rollups_status(_req: web.Request) -> web.Response:
+        """Standing-rollup status: per-spec lag (newest raw seq vs
+        newest rolled-up seq), segment coverage, serve counters, and
+        per-tier cell volume (docs/rollups.md)."""
+        rollups = getattr(state.engine, "rollups", None)
+        if rollups is None:
+            return web.json_response(
+                {"error": "rollups are not enabled on this server "
+                          "([rollup] enabled = true)"}, status=501)
+        return web.json_response(await rollups.stats())
+
+    @routes.post("/admin/rollups")
+    async def admin_rollups(req: web.Request) -> web.Response:
+        """Register a standing downsample query: {"metric", "field"?}.
+        Optional {"roll": true} runs a synchronous maintenance pass
+        (initial backfill / test hook) before answering; registration
+        alone backfills on the next background pass."""
+        rollups = getattr(state.engine, "rollups", None)
+        if rollups is None:
+            return web.json_response(
+                {"error": "rollups are not enabled on this server "
+                          "([rollup] enabled = true)"}, status=501)
+        try:
+            body = await req.json()
+            if not isinstance(body, dict):
+                raise ValueError("body must be a JSON object")
+            metric = body.get("metric")
+            field = str(body.get("field", "value"))
+            roll = bool(body.get("roll", False))
+            if metric is not None and not isinstance(metric, str):
+                raise ValueError("metric must be a string")
+        except (TypeError, ValueError) as e:
+            return web.json_response({"error": f"bad request: {e}"},
+                                     status=400)
+        try:
+            if metric:
+                await rollups.register(metric, field)
+            rolled = await rollups.roll_now() if roll else None
+        except Error as e:
+            return _error_response(e)
+        out = await rollups.stats()
+        if rolled is not None:
+            out["rolled_segments"] = rolled
+        return web.json_response(out)
 
     @routes.post("/write")
     async def write(req: web.Request) -> web.Response:
@@ -819,7 +875,7 @@ async def run_server(config: ServerConfig,
         config=config.metric_engine.time_merge_storage,
         chunked_data=config.metric_engine.chunked_data,
         chunk_window_ms=config.metric_engine.chunk_window.millis,
-        wal_config=wal_config)
+        wal_config=wal_config, rollup_config=config.rollup)
     state = ServerState(engine, config)
     if config.test.enable_write:
         state.start_generators()

@@ -94,6 +94,11 @@ class IngestStorage(TimeMergeStorage):
         self._flush_wake: Optional[asyncio.Event] = None
         self._stopping = False
         self._last_flush_at: Optional[float] = None
+        # newest seq acked by this ingest front end (rollup lag signal)
+        self.last_seq = 0
+        # flush-commit hook: called with the segment start after an SST
+        # + manifest commit lands (the rollup manager's delta feed)
+        self.on_flush = None
 
     def __getattr__(self, name):
         inner = self.__dict__.get("inner")
@@ -188,6 +193,7 @@ class IngestStorage(TimeMergeStorage):
         # the fsync ack point: the rows are durable from here on
         with span("memtable_insert"):
             seg = self._insert(seq, req.batch, req.time_range)
+        self.last_seq = max(self.last_seq, seq)
         self._maybe_wake_flusher(self._memtables.get(seg))
         _ACK_LATENCY.observe(time.perf_counter() - t0)
         return WriteResult(id=seq, seq=seq, size=size)
@@ -312,6 +318,8 @@ class IngestStorage(TimeMergeStorage):
             self._last_flush_at = self._clock()
             _FLUSHES.inc()
             _FLUSH_ROWS.inc(mt.rows)
+            if self.on_flush is not None:
+                self.on_flush(seg)
             return mt.rows
 
     # ---- read -------------------------------------------------------------
@@ -430,6 +438,23 @@ class IngestStorage(TimeMergeStorage):
     @property
     def value_idxes(self) -> list[int]:
         return self.inner.value_idxes
+
+    def memtable_segments(self) -> set[int]:
+        """Segments with acked-but-unflushed rows (live + in-flight
+        flushes) — the rollup manager excludes them from coverage so
+        buffered rows are always served through the raw/hybrid tail."""
+        return ({seg for seg, mt in self._memtables.items() if mt.entries}
+                | {seg for seg, mts in self._flushing.items() if mts})
+
+    def oldest_unflushed_seq(self) -> Optional[int]:
+        """Min seq across acked-but-unflushed rows; None when fully
+        flushed.  The rollup lag watermark must never advance past an
+        unflushed (hence unrolled) row's seq, or a stale tier could
+        report zero lag."""
+        live = list(self._memtables.values()) + [
+            mt for mts in self._flushing.values() for mt in mts]
+        return min((e.seq for mt in live for e in mt.entries),
+                   default=None)
 
     def ingest_stats(self) -> dict:
         """The /stats surface: buffered state + WAL backlog.  Counts

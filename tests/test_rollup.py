@@ -1,0 +1,652 @@
+"""Continuous-query rollup tests: registration/backfill, bit-for-bit
+equivalence of rollup-served grids against a from-raw recompute
+(including the memtable/hybrid tail), crash recovery of rollup state,
+server wiring, and the seeded ingest/flush/compaction interleaving
+harness (knobs ROLLUP_SEED / ROLLUP_SCHEDULES, wired into
+`make chaos`; a fast variant stays in tier-1)."""
+
+import asyncio
+import os
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from horaedb_tpu.common import Error, ReadableDuration
+from horaedb_tpu.metric_engine import Label, MetricEngine, Sample
+from horaedb_tpu.objstore import MemoryObjectStore
+from horaedb_tpu.rollup import RollupConfig
+from horaedb_tpu.rollup.manager import _split3
+from horaedb_tpu.storage.config import StorageConfig, from_dict
+from horaedb_tpu.storage.types import TimeRange
+from horaedb_tpu.wal import WalConfig
+
+ROLLUP_SEED = int(os.environ.get("ROLLUP_SEED", "1337"), 0)
+ROLLUP_SCHEDULES = int(os.environ.get("ROLLUP_SCHEDULES", "24"), 0)
+
+SEG = 3_600_000
+T0 = (1_700_000_000_000 // SEG) * SEG
+AGG_SETS = [("avg",), ("sum",), ("min", "max"), ("last",),
+            ("count", "sum", "min", "max", "avg", "last")]
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def storage_cfg():
+    cfg = from_dict(StorageConfig, {
+        "scheduler": {"schedule_interval": "1h", "input_sst_min_num": 2},
+    })
+    cfg.manifest.merge_interval = ReadableDuration.parse("1h")
+    cfg.scrub.interval = ReadableDuration.parse("1h")
+    return cfg
+
+
+def rollup_cfg(tiers=("1m", "10m"), specs=("cpu",)):
+    # roll_interval long: tests drive maintenance via roll_now() so the
+    # schedules stay deterministic
+    return RollupConfig(enabled=True, tiers=list(tiers), specs=list(specs),
+                        roll_interval=ReadableDuration.parse("1h"))
+
+
+def wal_cfg(wal_dir):
+    return WalConfig(enabled=True, dir=str(wal_dir), flush_rows=10**6,
+                     flush_bytes=1 << 30,
+                     flush_age=ReadableDuration.parse("1h"),
+                     flush_interval=ReadableDuration.parse("1h"))
+
+
+async def open_engine(store, wal_dir=None, tiers=("1m", "10m"),
+                      specs=("cpu",)):
+    return await MetricEngine.open(
+        "m", store, segment_ms=SEG, config=storage_cfg(),
+        wal_config=None if wal_dir is None else wal_cfg(wal_dir),
+        rollup_config=rollup_cfg(tiers, specs))
+
+
+def batch_of(rng, n, hosts=6, span_segs=3, t0=T0):
+    ts = t0 + rng.integers(0, span_segs * SEG, n).astype(np.int64)
+    hid = rng.integers(0, hosts, n)
+    return pa.record_batch({
+        "host": pa.array([f"h{i:02d}" for i in hid]),
+        "timestamp": pa.array(ts, type=pa.int64()),
+        "value": pa.array(rng.random(n), type=pa.float64()),
+    })
+
+
+async def assert_equiv(e, metric, filters, rng_t, bucket_ms, aggs,
+                       expect_served=None):
+    """THE correctness contract: the (possibly rollup-served) result is
+    bit-identical to a forced from-raw recompute."""
+    spec = e.rollups.specs.get((metric, "value"))
+    before = spec.served_queries if spec else 0
+    a = await e.query_downsample(metric, filters, rng_t, bucket_ms,
+                                 aggs=aggs)
+    b = await e.query_downsample(metric, filters, rng_t, bucket_ms,
+                                 aggs=aggs, use_rollup=False)
+    assert a["tsids"] == b["tsids"]
+    assert a["num_buckets"] == b["num_buckets"]
+    assert set(a["aggs"]) == set(b["aggs"])
+    for k in b["aggs"]:
+        ga, gb = np.asarray(a["aggs"][k]), np.asarray(b["aggs"][k])
+        assert ga.dtype == gb.dtype and ga.shape == gb.shape, k
+        assert ga.tobytes() == gb.tobytes(), \
+            f"grid {k!r} not bit-identical (bucket={bucket_ms})"
+    if expect_served is not None and spec is not None:
+        assert (spec.served_queries - before == int(expect_served)), (
+            spec.served_queries, before, expect_served)
+    return a
+
+
+class TestSplit3:
+    def test_triple_float_split_is_exact_and_f32_safe(self):
+        rng = np.random.default_rng(7)
+        v = np.concatenate([
+            rng.random(200) * 1e3, rng.random(200) * 1e-6,
+            rng.random(200) * 1e12, np.asarray([0.0, 1.0, 2.0**52]),
+            np.float64(np.float32(rng.random(50))),  # already f32-exact
+        ])
+        hi, md, lo = _split3(v)
+        np.testing.assert_array_equal((hi + md) + lo, v)
+        for part in (hi, md, lo):
+            np.testing.assert_array_equal(
+                part.astype(np.float32).astype(np.float64), part)
+
+
+class TestRollupServing:
+    def test_backfill_and_bit_for_bit(self):
+        async def go():
+            e = await open_engine(MemoryObjectStore())
+            try:
+                rng = np.random.default_rng(ROLLUP_SEED)
+                await e.write_arrow("cpu", ["host"], batch_of(rng, 8000))
+                rolled = await e.rollups.roll_now()
+                assert rolled["cpu:value"] == 3
+                q = TimeRange.new(T0, T0 + 3 * SEG)
+                for aggs in AGG_SETS:
+                    for bucket in (60_000, 600_000):
+                        await assert_equiv(e, "cpu", [], q, bucket, aggs,
+                                           expect_served=True)
+                # label-filtered queries select the same cells
+                await assert_equiv(e, "cpu", [("host", "h03")], q, 60_000,
+                                   ("avg",), expect_served=True)
+                st = await e.stats()
+                spec = st["rollups"]["specs"]["cpu:value"]
+                assert spec["lag_seqs"] == 0
+                assert spec["rolled_segments"] == 3
+                assert spec["coverage"] == 1.0
+                assert spec["served_queries"] > 0
+            finally:
+                await e.close()
+
+        run(go())
+
+    def test_uncovered_shapes_fall_back_to_raw(self):
+        async def go():
+            e = await open_engine(MemoryObjectStore())
+            try:
+                rng = np.random.default_rng(1)
+                await e.write_arrow("cpu", ["host"], batch_of(rng, 2000))
+                await e.rollups.roll_now()
+                spec = e.rollups.specs[("cpu", "value")]
+                q = TimeRange.new(T0, T0 + 2 * SEG)
+                # 90s is not a tier; unaligned start/end; unregistered
+                # metric — all take the raw path and stay correct
+                await assert_equiv(e, "cpu", [], q, 90_000, ("avg",),
+                                   expect_served=False)
+                await assert_equiv(
+                    e, "cpu", [], TimeRange.new(T0 + 1, T0 + SEG + 1),
+                    60_000, ("avg",), expect_served=False)
+                assert not e.rollups.covers(
+                    "mem", "value", 60_000, q)
+                assert spec.served_queries == 0
+            finally:
+                await e.close()
+
+        run(go())
+
+    def test_late_write_dirties_then_rerolls(self):
+        async def go():
+            e = await open_engine(MemoryObjectStore())
+            try:
+                rng = np.random.default_rng(2)
+                await e.write_arrow("cpu", ["host"], batch_of(rng, 3000))
+                await e.rollups.roll_now()
+                q = TimeRange.new(T0, T0 + 3 * SEG)
+                await assert_equiv(e, "cpu", [], q, 60_000, ("avg",),
+                                   expect_served=True)
+                # a late write lands in a rolled bucket: queries stay
+                # correct immediately (dirty segment served via the raw
+                # tail), and again after the re-roll
+                spec = e.rollups.specs[("cpu", "value")]
+                await e.write([Sample("cpu", [Label("host", "h00")],
+                                      T0 + 5, 99.5)])
+                # the note lands in dirty — or already in rolling if
+                # the woken background pass snapshotted it first
+                assert spec.dirty or spec.rolling
+                await assert_equiv(e, "cpu", [], q, 60_000,
+                                   ("avg", "last"), expect_served=True)
+                await e.rollups.roll_now()
+                assert not spec.dirty
+                await assert_equiv(e, "cpu", [], q, 60_000,
+                                   ("avg", "last"), expect_served=True)
+            finally:
+                await e.close()
+
+        run(go())
+
+    def test_overwrite_update_supersedes_cell(self):
+        async def go():
+            e = await open_engine(MemoryObjectStore())
+            try:
+                await e.write([Sample("cpu", [Label("host", "a")],
+                                      T0 + 100, 1.0)])
+                await e.rollups.roll_now()
+                # same (series, ts) point overwritten: last-value wins
+                # end to end, including through the re-rolled cell
+                await e.write([Sample("cpu", [Label("host", "a")],
+                                      T0 + 100, 42.0)])
+                await e.rollups.roll_now()
+                q = TimeRange.new(T0, T0 + SEG)
+                out = await assert_equiv(e, "cpu", [], q, 60_000,
+                                         ("last", "count"),
+                                         expect_served=True)
+                assert np.asarray(out["aggs"]["last"])[0, 0] == 42.0
+                assert np.asarray(out["aggs"]["count"])[0, 0] == 1.0
+            finally:
+                await e.close()
+
+        run(go())
+
+    def test_topk_and_multi_field_route_through_rollups(self):
+        async def go():
+            e = await open_engine(MemoryObjectStore())
+            try:
+                rng = np.random.default_rng(4)
+                await e.write_arrow("cpu", ["host"], batch_of(rng, 3000))
+                await e.rollups.roll_now()
+                q = TimeRange.new(T0, T0 + 3 * SEG)
+                spec = e.rollups.specs[("cpu", "value")]
+                a = await e.query_topk("cpu", [], q, 60_000, k=3)
+                b = await e.query_topk("cpu", [], q, 60_000, k=3,
+                                       use_rollup=False)
+                assert spec.served_queries == 1
+                assert a["tsids"] == b["tsids"]
+                for k in b["aggs"]:
+                    assert np.asarray(a["aggs"][k]).tobytes() == \
+                        np.asarray(b["aggs"][k]).tobytes(), k
+                ma = await e.query_downsample_multi(
+                    "cpu", [], q, 60_000, fields=["value"])
+                mb = await e.query_downsample_multi(
+                    "cpu", [], q, 60_000, fields=["value"],
+                    use_rollup=False)
+                assert spec.served_queries == 2
+                assert ma["value"]["tsids"] == mb["value"]["tsids"]
+                for k in mb["value"]["aggs"]:
+                    assert np.asarray(ma["value"]["aggs"][k]).tobytes() \
+                        == np.asarray(mb["value"]["aggs"][k]).tobytes()
+            finally:
+                await e.close()
+
+        run(go())
+
+    def test_memtable_tail_hybrid(self, tmp_path):
+        async def go():
+            store = MemoryObjectStore()
+            e = await open_engine(store, wal_dir=tmp_path)
+            try:
+                rng = np.random.default_rng(5)
+                samples = [
+                    Sample("cpu", [Label("host", f"h{i % 4}")],
+                           T0 + int(rng.integers(0, 2 * SEG)),
+                           float(rng.random())) for i in range(400)]
+                await e.write(samples)
+                spec = e.rollups.specs[("cpu", "value")]
+                # everything is memtable-buffered: nothing rollable yet
+                rolled = await e.rollups.roll_now()
+                assert rolled["cpu:value"] == 0
+                q = TimeRange.new(T0, T0 + 2 * SEG)
+                await assert_equiv(e, "cpu", [], q, 60_000, ("avg",),
+                                   expect_served=False)
+                # that raw aggregate flushed the memtables
+                # (flush-then-replan); now the segments roll
+                rolled = await e.rollups.roll_now()
+                await assert_equiv(e, "cpu", [], q, 60_000, ("avg",),
+                                   expect_served=True)
+                # fresh acked rows ride the raw tail over the covered
+                # prefix until their flush + re-roll
+                await e.write([Sample("cpu", [Label("host", "hx")],
+                                      T0 + 2 * SEG + 123, 7.5)])
+                assert e.tables["data"].memtable_segments()
+                q3 = TimeRange.new(T0, T0 + 3 * SEG)
+                await assert_equiv(e, "cpu", [], q3, 60_000,
+                                   ("avg", "last"), expect_served=True)
+                assert spec.served_queries == 2
+            finally:
+                await e.close()
+
+        run(go())
+
+
+class TestRollupEdges:
+    def test_empty_prefix_segments_count_as_covered(self):
+        """A 'last N days' range mostly predating the first write must
+        still serve from the rollup: segments with provably no data are
+        trivially covered, not tail."""
+        async def go():
+            e = await open_engine(MemoryObjectStore())
+            try:
+                rng = np.random.default_rng(9)
+                # data only in the LAST segment of a 6-segment range
+                await e.write_arrow("cpu", ["host"],
+                                    batch_of(rng, 500, span_segs=1,
+                                             t0=T0 + 5 * SEG))
+                await e.rollups.roll_now()
+                q = TimeRange.new(T0, T0 + 6 * SEG)
+                await assert_equiv(e, "cpu", [], q, 60_000, ("avg",),
+                                   expect_served=True)
+            finally:
+                await e.close()
+
+        run(go())
+
+    def test_unsplittable_values_stay_raw_served(self):
+        """A sum beyond float32 range cannot round-trip the cell
+        encoding: the segment is marked unrollable and keeps serving
+        raw — correct answers, no silent NaN cells."""
+        async def go():
+            e = await open_engine(MemoryObjectStore())
+            try:
+                await e.write([
+                    Sample("cpu", [Label("host", "a")], T0 + 1, 3.0e38),
+                    Sample("cpu", [Label("host", "a")], T0 + 2, 3.0e38),
+                ])
+                await e.rollups.roll_now()
+                spec = e.rollups.specs[("cpu", "value")]
+                assert spec.unrollable and not spec.rolled
+                q = TimeRange.new(T0, T0 + SEG)
+                out = await assert_equiv(e, "cpu", [], q, 60_000,
+                                         ("sum",), expect_served=False)
+                # the engine's f32 partial-grid convention makes this
+                # +inf on the raw path too — the point is both paths
+                # agree and no NaN cell was silently served
+                assert np.isinf(np.asarray(out["aggs"]["sum"])[0, 0])
+                # a second pass does not churn on the unrollable segment
+                rolled = await e.rollups.roll_now()
+                assert rolled["cpu:value"] == 0
+            finally:
+                await e.close()
+
+        run(go())
+
+
+class TestRollupLag:
+    def test_unflushed_rows_keep_lag_positive(self, tmp_path):
+        """The stale-tier alert must not read 0 while acked rows sit in
+        memtables: the incorporation watermark is floored by the oldest
+        unflushed seq even when a LATER flush's SST id was rolled."""
+        async def go():
+            e = await open_engine(MemoryObjectStore(), wal_dir=tmp_path)
+            try:
+                await e.write([Sample("cpu", [Label("host", "a")],
+                                      T0 + 1, 1.0)])
+                await e.flush()
+                await e.rollups.roll_now()
+                st = (await e.rollups.stats())["specs"]["cpu:value"]
+                assert st["lag_seqs"] == 0
+                # a fresh ack in ANOTHER segment stays buffered: its
+                # seq is below the rolled watermark id, yet the tier
+                # must report lag until it is flushed and rolled
+                await e.write([Sample("cpu", [Label("host", "a")],
+                                      T0 + SEG + 1, 2.0)])
+                st = (await e.rollups.stats())["specs"]["cpu:value"]
+                assert st["lag_seqs"] > 0
+                await e.flush()
+                await e.rollups.roll_now()
+                st = (await e.rollups.stats())["specs"]["cpu:value"]
+                assert st["lag_seqs"] == 0
+            finally:
+                await e.close()
+
+        run(go())
+
+
+class TestRollupRecovery:
+    def test_state_survives_restart(self):
+        async def go():
+            store = MemoryObjectStore()
+            e = await open_engine(store)
+            rng = np.random.default_rng(6)
+            try:
+                await e.write_arrow("cpu", ["host"], batch_of(rng, 3000))
+                await e.rollups.roll_now()
+            finally:
+                await e.close()
+            e = await open_engine(store)
+            try:
+                spec = e.rollups.specs[("cpu", "value")]
+                assert len(spec.rolled) == 3 and not spec.dirty
+                q = TimeRange.new(T0, T0 + 3 * SEG)
+                await assert_equiv(e, "cpu", [], q, 60_000, ("avg",),
+                                   expect_served=True)
+            finally:
+                await e.close()
+
+        run(go())
+
+    def test_partial_update_never_trusted(self):
+        """Crash between cell writes and the state persist: the reopened
+        manager re-rolls from raw instead of trusting the half-update
+        (fingerprint diff), and results stay bit-identical."""
+        async def go():
+            store = MemoryObjectStore()
+            e = await open_engine(store)
+            rng = np.random.default_rng(8)
+            try:
+                await e.write_arrow("cpu", ["host"], batch_of(rng, 2000))
+                await e.rollups.roll_now()
+                # new data, then a roll whose state persist "crashes"
+                await e.write_arrow("cpu", ["host"],
+                                    batch_of(rng, 500, span_segs=1))
+
+                async def boom(spec):
+                    raise OSError("simulated crash before state persist")
+
+                e.rollups._persist = boom
+                with pytest.raises(OSError):
+                    await e.rollups.roll_now()
+            finally:
+                await e.close()
+            e = await open_engine(store)
+            try:
+                spec = e.rollups.specs[("cpu", "value")]
+                # the changed segment's fingerprint no longer matches
+                # the persisted state: dirty again on open
+                assert spec.dirty
+                q = TimeRange.new(T0, T0 + 3 * SEG)
+                await assert_equiv(e, "cpu", [], q, 60_000, ("avg",),
+                                   expect_served=True)
+                await e.rollups.roll_now()
+                assert not spec.dirty
+                await assert_equiv(e, "cpu", [], q, 60_000, ("sum",),
+                                   expect_served=True)
+            finally:
+                await e.close()
+
+        run(go())
+
+
+class TestRollupConfigAndServer:
+    def test_rollup_toml_roundtrip(self, tmp_path):
+        from horaedb_tpu.server.config import load_config
+
+        p = tmp_path / "cfg.toml"
+        p.write_text("""
+[rollup]
+enabled = true
+tiers = ["1m", "1h"]
+roll_interval = "5s"
+specs = ["cpu", "mem:usage_user"]
+""")
+        cfg = load_config(str(p))
+        assert cfg.rollup.enabled
+        assert cfg.rollup.tier_millis() == [60_000, 3_600_000]
+        assert cfg.rollup.spec_pairs() == [("cpu", "value"),
+                                           ("mem", "usage_user")]
+        assert cfg.rollup.roll_interval.seconds == 5.0
+
+    def test_rollup_toml_rejects_bad_tier(self, tmp_path):
+        from horaedb_tpu.server.config import load_config
+
+        p = tmp_path / "cfg.toml"
+        p.write_text("""
+[rollup]
+enabled = true
+tiers = ["7m"]
+""")
+        with pytest.raises(Error):
+            load_config(str(p))  # 7m does not divide the 2h segment
+
+    def test_rollup_rejects_chunked_layout(self):
+        async def go():
+            with pytest.raises(Error):
+                await MetricEngine.open(
+                    "m", MemoryObjectStore(), segment_ms=SEG,
+                    config=storage_cfg(), chunked_data=True,
+                    rollup_config=rollup_cfg())
+
+        run(go())
+
+    def test_server_admin_rollups_and_metrics(self):
+        async def go():
+            from aiohttp.test_utils import TestClient, TestServer
+
+            from horaedb_tpu.server.config import ServerConfig
+            from horaedb_tpu.server.main import ServerState, build_app
+
+            engine = await open_engine(MemoryObjectStore(), specs=())
+            state = ServerState(engine, ServerConfig())
+            client = TestClient(TestServer(build_app(state)))
+            await client.start_server()
+            try:
+                samples = [{"name": "cpu", "labels": {"host": f"h{i % 3}"},
+                            "timestamp": T0 + i * 1000, "value": float(i)}
+                           for i in range(300)]
+                r = await client.post("/write", json={"samples": samples})
+                assert r.status == 200
+                # register + synchronous backfill
+                # a non-object body is a client error, not a 500
+                r = await client.post("/admin/rollups", json=[1, 2])
+                assert r.status == 400
+                r = await client.post("/admin/rollups",
+                                      json={"metric": "cpu", "roll": True})
+                assert r.status == 200
+                body = await r.json()
+                assert body["rolled_segments"]["cpu:value"] >= 1
+                assert body["specs"]["cpu:value"]["lag_seqs"] == 0
+                # a covered dashboard query is served from the tier
+                r = await client.post("/query", json={
+                    "metric": "cpu", "start": T0, "end": T0 + SEG,
+                    "bucket_ms": 60_000})
+                assert r.status == 200
+                r = await client.get("/admin/rollups")
+                status = await r.json()
+                assert status["specs"]["cpu:value"]["served_queries"] == 1
+                assert status["specs"]["cpu:value"]["coverage"] == 1.0
+                assert "1m" in status["tiers"]
+                assert status["tiers"]["1m"]["cell_rows"] > 0
+                # /stats carries the same lag/coverage surface
+                r = await client.get("/stats")
+                st = await r.json()
+                assert st["rollups"]["specs"]["cpu:value"]["lag_seqs"] == 0
+                # labeled serve counter on /metrics
+                r = await client.get("/metrics")
+                text = await r.text()
+                assert "rollup_served_queries_total" in text
+                assert 'table="cpu"' in text and 'tier="1m"' in text
+                assert "rollup_lag_seqs" in text
+            finally:
+                await client.close()
+                await engine.close()
+
+        run(go())
+
+    def test_server_without_rollups_501(self):
+        async def go():
+            from aiohttp.test_utils import TestClient, TestServer
+
+            from horaedb_tpu.server.config import ServerConfig
+            from horaedb_tpu.server.main import ServerState, build_app
+
+            engine = await MetricEngine.open("m", MemoryObjectStore(),
+                                             segment_ms=SEG,
+                                             config=storage_cfg())
+            state = ServerState(engine, ServerConfig())
+            client = TestClient(TestServer(build_app(state)))
+            await client.start_server()
+            try:
+                assert (await client.get("/admin/rollups")).status == 501
+                assert (await client.post(
+                    "/admin/rollups", json={"metric": "x"})).status == 501
+            finally:
+                await client.close()
+                await engine.close()
+
+        run(go())
+
+
+# ---------------------------------------------------------------------------
+# seeded ingest/flush/compaction interleaving harness (make chaos)
+# ---------------------------------------------------------------------------
+
+
+async def run_rollup_schedule(i: int, tmp_path) -> None:
+    """One seeded schedule: random writes (with duplicate-PK
+    overwrites), flushes, compactions, rolls and restarts, with every
+    query asserted bit-identical between the rollup-served and from-raw
+    paths."""
+    rng = np.random.default_rng(ROLLUP_SEED + i)
+    use_wal = bool(i % 2)
+    wal_dir = tmp_path / f"wal-{i}"
+    store = MemoryObjectStore()
+
+    async def open_e():
+        return await open_engine(store,
+                                 wal_dir=wal_dir if use_wal else None,
+                                 tiers=("1m", "10m"))
+
+    e = await open_e()
+    try:
+        hosts = [f"h{j:02d}" for j in range(5)]
+        span_segs = 3
+
+        async def op_write():
+            n = int(rng.integers(10, 200))
+            ts = T0 + rng.integers(0, span_segs * SEG, n).astype(np.int64)
+            if rng.random() < 0.4 and n > 20:
+                ts[: n // 2] = ts[n // 2: n // 2 + n // 2]  # dup PKs
+            await e.write_arrow("cpu", ["host"], pa.record_batch({
+                "host": pa.array([hosts[j] for j in
+                                  rng.integers(0, len(hosts), n)]),
+                "timestamp": pa.array(ts, type=pa.int64()),
+                "value": pa.array(rng.random(n), type=pa.float64()),
+            }))
+
+        async def op_flush():
+            await e.flush()
+
+        async def op_roll():
+            await e.rollups.roll_now()
+
+        async def op_compact():
+            await e.tables["data"].compact()
+            for t in e.rollups.tiers.values():
+                await t.compact()
+
+        async def op_restart():
+            nonlocal e
+            await e.close()
+            e = await open_e()
+
+        async def op_query():
+            bucket = int(rng.choice([60_000, 600_000]))
+            lo_b = int(rng.integers(0, span_segs * SEG // bucket - 1))
+            hi_b = int(rng.integers(lo_b + 1, span_segs * SEG // bucket + 1))
+            q = TimeRange.new(T0 + lo_b * bucket, T0 + hi_b * bucket)
+            aggs = AGG_SETS[int(rng.integers(0, len(AGG_SETS)))]
+            filters = ([] if rng.random() < 0.6 else
+                       [("host", hosts[int(rng.integers(0, len(hosts)))])])
+            await assert_equiv(e, "cpu", filters, q, bucket, aggs)
+
+        ops = [op_write, op_flush, op_roll, op_compact, op_restart,
+               op_query]
+        weights = np.array([0.34, 0.1, 0.18, 0.06, 0.06, 0.26])
+        await op_write()
+        for _ in range(14):
+            await ops[int(rng.choice(len(ops), p=weights))]()
+        await op_roll()
+        await op_query()
+    finally:
+        await e.close()
+
+
+def test_rollup_torture_fast(tmp_path):
+    """Tier-1 variant: a handful of schedules keeps the seeded
+    interleaving coverage in every run."""
+    async def go():
+        for i in range(4):
+            await run_rollup_schedule(i, tmp_path)
+
+    run(go())
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("chunk", range(4))
+def test_rollup_torture_schedules(chunk, tmp_path):
+    async def go():
+        per = max(1, ROLLUP_SCHEDULES // 4)
+        for i in range(chunk * per, (chunk + 1) * per):
+            await run_rollup_schedule(i, tmp_path)
+
+    run(go())

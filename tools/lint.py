@@ -24,6 +24,11 @@ Checks, all hard failures:
     reader's alone — writers insert through the tiered admission API
     (EncodedSegmentCache.admit), so cache-coherence reasoning lives in
     exactly one module (storage/encoded_cache.py's docstring)
+  - rollup coverage discipline under horaedb_tpu/: scan-shaped calls
+    on rollup tier tables outside horaedb_tpu/rollup/ are an error —
+    reads go through the planner's coverage API
+    (RollupManager.covers/try_serve), the one place that knows which
+    segments' cells are current (docs/rollups.md)
   - metric registration hygiene under horaedb_tpu/: every
     `registry.counter/gauge/histogram(...)` call must pass non-empty
     help text (docs/observability.md — /metrics is an operator
@@ -131,6 +136,47 @@ def _tiered_cache_violation(node: ast.Call) -> bool:
     if isinstance(cur, ast.Name):
         chain.append(cur.id)
     return any(tok in part for part in chain for tok in _CACHE_TOKENS)
+
+
+# rollup tier tables are read ONLY through the planner's coverage API
+# (rollup/manager.py: covers/try_serve): a direct scan of a rollup
+# table elsewhere bypasses the dirty/rolling/memtable coverage checks
+# and can serve stale pre-aggregates (docs/rollups.md).  Writes/admin
+# (compact/scrub) stay allowed; the scan-shaped surface does not.
+_ROLLUP_SCAN_METHODS = {"scan", "scan_segments", "scan_aggregate",
+                        "plan_query", "execute_plan", "build_scan_plan"}
+_ROLLUP_TOKENS = ("rollup", "tier")
+
+
+def _receiver_chain(func: ast.Attribute) -> list[str]:
+    """Attribute/Name/Subscript tokens of a call receiver, e.g.
+    `self.rollups.tiers[ms].scan(...)` -> [tiers, rollups, self]."""
+    chain = []
+    cur = func.value
+    while True:
+        if isinstance(cur, ast.Attribute):
+            chain.append(cur.attr)
+            cur = cur.value
+        elif isinstance(cur, ast.Subscript):
+            cur = cur.value
+        elif isinstance(cur, ast.Call):
+            cur = cur.func
+        else:
+            break
+    if isinstance(cur, ast.Name):
+        chain.append(cur.id)
+    return chain
+
+
+def _rollup_scan_violation(node: ast.Call) -> bool:
+    """True for `<...rollup|tier...>.scan/plan_query/... (...)` calls —
+    rollup-tier reads outside the coverage API."""
+    func = node.func
+    if not isinstance(func, ast.Attribute) \
+            or func.attr not in _ROLLUP_SCAN_METHODS:
+        return False
+    return any(tok in part.lower() for part in _receiver_chain(func)
+               for tok in _ROLLUP_TOKENS)
 
 
 # metric-factory methods on a registry object; any such call under
@@ -241,6 +287,17 @@ def lint_file(path: pathlib.Path) -> list[str]:
                     "outside the reader — writers go through the tiered "
                     "admission API (EncodedSegmentCache.admit); see "
                     "storage/encoded_cache.py")
+        elif (isinstance(node, ast.Call) and "horaedb_tpu" in path.parts
+                and "rollup" not in path.parts
+                and _rollup_scan_violation(node)):
+            src = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+            if "noqa" not in src:
+                problems.append(
+                    f"{path}:{node.lineno}: direct rollup-tier scan "
+                    "outside horaedb_tpu/rollup/ — reads go through the "
+                    "planner's coverage API (RollupManager.covers/"
+                    "try_serve), which is what keeps stale cells from "
+                    "serving (docs/rollups.md)")
         elif (isinstance(node, ast.Call) and "horaedb_tpu" in path.parts
                 and _metric_call_without_help(node)):
             src = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
