@@ -39,9 +39,11 @@ lint:
 	python tools/lint.py
 
 # end-to-end tracing demo (docs/observability.md): run a query against
-# a throwaway local server and pretty-print its span tree + counters
+# a throwaway local server and pretty-print its span tree + counters,
+# then (--ops) provoke a compaction + roll pass and print their op
+# traces and the /debug/tasks background-loop table
 trace-demo:
-	JAX_PLATFORMS=cpu python tools/trace_demo.py
+	JAX_PLATFORMS=cpu python tools/trace_demo.py --ops
 
 # multichip dryrun with a GUARANTEED result record: even a wedged run
 # (rc=124) writes bench_results/multichip_rNN.json with an explicit

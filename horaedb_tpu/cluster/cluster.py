@@ -24,6 +24,7 @@ import pyarrow as pa
 
 from horaedb_tpu.common import deadline as deadline_mod
 from horaedb_tpu.common.error import Error, ensure
+from horaedb_tpu.common.loops import loops
 from horaedb_tpu.common.time_ext import now_ms
 from horaedb_tpu.cluster.breaker import (CLOSED as BREAKER_CLOSED,
                                          BreakerConfig, CircuitBreaker)
@@ -32,7 +33,7 @@ from horaedb_tpu.metric_engine import MetricEngine, Sample
 from horaedb_tpu.objstore import ObjectStore
 from horaedb_tpu.storage.config import StorageConfig
 from horaedb_tpu.storage.types import TimeRange
-from horaedb_tpu.utils import registry, span
+from horaedb_tpu.utils import op_trace, registry, span
 
 logger = logging.getLogger(__name__)
 
@@ -51,6 +52,10 @@ _HEDGES = registry.counter(
 _HEDGE_WINS = registry.counter(
     "cluster_hedge_wins_total",
     "hedged requests that beat the primary attempt")
+_HEALTH_ERRORS = registry.counter(
+    "health_monitor_errors_total",
+    "heartbeat-round exceptions, by region (region=\"_round\" for "
+    "whole-round failures that would otherwise be swallowed)")
 
 
 @dataclass
@@ -78,6 +83,10 @@ class Cluster:
         # dead after consecutive failed pings fail queries fast
         self._health_task: Optional[asyncio.Task] = None
         self._health_fails: dict[int, int] = {}
+        # last heartbeat exception per region, surfaced via the health
+        # loop's /debug/tasks backlog instead of vanishing into a bare
+        # except (the pre-PR-7 behavior)
+        self._health_errors: dict[int, dict] = {}
         self.dead_regions: set[int] = set()
         # per-remote-region circuit breakers (docs/robustness.md):
         # consecutive failures open the circuit; the health monitor's
@@ -191,6 +200,7 @@ class Cluster:
         breaker state."""
         self.dead_regions.discard(region_id)
         self._health_fails.pop(region_id, None)
+        self._health_errors.pop(region_id, None)
         self.breakers.pop(region_id, None)
 
     # ---- region movement --------------------------------------------------
@@ -283,8 +293,21 @@ class Cluster:
         and routed queries fail IMMEDIATELY with an actionable error;
         a successful ping clears the mark."""
         ensure(self._health_task is None, "health monitor already running")
-        self._health_task = asyncio.create_task(
-            self._health_loop(interval_s))
+        self._health_task = loops.spawn(
+            lambda hb: self._health_loop(hb, interval_s),
+            name="health-monitor", owner="cluster",
+            period_s=interval_s, backlog=self._health_backlog)
+
+    def _health_backlog(self) -> dict:
+        """/debug/tasks hint: which peers are failing and the last
+        heartbeat error per region (with its timestamp)."""
+        return {
+            "dead_regions": sorted(self.dead_regions),
+            "consecutive_fails": {str(r): n for r, n
+                                  in self._health_fails.items() if n},
+            "last_errors": {str(r): dict(e) for r, e
+                            in self._health_errors.items()},
+        }
 
     async def stop_health_monitor(self) -> None:
         if self._health_task is not None:
@@ -302,9 +325,27 @@ class Cluster:
         timeout, not the sum over sick peers."""
         targets = [(rid, ping) for rid, backend in self.regions.items()
                    if (ping := getattr(backend, "ping", None)) is not None]
-        results = await asyncio.gather(*(p() for _rid, p in targets))
+        # return_exceptions: one ping RAISING (vs. returning False) used
+        # to kill the whole round — and the loop's bare except then
+        # swallowed it, so a buggy backend was indistinguishable from a
+        # healthy idle monitor.  Now it counts, is surfaced, and marks
+        # only ITS region failed.
+        results = await asyncio.gather(*(p() for _rid, p in targets),
+                                       return_exceptions=True)
         alive: dict[int, bool] = {}
-        for (rid, _p), ok in zip(targets, results):
+        for (rid, _p), res in zip(targets, results):
+            if isinstance(res, asyncio.CancelledError):
+                raise res
+            if isinstance(res, BaseException):
+                _HEALTH_ERRORS.labels(region=str(rid)).inc()
+                self._health_errors[rid] = {
+                    "error": str(res) or type(res).__name__,
+                    "at_ms": now_ms()}
+                logger.warning("health ping for region %s raised: %s",
+                               rid, res)
+                ok = False
+            else:
+                ok = bool(res)
             alive[rid] = ok
             br = self.breakers.get(rid)
             if ok:
@@ -325,12 +366,24 @@ class Cluster:
                     br.record_failure()
         return alive
 
-    async def _health_loop(self, interval_s: float) -> None:
+    async def _health_loop(self, hb, interval_s: float) -> None:
         while True:
+            hb.beat()
             try:
-                await self.check_health_once()
-            except Exception:  # a heartbeat crash must not kill the loop
-                pass
+                # each round is an op trace: ping RPC spans + failure
+                # attribution land in /debug/traces?kind=op
+                with op_trace("health_round", slow_s=max(interval_s,
+                                                         5.0)):
+                    await self.check_health_once()
+                hb.ok()
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:  # noqa: BLE001 — a crash must not
+                # kill the loop, but it must not vanish either: count
+                # it and surface it on /debug/tasks (last_error)
+                hb.error(exc)
+                _HEALTH_ERRORS.labels(region="_round").inc()
+                logger.exception("health-monitor round failed")
             await asyncio.sleep(interval_s)
 
     # ---- rebalancing ------------------------------------------------------

@@ -466,6 +466,9 @@ class MetricEngine:
         # standing rollup tiers (rollup/manager.py); populated by open()
         # when a [rollup] config enables them
         self.rollups = None
+        # self-monitoring meta-ingest (metric_engine/meta.py); populated
+        # by open() when a [meta] config enables it
+        self.meta = None
         # chunked layout: the Append-mode data table bypasses the
         # reader's scan cache (host merge, uncached), so decoded sample
         # arrays get their own byte-budgeted LRU — keyed by (predicate,
@@ -489,7 +492,8 @@ class MetricEngine:
                    config: Optional[StorageConfig] = None,
                    chunked_data: bool = False,
                    chunk_window_ms: int = 30 * 60 * 1000,
-                   wal_config=None, rollup_config=None) -> "MetricEngine":
+                   wal_config=None, rollup_config=None,
+                   meta_config=None) -> "MetricEngine":
         import dataclasses
 
         if chunked_data:
@@ -585,9 +589,26 @@ class MetricEngine:
             data = tables["data"]
             if hasattr(data, "memtable_segments"):
                 data.on_flush = self.rollups.note_flush
+        if meta_config is not None and meta_config.enabled:
+            # self-monitoring: scrape the process's own MetricsRegistry
+            # into a __meta metrics table through this engine's normal
+            # write path (metric_engine/meta.py)
+            from horaedb_tpu.metric_engine.meta import MetaIngest
+
+            try:
+                self.meta = MetaIngest(self, meta_config)
+                await self.meta.start()
+            except BaseException:
+                await self.close()
+                raise
         return self
 
     async def close(self) -> None:
+        if self.meta is not None:
+            # the meta scraper writes through this engine: stop it
+            # before anything under it goes away
+            await self.meta.stop()
+            self.meta = None
         if self.rollups is not None:
             await self.rollups.close()
             self.rollups = None

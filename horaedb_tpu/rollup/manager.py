@@ -53,13 +53,15 @@ import numpy as np
 import pyarrow as pa
 
 from horaedb_tpu.common.error import ensure
+from horaedb_tpu.common.loops import loops
 from horaedb_tpu.objstore import NotFoundError, ObjectStore
 from horaedb_tpu.ops import And, Eq, In, TimeRangePred
 from horaedb_tpu.ops.downsample import ALL_AGGS
 from horaedb_tpu.rollup.config import RollupConfig
 from horaedb_tpu.storage.read import ScanRequest
 from horaedb_tpu.storage.types import TimeRange, Timestamp
-from horaedb_tpu.utils import WIDE_BUCKETS, registry, span, trace_add
+from horaedb_tpu.utils import (WIDE_BUCKETS, op_trace, registry, span,
+                               trace_add)
 
 logger = logging.getLogger(__name__)
 
@@ -238,8 +240,12 @@ class RollupManager:
                 await t.close()
             raise
         self._wake = asyncio.Event()
-        self._task = asyncio.create_task(self._loop(),
-                                         name=f"rollup:{root_path}")
+        # threshold sized to a whole-table registration backfill, the
+        # longest legitimate pass
+        self._task = loops.spawn(
+            self._loop, name=f"rollup:{root_path}", kind="rollup",
+            owner="rollup", period_s=config.roll_interval.seconds,
+            stall_threshold_s=600.0, backlog=self._backlog)
         if self.specs:
             # recovered/config-registered specs may have pending work
             # (their register()-time wake predates the event existing)
@@ -373,21 +379,36 @@ class RollupManager:
 
     # ---- maintenance ------------------------------------------------------
 
-    async def _loop(self) -> None:
+    def _backlog(self) -> dict:
+        """/debug/tasks hint: segments awaiting (or refused) a roll."""
+        return {
+            "dirty_segments": sum(len(s.dirty)
+                                  for s in self.specs.values()),
+            "rolling_segments": sum(len(s.rolling)
+                                    for s in self.specs.values()),
+            "unrollable_segments": sum(len(s.unrollable)
+                                       for s in self.specs.values()),
+            "specs": len(self.specs),
+        }
+
+    async def _loop(self, hb) -> None:
         interval = self.config.roll_interval.seconds
         while not self._stopping:
             try:
                 await asyncio.wait_for(self._wake.wait(), interval)
             except asyncio.TimeoutError:
                 pass
+            hb.beat()
             self._wake.clear()
             if self._stopping:
                 return
             try:
                 await self.roll_now()
+                hb.ok()
             except asyncio.CancelledError:
                 raise
-            except Exception:  # noqa: BLE001 — retried next tick
+            except Exception as exc:  # noqa: BLE001 — retried next tick
+                hb.error(exc)
                 logger.exception("rollup maintenance pass failed")
 
     async def _data_fingerprints(self) -> dict[int, list[int]]:
@@ -412,9 +433,14 @@ class RollupManager:
         out = {}
         async with self._roll_lock:
             _PASSES.inc()
-            for spec in list(self.specs.values()):
-                rolled = await self._roll_spec(spec)
-                out[f"{spec.metric}:{spec.field}"] = rolled
+            # one op trace per maintenance pass: the recompute scans'
+            # objstore/cache traffic and per-segment rollup_roll spans
+            # attribute to it (a traced admin request keeps the scope)
+            with op_trace("rollup_pass", slow_s=600.0,
+                          specs=len(self.specs)):
+                for spec in list(self.specs.values()):
+                    rolled = await self._roll_spec(spec)
+                    out[f"{spec.metric}:{spec.field}"] = rolled
         return out
 
     async def _roll_spec(self, spec: RollupSpec) -> int:

@@ -18,6 +18,7 @@ from typing import Any, Optional
 
 from horaedb_tpu.common import Error, ReadableDuration, ensure
 from horaedb_tpu.cluster.breaker import BreakerConfig
+from horaedb_tpu.metric_engine.meta import MetaConfig
 from horaedb_tpu.rollup.config import RollupConfig, rollup_from_dict
 from horaedb_tpu.storage.config import StorageConfig, _check_scalar
 from horaedb_tpu.storage.config import from_dict as storage_from_dict
@@ -71,6 +72,33 @@ class TraceConfig:
     # fraction of requests that record spans (the X-Trace-Id header is
     # minted regardless; an upstream-traced request is always recorded)
     sample_rate: float = 1.0
+    # background-op traces (compaction, flush, WAL commit rounds,
+    # rollup passes, scrub, health rounds) get their OWN ring so hot
+    # ops never evict query traces, their own default slow threshold
+    # (call sites override per-op), and their own sampling rate
+    op_ring_size: int = 256
+    op_slow_threshold: ReadableDuration = field(
+        default_factory=lambda: ReadableDuration.parse("30s"))
+    op_sample_rate: float = 1.0
+
+
+@dataclass
+class WatchdogConfig:
+    """[watchdog]: the background-loop watchdog (common/loops.py).
+    Every loop spawned through the loop registry heartbeats; a non-idle
+    loop whose heartbeat age exceeds its stall threshold fires
+    `loop_stalled_total{loop=}` + a slow-log entry, and the flag clears
+    when beats resume.  `GET /debug/tasks` serves the full registry."""
+
+    enabled: bool = True
+    # watchdog sweep period
+    interval: ReadableDuration = field(
+        default_factory=lambda: ReadableDuration.parse("1s"))
+    # default stall threshold = max(min_stall, stall_factor * period)
+    # for loops that don't declare their own threshold
+    stall_factor: float = 4.0
+    min_stall: ReadableDuration = field(
+        default_factory=lambda: ReadableDuration.parse("5s"))
 
 
 @dataclass
@@ -132,6 +160,10 @@ class ServerConfig:
     rollup: RollupConfig = field(default_factory=RollupConfig)
     # request-scoped tracing: ring size, slow-query threshold, sampling
     trace: TraceConfig = field(default_factory=TraceConfig)
+    # background-loop watchdog (common/loops.py, GET /debug/tasks)
+    watchdog: WatchdogConfig = field(default_factory=WatchdogConfig)
+    # self-monitoring meta-ingest (metric_engine/meta.py)
+    meta: MetaConfig = field(default_factory=MetaConfig)
     metric_engine: MetricEngineConfig = field(default_factory=MetricEngineConfig)
 
 
@@ -168,12 +200,20 @@ def _dc_from_dict(cls: type, data: dict[str, Any]) -> Any:
         elif key == "wal":
             ensure(isinstance(value, dict), f"{where} expects a config table")
             kwargs[key] = _dc_from_dict(WalConfig, value)
-        elif key == "rollup":
+        elif key == "rollup" and cls is ServerConfig:
+            # ServerConfig.rollup is the [rollup] table; MetaConfig's
+            # same-named field is a plain bool (the scalar path below)
             ensure(isinstance(value, dict), f"{where} expects a config table")
             kwargs[key] = rollup_from_dict(value)
         elif key == "trace":
             ensure(isinstance(value, dict), f"{where} expects a config table")
             kwargs[key] = _dc_from_dict(TraceConfig, value)
+        elif key == "watchdog":
+            ensure(isinstance(value, dict), f"{where} expects a config table")
+            kwargs[key] = _dc_from_dict(WatchdogConfig, value)
+        elif key == "meta":
+            ensure(isinstance(value, dict), f"{where} expects a config table")
+            kwargs[key] = _dc_from_dict(MetaConfig, value)
         elif key == "metric_engine":
             ensure(isinstance(value, dict), f"{where} expects a config table")
             kwargs[key] = _dc_from_dict(MetricEngineConfig, value)
@@ -225,6 +265,21 @@ def load_config(path: Optional[str] = None) -> ServerConfig:
     ensure(0.0 <= cfg.trace.sample_rate <= 1.0,
            "[trace] sample_rate must be in [0, 1]")
     ensure(cfg.trace.ring_size >= 1, "[trace] ring_size must be >= 1")
+    ensure(0.0 <= cfg.trace.op_sample_rate <= 1.0,
+           "[trace] op_sample_rate must be in [0, 1]")
+    ensure(cfg.trace.op_ring_size >= 1,
+           "[trace] op_ring_size must be >= 1")
+    ensure(cfg.watchdog.stall_factor >= 1.0,
+           "[watchdog] stall_factor must be >= 1")
+    ensure(cfg.watchdog.interval.seconds > 0,
+           "[watchdog] interval must be positive")
+    if cfg.meta.enabled:
+        ensure(cfg.meta.interval.seconds > 0,
+               "[meta] interval must be positive")
+        ensure(bool(cfg.meta.metric),
+               "[meta] metric must be non-empty")
+        ensure(cfg.meta.max_series >= 1,
+               "[meta] max_series must be >= 1")
     if cfg.rollup.enabled:
         ensure(not cfg.metric_engine.chunked_data,
                "[rollup] requires the row data layout "

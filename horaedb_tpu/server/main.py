@@ -39,6 +39,7 @@ from horaedb_tpu.common.deadline import (
     DeadlineExceeded,
     deadline_scope,
 )
+from horaedb_tpu.common.loops import loops
 from horaedb_tpu.metric_engine import Label, MetricEngine, Sample
 from horaedb_tpu.objstore import LocalObjectStore
 from horaedb_tpu.server.config import (AdmissionConfig, ServerConfig,
@@ -156,7 +157,17 @@ class ServerState:
             enabled=config.trace.enabled,
             ring_size=config.trace.ring_size,
             slow_threshold_s=config.trace.slow_threshold.seconds,
-            sample_rate=config.trace.sample_rate)
+            sample_rate=config.trace.sample_rate,
+            op_ring_size=config.trace.op_ring_size,
+            op_slow_threshold_s=config.trace.op_slow_threshold.seconds,
+            op_sample_rate=config.trace.op_sample_rate)
+        # [watchdog] applies to the process-wide loop registry the same
+        # way (background loops registered at engine open included)
+        loops.configure(
+            enabled=config.watchdog.enabled,
+            interval_s=config.watchdog.interval.seconds,
+            stall_factor=config.watchdog.stall_factor,
+            min_stall_s=config.watchdog.min_stall.seconds)
         # a cluster-backed server applies its [breaker] section to the
         # engine's scatter-gather policy (the setter re-points breakers
         # of already-attached remote regions too)
@@ -168,9 +179,11 @@ class ServerState:
 
     def start_generators(self) -> None:
         for worker in range(self.config.test.write_worker_num):
-            self._generator_tasks.append(
-                asyncio.create_task(self._generate_load(worker),
-                                    name=f"write-gen-{worker}"))
+            self._generator_tasks.append(loops.spawn(
+                lambda hb, w=worker: self._write_load_loop(hb, w),
+                name=f"write-gen-{worker}", kind="write-gen",
+                owner="test",
+                period_s=self.config.test.write_interval.seconds))
 
     async def stop_generators(self) -> None:
         for t in self._generator_tasks:
@@ -182,11 +195,12 @@ class ServerState:
                 pass
         self._generator_tasks = []
 
-    async def _generate_load(self, worker: int) -> None:
+    async def _write_load_loop(self, hb, worker: int) -> None:
         interval = self.config.test.write_interval.seconds
         rng = random.Random(worker)
         while True:
             await asyncio.sleep(interval)
+            hb.beat()
             if not self.write_enabled:
                 continue
             now = now_ms()
@@ -198,7 +212,9 @@ class ServerState:
             ]
             try:
                 await self.engine.write(samples)
-            except Exception:
+                hb.ok()
+            except Exception as exc:  # noqa: BLE001 — next tick retries
+                hb.error(exc)
                 logger.exception("write-load generator failed")
 
 
@@ -462,14 +478,42 @@ def build_app(state: ServerState) -> web.Application:
     @routes.get("/debug/traces")
     async def debug_traces(req: web.Request) -> web.Response:
         """Newest-first summaries of recently completed traces
-        (?limit=N, default 50; docs/observability.md)."""
+        (?limit=N, default 50; docs/observability.md).  ?kind=query|op
+        restricts to one trace population (default: both, merged);
+        ?op=<name> to one background op (compaction, flush, wal_commit,
+        rollup_pass, scrub, health_round, meta_scrape — implies
+        kind=op)."""
         try:
             limit = int(req.query.get("limit", "50"))
         except ValueError:
             return web.json_response(
                 {"error": f"bad limit: {req.query.get('limit')!r}"},
                 status=400)
-        return web.json_response({"traces": tracing.recorder.list(limit)})
+        kind = req.query.get("kind", "all")
+        if kind not in ("all", "query", "op"):
+            return web.json_response(
+                {"error": f"bad kind: {kind!r} (query|op|all)"},
+                status=400)
+        op = req.query.get("op")
+        return web.json_response(
+            {"traces": tracing.recorder.list(limit, kind=kind, op=op)})
+
+    @routes.get("/debug/tasks")
+    async def debug_tasks(_req: web.Request) -> web.Response:
+        """The background-loop registry (common/loops.py): every loop's
+        liveness, heartbeat age, stall flag, last success, consecutive
+        errors + last error, and backlog hints (WAL backlog bytes,
+        dirty rollup segments, pending compaction tasks).  This is the
+        maintenance plane's /debug/traces."""
+        return web.json_response({
+            "loops": loops.snapshot(),
+            "watchdog": {
+                "enabled": loops.enabled,
+                "interval_s": loops.interval_s,
+                "stall_factor": loops.stall_factor,
+                "min_stall_s": loops.min_stall_s,
+            },
+        })
 
     @routes.get("/debug/traces/{trace_id}")
     async def debug_trace(req: web.Request) -> web.Response:
@@ -491,7 +535,11 @@ def build_app(state: ServerState) -> web.Application:
         # data-volume load signal for cluster rebalancing (rows/bytes/
         # SSTs per table from the manifests) + the ingest plane's
         # buffered state (memtable rows/bytes, WAL backlog, flush age)
-        return web.json_response(await state.engine.stats())
+        # + the maintenance plane's health rollup (stalled/erroring
+        # loops — degraded maintenance surfaces BEFORE query latency)
+        out = await state.engine.stats()
+        out["loops"] = loops.summary()
+        return web.json_response(out)
 
     @routes.post("/admin/flush")
     async def admin_flush(_req: web.Request) -> web.Response:
@@ -875,7 +923,8 @@ async def run_server(config: ServerConfig,
         config=config.metric_engine.time_merge_storage,
         chunked_data=config.metric_engine.chunked_data,
         chunk_window_ms=config.metric_engine.chunk_window.millis,
-        wal_config=wal_config, rollup_config=config.rollup)
+        wal_config=wal_config, rollup_config=config.rollup,
+        meta_config=config.meta)
     state = ServerState(engine, config)
     if config.test.enable_write:
         state.start_generators()

@@ -29,6 +29,7 @@ from typing import TYPE_CHECKING, Optional
 import pyarrow as pa
 
 from horaedb_tpu.common.error import Error, ensure
+from horaedb_tpu.common.loops import loops
 from horaedb_tpu.common.tasks import cancel_and_wait
 from horaedb_tpu.common.time_ext import now_ms
 from horaedb_tpu.storage import parquet_io, sidecar
@@ -44,7 +45,7 @@ from horaedb_tpu.storage.types import (
 if TYPE_CHECKING:
     from horaedb_tpu.storage.storage import CloudObjectStorage
 
-from horaedb_tpu.utils import WIDE_BUCKETS, registry, span
+from horaedb_tpu.utils import WIDE_BUCKETS, op_trace, registry, span
 
 logger = logging.getLogger(__name__)
 
@@ -227,6 +228,11 @@ class Executor:
         """TTL garbage collection: drop expired SSTs from the manifest,
         then best-effort delete the objects.  No rewrite, no memory gate
         (nothing is read)."""
+        with op_trace("ttl_gc", slow_s=120.0,
+                      expireds=len(task.expireds)):
+            await self._gc_expired_traced(task)
+
+    async def _gc_expired_traced(self, task: Task) -> None:
         ok = False
         try:
             to_deletes = [f.id for f in task.expireds]
@@ -243,12 +249,18 @@ class Executor:
                 self._unmark(task)
 
     async def _do_compaction(self, task: Task) -> None:
-        # compaction rewrites routinely outlast the default 10 s bucket
-        # ceiling — the wide layout keeps their histogram informative
-        with span("compaction.execute", buckets=WIDE_BUCKETS,
-                  inputs=len(task.inputs),
-                  expireds=len(task.expireds), bytes=task.input_size):
-            await self._do_compaction_traced(task)
+        # each rewrite is a background op with its own trace tree
+        # (objstore GETs/bytes and cache admissions attribute to it);
+        # "slow" for a compaction is ten minutes, not the query scale.
+        # The compaction.execute span keeps its histogram: rewrites
+        # routinely outlast the default 10 s bucket ceiling, so the
+        # wide layout keeps it informative
+        with op_trace("compaction", slow_s=600.0,
+                      inputs=len(task.inputs), bytes=task.input_size):
+            with span("compaction.execute", buckets=WIDE_BUCKETS,
+                      inputs=len(task.inputs),
+                      expireds=len(task.expireds), bytes=task.input_size):
+                await self._do_compaction_traced(task)
 
     async def _do_compaction_traced(self, task: Task) -> None:
         self._trigger_more()
@@ -399,17 +411,40 @@ class Scheduler:
 
     async def start(self) -> None:
         self._stopping = False
+        root = self.storage.root_path
+        # the spawn helper registers every loop with the watchdog
+        # (common/loops.py): names are per-table (root path), the
+        # metric label is the stable kind.  The executor's threshold
+        # is sized to a worst-case rewrite — flag wedged, not busy.
         self._loops = [
-            asyncio.create_task(self._generate_task_loop(), name="compact-picker"),
-            asyncio.create_task(self._recv_task_loop(), name="compact-executor"),
+            loops.spawn(self._generate_task_loop,
+                        name=f"compact-picker:{root}",
+                        kind="compact-picker", owner="compaction",
+                        period_s=self.interval_s,
+                        backlog=self._backlog),
+            loops.spawn(self._recv_task_loop,
+                        name=f"compact-executor:{root}",
+                        kind="compact-executor", owner="compaction",
+                        stall_threshold_s=900.0,
+                        backlog=self._backlog),
         ]
         # the orphan scrubber rides the compaction scheduler's lifecycle:
         # same background-loop ownership, stopped by the same stop()
         scrub_cfg = self.storage.config.scrub
         if scrub_cfg.enabled:
-            self._loops.append(asyncio.create_task(
-                self._scrub_loop(scrub_cfg.interval.seconds),
-                name="orphan-scrubber"))
+            self._loops.append(loops.spawn(
+                lambda hb: self._scrub_loop(hb, scrub_cfg.interval.seconds),
+                name=f"orphan-scrubber:{root}", kind="orphan-scrubber",
+                owner="compaction",
+                period_s=scrub_cfg.interval.seconds,
+                stall_threshold_s=300.0))
+
+    def _backlog(self) -> dict:
+        """/debug/tasks hint: pending compaction work (the "scores"
+        signal — queued tasks and reserved rewrite memory)."""
+        return {"pending_tasks": self._tasks.qsize(),
+                "pending_triggers": self._trigger.qsize(),
+                "inused_memory": self.executor.inused_memory}
 
     async def stop(self) -> None:
         # flag + cancel_and_wait, not cancel+await: trigger tokens race
@@ -431,20 +466,23 @@ class Scheduler:
         except asyncio.QueueFull:
             pass
 
-    async def _generate_task_loop(self) -> None:
+    async def _generate_task_loop(self, hb) -> None:
         while not self._stopping:
             try:
                 await asyncio.wait_for(self._trigger.get(),
                                        timeout=self.interval_s)
             except (TimeoutError, asyncio.TimeoutError):
                 pass
+            hb.beat()
             if self._stopping:
                 return
             # picker must run serially (in_compaction marking is the lock);
             # transient store errors must not kill the loop
             try:
                 task = await self.picker.pick_candidate()
-            except Exception:
+                hb.ok()
+            except Exception as exc:  # noqa: BLE001 — retried next tick
+                hb.error(exc)
                 logger.exception("compaction pick failed; will retry")
                 continue
             if task is not None:
@@ -456,14 +494,18 @@ class Scheduler:
                     for f in task.inputs + task.expireds:
                         f.unmark_compaction()
 
-    async def _recv_task_loop(self) -> None:
+    async def _recv_task_loop(self, hb) -> None:
         failure_streak = 0
         while not self._stopping:
+            hb.idle()  # parked on the task queue (healthy silence)
             task = await self._tasks.get()
+            hb.beat()
             try:
                 await self.executor.execute(task)
+                hb.ok()
                 failure_streak = 0
-            except Exception:
+            except Exception as exc:  # noqa: BLE001 — backoff + retry
+                hb.error(exc)
                 logger.exception("compaction task failed")
                 # back off on repeated failure: a dead store otherwise
                 # spins the pick→execute→trigger cycle at full speed (a
@@ -472,12 +514,16 @@ class Scheduler:
                 failure_streak += 1
                 await asyncio.sleep(min(5.0, 0.05 * 2 ** failure_streak))
 
-    async def _scrub_loop(self, interval_s: float) -> None:
+    async def _scrub_loop(self, hb, interval_s: float) -> None:
         while not self._stopping:
+            hb.idle()  # the inter-pass sleep (often minutes) is healthy
             await asyncio.sleep(interval_s)
+            hb.beat()
             try:
                 report = await self.storage.scrubber.scrub()
+                hb.ok()
                 if report.orphans_deleted or report.errors:
                     logger.info("scrub pass: %s", report.as_dict())
-            except Exception:
+            except Exception as exc:  # noqa: BLE001 — retried next pass
+                hb.error(exc)
                 logger.exception("orphan scrub pass failed; will retry")

@@ -52,7 +52,7 @@ from horaedb_tpu.storage.manifest import (
 from horaedb_tpu.storage.manifest.encoding import decode_manifest_update
 from horaedb_tpu.storage.sidecar import SIDECAR_SUFFIX
 from horaedb_tpu.storage.sst import DATA_PREFIX
-from horaedb_tpu.utils import registry
+from horaedb_tpu.utils import op_trace, registry
 
 logger = logging.getLogger(__name__)
 
@@ -136,7 +136,14 @@ class Scrubber:
     async def scrub(self, grace_override_s: Optional[float] = None
                     ) -> ScrubReport:
         """One reconcile pass.  Never raises on per-object failures —
-        a failed delete is an orphan for the next pass."""
+        a failed delete is an orphan for the next pass.  Each pass is
+        its own op trace (the store list/get/delete traffic attributes
+        to it) whether the scrub loop or POST /admin/scrub ran it."""
+        with op_trace("scrub", slow_s=120.0, root=self.root_path):
+            return await self._scrub_traced(grace_override_s)
+
+    async def _scrub_traced(self, grace_override_s: Optional[float]
+                            ) -> ScrubReport:
         grace = (self.grace_period_s if grace_override_s is None
                  else grace_override_s)
         report = ScrubReport()

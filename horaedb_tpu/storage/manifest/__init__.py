@@ -85,8 +85,15 @@ class _Merger:
         self._merge_lock = asyncio.Lock()
 
     def start(self) -> None:
+        from horaedb_tpu.common.loops import loops
+
         self._stopping = False
-        self._task = asyncio.create_task(self._run(), name="manifest-merger")
+        self._task = loops.spawn(
+            self._merge_loop, name=f"manifest-merger:{self.snapshot_path}",
+            kind="manifest-merger", owner="manifest",
+            period_s=self.config.merge_interval.seconds,
+            stall_threshold_s=120.0,
+            backlog=lambda: {"deltas_num": self.deltas_num})
 
     async def stop(self) -> None:
         self._stopping = True
@@ -97,7 +104,7 @@ class _Merger:
             await cancel_and_wait(self._task)
             self._task = None
 
-    async def _run(self) -> None:
+    async def _merge_loop(self, hb) -> None:
         interval = self.config.merge_interval.seconds
         logger.info("start manifest merge background job, interval=%ss", interval)
         while not self._stopping:
@@ -107,12 +114,15 @@ class _Merger:
                 pass
             except asyncio.TimeoutError:  # Python < 3.11 alias
                 pass
+            hb.beat()
             if self._stopping:
                 return
             if self.deltas_num > self.config.min_merge_threshold:
                 try:
                     await self.do_merge(first_run=False)
-                except Exception:
+                    hb.ok()
+                except Exception as exc:  # noqa: BLE001 — retried later
+                    hb.error(exc)
                     logger.exception("failed to merge manifest deltas")
 
     def _schedule_merge(self) -> None:

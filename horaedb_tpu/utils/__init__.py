@@ -4,10 +4,10 @@ from horaedb_tpu.utils.metrics import (WIDE_BUCKETS, Counter, Gauge,
                                        Histogram, MetricsRegistry, registry)
 from horaedb_tpu.utils.tracing import (active_trace, current_span,
                                        current_trace_id, new_trace_id,
-                                       recorder, span, trace_add,
-                                       trace_scope)
+                                       op_trace, recorder, span,
+                                       trace_add, trace_scope)
 
 __all__ = ["WIDE_BUCKETS", "Counter", "Gauge", "Histogram",
            "MetricsRegistry", "active_trace", "current_span",
-           "current_trace_id", "new_trace_id", "recorder", "registry",
-           "span", "trace_add", "trace_scope"]
+           "current_trace_id", "new_trace_id", "op_trace", "recorder",
+           "registry", "span", "trace_add", "trace_scope"]

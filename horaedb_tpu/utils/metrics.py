@@ -89,6 +89,18 @@ class _Family:
         return [f"# HELP {self.name} {self.help}",
                 f"# TYPE {self.name} {kind}"]
 
+    def samples(self) -> list:
+        """Family-wide scalar samples as (series_name, labels_dict,
+        value) tuples — the meta-ingest scrape surface
+        (metric_engine/meta.py).  Mirrors render(): the bare series
+        only when it would render, then every labeled child."""
+        out = []
+        if self._render_base():
+            out.extend(self._sample_points())
+        for child in self._snapshot_children():
+            out.extend(child._sample_points())
+        return out
+
 
 class Counter(_Family):
     __slots__ = ("name", "help", "_value", "_lock", "_labels", "_children",
@@ -121,6 +133,9 @@ class Counter(_Family):
 
     def _series_lines(self) -> list:
         return [f"{self._series()} {self._value}"]
+
+    def _sample_points(self) -> list:
+        return [(self.name, dict(self._labels), self._value)]
 
     def render(self) -> str:
         out = self._header("counter")
@@ -169,6 +184,9 @@ class Gauge(_Family):
 
     def _series_lines(self) -> list:
         return [f"{self._series()} {self._value}"]
+
+    def _sample_points(self) -> list:
+        return [(self.name, dict(self._labels), self._value)]
 
     def render(self) -> str:
         out = self._header("gauge")
@@ -250,6 +268,13 @@ class Histogram(_Family):
         out.append(f"{self._series('_count')} {self._count}")
         return out
 
+    def _sample_points(self) -> list:
+        # sum + count only: rates and means are derivable, and the
+        # bucket grid would multiply the scraped-series cardinality
+        labels = dict(self._labels)
+        return [(f"{self.name}_sum", labels, self._sum),
+                (f"{self.name}_count", dict(labels), self._count)]
+
     def render(self) -> str:
         out = self._header("histogram")
         if self._render_base():
@@ -300,6 +325,18 @@ class MetricsRegistry:
         with self._lock:
             metrics = sorted(self._metrics.items())
         return "".join(m.render() for _name, m in metrics)
+
+    def samples(self) -> list:
+        """Every family's scalar samples as (series_name, labels_dict,
+        value), sorted by family name — the meta-ingest scrape
+        snapshot.  Same lock discipline as render(): snapshot the
+        metric list under the registry lock, sample outside it."""
+        with self._lock:
+            metrics = sorted(self._metrics.items())
+        out = []
+        for _name, m in metrics:
+            out.extend(m.samples())
+        return out
 
 
 registry = MetricsRegistry()

@@ -58,6 +58,12 @@ EXPORT_LIMIT = 7000
 _SLOW_QUERIES = registry.counter(
     "slow_queries_total",
     "traced requests over the slow threshold (or deadline-exceeded)")
+# ops get their OWN slow counter: an 11-minute compaction is slow, but
+# it is not a slow QUERY — alerts on slow_queries_total must not fire
+# during routine maintenance
+_SLOW_OPS = registry.counter(
+    "slow_ops_total",
+    "background-op traces over their per-op slow threshold")
 _TRACES_RECORDED = registry.counter(
     "traces_recorded_total", "traces completed into the trace ring")
 
@@ -102,18 +108,32 @@ def current_trace_id() -> str:
 
 
 class Trace:
-    """One request's span buffer + counters.  Thread-safe: spans and
-    counts arrive from the event loop AND worker-pool threads.  After
-    `finish()` the trace is immutable — late adds (a straggler task
-    outliving its request) are dropped, so work done after the query
-    ended is attributed to nothing."""
+    """One request's (or background operation's) span buffer +
+    counters.  Thread-safe: spans and counts arrive from the event loop
+    AND worker-pool threads.  After `finish()` the trace is immutable —
+    late adds (a straggler task outliving its request) are dropped, so
+    work done after the query ended is attributed to nothing.
 
-    __slots__ = ("trace_id", "name", "root_span_id", "start_ms", "_t0",
+    `kind` separates the two trace populations: "query" (HTTP
+    query/write requests, the PR-5 surface) and "op" (background
+    operations — compaction, flush, WAL commit rounds, rollup passes,
+    scrub, health rounds; docs/observability.md, background plane).
+    Op traces carry the op name in `op` and may override the recorder's
+    slow threshold per-op via `slow_threshold_s`."""
+
+    __slots__ = ("trace_id", "name", "kind", "op", "slow_threshold_s",
+                 "root_fields", "root_span_id", "start_ms", "_t0",
                  "spans", "counters", "finished", "_lock")
 
-    def __init__(self, trace_id: str, name: str):
+    def __init__(self, trace_id: str, name: str, kind: str = "query",
+                 op: str = "", slow_threshold_s: Optional[float] = None,
+                 root_fields: Optional[dict] = None):
         self.trace_id = trace_id
         self.name = name
+        self.kind = kind
+        self.op = op
+        self.slow_threshold_s = slow_threshold_s
+        self.root_fields = dict(root_fields or {})
         self.root_span_id = _new_span_id()
         self.start_ms = time.time() * 1e3
         self._t0 = time.perf_counter()
@@ -179,7 +199,8 @@ class Trace:
                 "span_id": self.root_span_id, "parent_id": "",
                 "name": self.name, "start_ms": round(self.start_ms, 3),
                 "duration_ms": round(duration_ms, 3), "status": status,
-                "fields": {},
+                "fields": {k: _field(v)
+                           for k, v in self.root_fields.items()},
             })
             self.finished = True
             return self.to_dict_locked()
@@ -189,6 +210,8 @@ class Trace:
         return {
             "trace_id": self.trace_id,
             "root": self.name,
+            "kind": self.kind,
+            "op": self.op,
             "start_ms": round(self.start_ms, 3),
             "duration_ms": (root["duration_ms"] if root else None),
             "status": (root["status"] if root else "active"),
@@ -330,14 +353,24 @@ class TraceRecorder:
         self.ring_size = 256
         self.slow_threshold_s = 1.0
         self.sample_rate = 1.0
+        # op traces get their OWN ring and knobs: a hot background op
+        # (a WAL commit round per write group) must never evict query
+        # traces, and background ops have very different "slow" scales
+        self.op_ring_size = 256
+        self.op_slow_threshold_s = 30.0
+        self.op_sample_rate = 1.0
         self._ring: "OrderedDict[str, dict]" = OrderedDict()
+        self._op_ring: "OrderedDict[str, dict]" = OrderedDict()
         self._lock = threading.Lock()
         self._rng = random.Random(0xACE)
 
     def configure(self, enabled: Optional[bool] = None,
                   ring_size: Optional[int] = None,
                   slow_threshold_s: Optional[float] = None,
-                  sample_rate: Optional[float] = None) -> None:
+                  sample_rate: Optional[float] = None,
+                  op_ring_size: Optional[int] = None,
+                  op_slow_threshold_s: Optional[float] = None,
+                  op_sample_rate: Optional[float] = None) -> None:
         if enabled is not None:
             self.enabled = enabled
         if ring_size is not None:
@@ -346,53 +379,93 @@ class TraceRecorder:
             self.slow_threshold_s = slow_threshold_s
         if sample_rate is not None:
             self.sample_rate = min(1.0, max(0.0, sample_rate))
+        if op_ring_size is not None:
+            self.op_ring_size = max(1, op_ring_size)
+        if op_slow_threshold_s is not None:
+            self.op_slow_threshold_s = op_slow_threshold_s
+        if op_sample_rate is not None:
+            self.op_sample_rate = min(1.0, max(0.0, op_sample_rate))
 
     def start(self, name: str, trace_id: Optional[str] = None,
-              forced: bool = False) -> Optional[Trace]:
+              forced: bool = False, kind: str = "query", op: str = "",
+              slow_threshold_s: Optional[float] = None,
+              root_fields: Optional[dict] = None) -> Optional[Trace]:
         """A new active trace, or None when tracing is off / this
         request lost the sampling draw.  `forced` (an upstream
         coordinator already traced this request) bypasses sampling —
-        a stitched trace must not lose limbs to a local coin flip."""
+        a stitched trace must not lose limbs to a local coin flip.
+        Op traces (kind="op") draw against `op_sample_rate`."""
         if not self.enabled:
             return None
-        if not forced and self.sample_rate < 1.0:
+        rate = self.op_sample_rate if kind == "op" else self.sample_rate
+        if not forced and rate < 1.0:
             with self._lock:
-                if self._rng.random() >= self.sample_rate:
+                if self._rng.random() >= rate:
                     return None
-        return Trace(trace_id or new_trace_id(), name)
+        return Trace(trace_id or new_trace_id(), name, kind=kind, op=op,
+                     slow_threshold_s=slow_threshold_s,
+                     root_fields=root_fields)
 
     def finish(self, trace: Trace, status: str = "ok") -> dict:
-        """Complete a trace into the ring; fires the slow-query log on
-        threshold breach or a deadline-exceeded outcome."""
+        """Complete a trace into its ring; fires the slow log on
+        threshold breach or a deadline-exceeded outcome.  Ops use
+        their per-op threshold when one was set at start, else the
+        recorder's op default."""
         d = trace.finish(status)
+        if trace.slow_threshold_s is not None:
+            thr = trace.slow_threshold_s
+        elif trace.kind == "op":
+            thr = self.op_slow_threshold_s
+        else:
+            thr = self.slow_threshold_s
         slow = (status == "timeout"
-                or (d["duration_ms"] or 0) >= self.slow_threshold_s * 1e3)
+                or (d["duration_ms"] or 0) >= thr * 1e3)
         d["slow"] = slow
+        ring, size = ((self._op_ring, self.op_ring_size)
+                      if trace.kind == "op"
+                      else (self._ring, self.ring_size))
         with self._lock:
-            self._ring[trace.trace_id] = d
-            self._ring.move_to_end(trace.trace_id)
-            while len(self._ring) > self.ring_size:
-                self._ring.popitem(last=False)
+            ring[trace.trace_id] = d
+            ring.move_to_end(trace.trace_id)
+            while len(ring) > size:
+                ring.popitem(last=False)
         _TRACES_RECORDED.inc()
         if slow:
-            _SLOW_QUERIES.inc()
+            (_SLOW_OPS if trace.kind == "op" else _SLOW_QUERIES).inc()
+            what = (f"op {trace.op or d['root']}"
+                    if trace.kind == "op" else "query")
             slow_logger.warning(
-                "[trace] slow query trace_id=%s root=%s status=%s %s "
-                "counters=%s", trace.trace_id, d["root"], status,
+                "[trace] slow %s trace_id=%s root=%s status=%s %s "
+                "counters=%s", what, trace.trace_id, d["root"], status,
                 summarize(d), json.dumps(d["counters"], sort_keys=True))
         return d
 
     def get(self, trace_id: str) -> Optional[dict]:
         with self._lock:
-            return self._ring.get(trace_id)
+            d = self._ring.get(trace_id)
+            return d if d is not None else self._op_ring.get(trace_id)
 
-    def list(self, limit: int = 50) -> list[dict]:
-        """Newest-first summaries for GET /debug/traces."""
+    def list(self, limit: int = 50, kind: str = "query",
+             op: Optional[str] = None) -> list[dict]:
+        """Newest-first summaries for GET /debug/traces.  `kind` picks
+        the population: "query" (default — the PR-5 contract), "op",
+        or "all" (both rings merged by start time); `op` filters to
+        one op name (implies kind="op")."""
+        if op is not None:
+            kind = "op"
         with self._lock:
-            items = list(self._ring.values())
+            items = []
+            if kind in ("all", "query"):
+                items += list(self._ring.values())
+            if kind in ("all", "op"):
+                items += [d for d in self._op_ring.values()
+                          if op is None or d.get("op") == op]
+        items.sort(key=lambda d: d.get("start_ms") or 0)
         out = []
         for d in reversed(items[-max(0, limit):] if limit else items):
             out.append({"trace_id": d["trace_id"], "root": d["root"],
+                        "kind": d.get("kind", "query"),
+                        "op": d.get("op", ""),
                         "start_ms": d["start_ms"],
                         "duration_ms": d["duration_ms"],
                         "status": d["status"], "slow": d.get("slow"),
@@ -402,6 +475,7 @@ class TraceRecorder:
     def clear(self) -> None:
         with self._lock:
             self._ring.clear()
+            self._op_ring.clear()
 
 
 recorder = TraceRecorder()
@@ -483,3 +557,43 @@ def span(name: str, buckets: Optional[tuple] = None, **fields) -> Iterator[None]
 
 def _field(v):
     return v if isinstance(v, (str, int, float, bool, type(None))) else str(v)
+
+
+@contextlib.contextmanager
+def op_trace(op: str, slow_s: Optional[float] = None,
+             **fields) -> Iterator[Optional[Trace]]:
+    """Trace one background operation (compaction execute, memtable
+    flush, WAL group-commit round, rollup roll pass, scrub pass,
+    health-monitor round) as its own kind="op" trace tree in the
+    recorder's op ring — with the same objstore/cache/rows/bytes
+    attribution queries get, because every trace_add()/span() inside
+    (including pool work, which inherits the contextvars) lands on the
+    ambient trace this binds.
+
+    If a trace is ALREADY ambient — a query-triggered flush inside the
+    aggregate pushdown's pre-flush, a synchronous roll under a traced
+    admin request — the operation records as a span of that trace
+    instead of stealing the scope: the work is attributed to whoever
+    caused it.
+
+    `slow_s` overrides the recorder's op slow threshold for this op
+    (a compaction's "slow" is minutes; a WAL fsync round's is
+    seconds)."""
+    if _current_trace.get() is not None:
+        with span(op, **fields):
+            yield None
+        return
+    trace = recorder.start(op, kind="op", op=op, slow_threshold_s=slow_s,
+                           root_fields=fields)
+    if trace is None:
+        yield None
+        return
+    status = "ok"
+    with trace_scope(trace):
+        try:
+            yield trace
+        except BaseException:
+            status = "error"
+            raise
+        finally:
+            recorder.finish(trace, status=status)

@@ -38,6 +38,7 @@ import pyarrow as pa
 import logging
 
 from horaedb_tpu.common.error import ensure
+from horaedb_tpu.common.loops import loops
 from horaedb_tpu.storage.config import UpdateMode
 from horaedb_tpu.storage.read import (
     ScanPlan,
@@ -51,7 +52,8 @@ from horaedb_tpu.storage.storage import (
     WriteRequest,
     WriteResult,
 )
-from horaedb_tpu.utils import WIDE_BUCKETS, registry, span, trace_add
+from horaedb_tpu.utils import (WIDE_BUCKETS, op_trace, registry, span,
+                               trace_add)
 from horaedb_tpu.wal.config import WalConfig
 from horaedb_tpu.wal.log import Wal
 from horaedb_tpu.wal.memtable import MemEntry, Memtable
@@ -94,6 +96,10 @@ class IngestStorage(TimeMergeStorage):
         self._flush_wake: Optional[asyncio.Event] = None
         self._stopping = False
         self._last_flush_at: Optional[float] = None
+        # watchdog test hook: a positive value wedges the flush loop's
+        # next iteration (sleeps without heartbeating) so stall
+        # detection is testable against a REAL loop (tests/test_loops)
+        self.test_stall_s = 0.0
         # newest seq acked by this ingest front end (rollup lag signal)
         self.last_seq = 0
         # flush-commit hook: called with the segment start after an SST
@@ -140,9 +146,22 @@ class IngestStorage(TimeMergeStorage):
                         wal_dir, replayed, len(self._memtables))
         wal.start()
         self._flush_wake = asyncio.Event()
-        self._flusher_task = asyncio.create_task(
-            self._flush_loop(), name=f"wal-flusher:{wal_dir}")
+        # stall threshold sized to a worst-case flush (wide-bucket op:
+        # a big memtable's SST write runs minutes), not the poll period
+        self._flusher_task = loops.spawn(
+            self._flush_loop, name=f"wal-flusher:{wal_dir}",
+            kind="wal-flusher", owner="wal",
+            period_s=config.flush_interval.seconds,
+            stall_threshold_s=300.0,
+            backlog=self._flusher_backlog)
         return self
+
+    def _flusher_backlog(self) -> dict:
+        """/debug/tasks backlog hint: what the flusher is behind on."""
+        s = self.ingest_stats()
+        return {"memtable_rows": s["memtable_rows"],
+                "memtable_bytes": s["memtable_bytes"],
+                "wal_backlog_bytes": s["wal_backlog_bytes"]}
 
     async def close(self, flush: bool = True) -> None:
         self._stopping = True
@@ -209,21 +228,28 @@ class IngestStorage(TimeMergeStorage):
 
     # ---- flush ------------------------------------------------------------
 
-    async def _flush_loop(self) -> None:
+    async def _flush_loop(self, hb) -> None:
         interval = self.config.flush_interval.seconds
         while not self._stopping:
             try:
                 await asyncio.wait_for(self._flush_wake.wait(), interval)
             except asyncio.TimeoutError:
                 pass
+            if self.test_stall_s:
+                # injected stall (watchdog tests): wedge WITHOUT
+                # beating, exactly like a hung store call would
+                await asyncio.sleep(self.test_stall_s)
+            hb.beat()
             self._flush_wake.clear()
             if self._stopping:
                 return
             try:
                 await self._flush_due()
+                hb.ok()
             except asyncio.CancelledError:
                 raise
-            except Exception:  # noqa: BLE001 — flush retries next tick
+            except Exception as exc:  # noqa: BLE001 — retries next tick
+                hb.error(exc)
                 logger.exception("memtable flush pass failed")
 
     def _due(self, mt: Memtable) -> bool:
@@ -279,48 +305,57 @@ class IngestStorage(TimeMergeStorage):
                 if mt is not None:
                     mt.account_drop()
                 return 0
-            # the memtable stays scan-visible via _flushing while the
-            # SST write is in flight; a concurrent scan's overlay
-            # snapshot therefore always holds the rows, and once the
-            # manifest commit lands the seq tie dedups the double
-            self._flushing.setdefault(seg, []).append(mt)
-            try:
-                table, rng, seqs = mt.drain(self.inner.schema())
-                if table is not None:
-                    if self._on_op is not None:
-                        self._on_op("flush")
-                    # flushes run seconds-to-minutes on big memtables:
-                    # the wide buckets keep them out of the +Inf bin
-                    with span("memtable_flush", buckets=WIDE_BUCKETS,
-                              segment=seg, rows=mt.rows):
-                        await self.inner.write_stamped(table, rng)
-            except BaseException:
-                # the rows are acked: put them back so reads keep
-                # serving them; the WAL still covers them for replay
-                _FLUSH_FAILURES.inc()
-                self._flushing[seg].remove(mt)
-                mt.account_drop()
-                cur = self._memtables.get(seg)
-                if cur is None:
-                    cur = self._memtables[seg] = Memtable(
-                        seg, mt.created_at)
-                for e in mt.entries:
-                    cur.add(e)
-                raise
-            finally:
-                if mt in self._flushing.get(seg, ()):
-                    self._flushing[seg].remove(mt)
-                if not self._flushing.get(seg):
-                    self._flushing.pop(seg, None)
+            # each flush is a background operation with its own op
+            # trace — unless a query's aggregate pre-flush triggered
+            # it, in which case it records as that query's span
+            # (utils.tracing.op_trace's ambient check)
+            with op_trace("flush", slow_s=60.0, segment=seg,
+                          rows=mt.rows):
+                return await self._flush_taken(seg, mt)
+
+    async def _flush_taken(self, seg: int, mt: Memtable) -> int:
+        # the memtable stays scan-visible via _flushing while the
+        # SST write is in flight; a concurrent scan's overlay
+        # snapshot therefore always holds the rows, and once the
+        # manifest commit lands the seq tie dedups the double
+        self._flushing.setdefault(seg, []).append(mt)
+        try:
+            table, rng, seqs = mt.drain(self.inner.schema())
+            if table is not None:
+                if self._on_op is not None:
+                    self._on_op("flush")
+                # flushes run seconds-to-minutes on big memtables:
+                # the wide buckets keep them out of the +Inf bin
+                with span("memtable_flush", buckets=WIDE_BUCKETS,
+                          segment=seg, rows=mt.rows):
+                    await self.inner.write_stamped(table, rng)
+        except BaseException:
+            # the rows are acked: put them back so reads keep
+            # serving them; the WAL still covers them for replay
+            _FLUSH_FAILURES.inc()
+            self._flushing[seg].remove(mt)
             mt.account_drop()
-            self.wal.mark_flushed(seqs)
-            await self.wal.truncate()
-            self._last_flush_at = self._clock()
-            _FLUSHES.inc()
-            _FLUSH_ROWS.inc(mt.rows)
-            if self.on_flush is not None:
-                self.on_flush(seg)
-            return mt.rows
+            cur = self._memtables.get(seg)
+            if cur is None:
+                cur = self._memtables[seg] = Memtable(
+                    seg, mt.created_at)
+            for e in mt.entries:
+                cur.add(e)
+            raise
+        finally:
+            if mt in self._flushing.get(seg, ()):
+                self._flushing[seg].remove(mt)
+            if not self._flushing.get(seg):
+                self._flushing.pop(seg, None)
+        mt.account_drop()
+        self.wal.mark_flushed(seqs)
+        await self.wal.truncate()
+        self._last_flush_at = self._clock()
+        _FLUSHES.inc()
+        _FLUSH_ROWS.inc(mt.rows)
+        if self.on_flush is not None:
+            self.on_flush(seg)
+        return mt.rows
 
     # ---- read -------------------------------------------------------------
 

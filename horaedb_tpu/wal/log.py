@@ -37,8 +37,9 @@ from typing import Callable, Iterator, Optional
 import pyarrow as pa
 
 from horaedb_tpu.common.error import Error, ensure
+from horaedb_tpu.common.loops import loops
 from horaedb_tpu.storage.types import TimeRange
-from horaedb_tpu.utils import registry
+from horaedb_tpu.utils import op_trace, registry
 from horaedb_tpu.wal.config import WalConfig
 
 import logging
@@ -156,6 +157,7 @@ class Wal:
         self.dir = wal_dir
         self.config = config
         lab = {"log": os.path.basename(os.path.normpath(wal_dir)) or "wal"}
+        self._log_label = lab["log"]
         self._m_appends = _APPENDS.labels(**lab)
         self._m_group_commits = _GROUP_COMMITS.labels(**lab)
         self._m_bytes_written = _BYTES_WRITTEN.labels(**lab)
@@ -215,8 +217,14 @@ class Wal:
     def start(self) -> None:
         ensure(self._commit_task is None, "wal already started")
         self._wake = asyncio.Event()
-        self._commit_task = asyncio.create_task(
-            self._commit_loop(), name=f"wal-commit:{self.dir}")
+        # fsync rounds are seconds at worst even on sick disks; a
+        # committer that stops beating for 30 s is wedged, not busy
+        self._commit_task = loops.spawn(
+            self._commit_loop, name=f"wal-commit:{self.dir}",
+            kind="wal-commit", owner="wal", stall_threshold_s=30.0,
+            backlog=lambda: {"queued_records": len(self._queue),
+                             "queued_bytes": self._queue_bytes,
+                             "backlog_bytes": self.backlog_bytes})
 
     async def close(self) -> None:
         self._stopping = True
@@ -263,14 +271,17 @@ class Wal:
         self._wake.set()
         return await fut
 
-    async def _commit_loop(self) -> None:
+    async def _commit_loop(self, hb) -> None:
         cfg = self.config
         while True:
+            hb.idle()  # parked on the un-timed wake (healthy silence)
             await self._wake.wait()
+            hb.beat()
             self._wake.clear()
             if self._stopping and not self._queue:
                 return
             while self._queue:
+                hb.beat()
                 if (cfg.max_group_wait.seconds > 0
                         and self._queue_bytes < cfg.max_group_bytes
                         and not self._stopping):
@@ -284,7 +295,14 @@ class Wal:
                     size += len(item[0])
                 self._queue_bytes -= size
                 try:
-                    await self._commit_group(group, size)
+                    # one op trace per group-commit fsync round: the
+                    # write path's background half, objstore/bytes
+                    # attribution included (docs/observability.md)
+                    with op_trace("wal_commit", slow_s=5.0,
+                                  log=self._log_label,
+                                  records=len(group), bytes=size):
+                        await self._commit_group(group, size)
+                    hb.ok()
                 except asyncio.CancelledError:
                     for _, _, fut in group:
                         if not fut.done():
@@ -292,6 +310,7 @@ class Wal:
                     self._quarantine_active_nowait()
                     raise
                 except Exception as exc:  # noqa: BLE001 — fail the group
+                    hb.error(exc)
                     for _, _, fut in group:
                         if not fut.done():
                             fut.set_exception(

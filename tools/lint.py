@@ -33,6 +33,13 @@ Checks, all hard failures:
     `registry.counter/gauge/histogram(...)` call must pass non-empty
     help text (docs/observability.md — /metrics is an operator
     surface; a bare series name is not documentation)
+  - loop-registry discipline under horaedb_tpu/: spawning a
+    long-running loop coroutine (a callee whose name contains "loop")
+    via bare `asyncio.create_task` / `loop.create_task` /
+    `ensure_future` is an error outside common/loops.py — loops go
+    through `loops.spawn(...)` so every one is registered, heartbeats,
+    and appears in GET /debug/tasks (a loop born unwatched is a loop
+    that hangs unseen; docs/observability.md, background plane)
 
 Usage: python tools/lint.py [paths...]   (default: horaedb_tpu tests
 bench.py __graft_entry__.py)
@@ -179,6 +186,38 @@ def _rollup_scan_violation(node: ast.Call) -> bool:
                for tok in _ROLLUP_TOKENS)
 
 
+# task-spawn surfaces; spawning a LOOP through any of these bypasses
+# the loop registry (no heartbeat, no watchdog, invisible to
+# /debug/tasks).  The discriminator is the ARGUMENT: a call to a
+# function whose name contains "loop" — the repo's background loops
+# are all named *_loop / _loop by convention, and the spawn helper
+# (common/loops.py, the one exempt module) keeps that convention
+# enforceable.
+_TASK_SPAWNERS = {"create_task", "ensure_future"}
+
+
+def _unwatched_loop_spawn(node: ast.Call) -> bool:
+    """True for `asyncio.create_task(self._x_loop(...))`-shaped calls —
+    a long-running loop spawned outside the loop registry."""
+    func = node.func
+    if not isinstance(func, ast.Attribute) \
+            or func.attr not in _TASK_SPAWNERS:
+        return False
+    if not node.args:
+        return False
+    arg = node.args[0]
+    if not isinstance(arg, ast.Call):
+        return False
+    f = arg.func
+    if isinstance(f, ast.Attribute):
+        callee = f.attr
+    elif isinstance(f, ast.Name):
+        callee = f.id
+    else:
+        return False
+    return "loop" in callee.lower()
+
+
 # metric-factory methods on a registry object; any such call under
 # horaedb_tpu/ must pass non-empty help text (positional or help_=)
 _METRIC_FACTORIES = {"counter", "gauge", "histogram"}
@@ -298,6 +337,17 @@ def lint_file(path: pathlib.Path) -> list[str]:
                     "planner's coverage API (RollupManager.covers/"
                     "try_serve), which is what keeps stale cells from "
                     "serving (docs/rollups.md)")
+        elif (isinstance(node, ast.Call) and "horaedb_tpu" in path.parts
+                and path.name != "loops.py"
+                and _unwatched_loop_spawn(node)):
+            src = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+            if "noqa" not in src:
+                problems.append(
+                    f"{path}:{node.lineno}: long-running loop spawned "
+                    "with bare create_task/ensure_future — use "
+                    "common.loops.spawn(...) so the loop is registered, "
+                    "heartbeats, and the watchdog can flag a stall "
+                    "(GET /debug/tasks)")
         elif (isinstance(node, ast.Call) and "horaedb_tpu" in path.parts
                 and _metric_call_without_help(node)):
             src = lines[node.lineno - 1] if node.lineno <= len(lines) else ""

@@ -2,7 +2,11 @@
 """`make trace-demo`: start a local server, write + query a metric,
 then fetch the query's trace and pretty-print its span tree.
 
-Usage: python tools/trace_demo.py [--port N]
+With `--ops`, also exercise the BACKGROUND plane — force SSTs, trigger
+a compaction and a rollup maintenance pass — then pretty-print the most
+recent op traces (/debug/traces?kind=op) alongside the query tree.
+
+Usage: python tools/trace_demo.py [--port N] [--ops]
 """
 
 from __future__ import annotations
@@ -30,7 +34,37 @@ def _print_tree(node: dict, depth: int = 0) -> None:
         _print_tree(child, depth + 1)
 
 
-async def main(port: int) -> int:
+async def _print_op_trace(s, base: str, timeout, op: str,
+                          deadline_s: float = 20.0) -> None:
+    """Poll /debug/traces?op= until the newest trace of that op shows,
+    then print its tree (ops complete asynchronously to the admin
+    calls that provoked them)."""
+    t_end = asyncio.get_running_loop().time() + deadline_s
+    trace_id = None
+    while asyncio.get_running_loop().time() < t_end:
+        async with s.get(f"{base}/debug/traces?op={op}&limit=1",
+                         timeout=timeout) as r:
+            traces = (await r.json())["traces"]
+        if traces:
+            trace_id = traces[0]["trace_id"]
+            break
+        await asyncio.sleep(0.2)
+    if trace_id is None:
+        print(f"\n== no {op} op trace appeared within {deadline_s}s ==")
+        return
+    async with s.get(f"{base}/debug/traces/{trace_id}",
+                     timeout=timeout) as r:
+        trace = await r.json()
+    print(f"\n== op trace: {op} ({trace_id}, "
+          f"status={trace['status']}, slow={trace.get('slow')}) ==")
+    _print_tree(trace["tree"])
+    counters = {k: round(v, 2)
+                for k, v in sorted(trace.get("counters", {}).items())}
+    if counters:
+        print(json.dumps(counters, indent=2))
+
+
+async def main(port: int, ops: bool = False) -> int:
     import aiohttp
 
     from horaedb_tpu.server.config import ServerConfig, load_config
@@ -42,8 +76,17 @@ async def main(port: int) -> int:
         config = ServerConfig(
             port=port, test=config.test, admission=config.admission,
             breaker=config.breaker, wal=config.wal, trace=config.trace,
-            metric_engine=config.metric_engine)
+            metric_engine=config.metric_engine, rollup=config.rollup,
+            watchdog=config.watchdog, meta=config.meta)
         config.metric_engine.object_store.data_dir = tmp
+        if ops:
+            # make the background plane fire fast: eager compaction
+            # (2 small SSTs qualify) and standing rollups on the demo
+            # metric
+            sched = config.metric_engine.time_merge_storage.scheduler
+            sched.input_sst_min_num = 2
+            config.rollup.enabled = True
+            config.rollup.specs = ["demo.cpu"]
         ready = asyncio.Event()
         server = asyncio.create_task(run_server(config, ready=ready))
         await asyncio.wait_for(ready.wait(), 30)
@@ -72,13 +115,44 @@ async def main(port: int) -> int:
                              timeout=timeout) as r:
                 assert r.status == 200, await r.text()
                 trace = await r.json()
-        print(f"\n== span tree for {trace_id} "
-              f"(status={trace['status']}, slow={trace.get('slow')}) ==")
-        _print_tree(trace["tree"])
-        counters = {k: round(v, 2)
-                    for k, v in sorted(trace.get("counters", {}).items())}
-        print("\n== per-trace counters ==")
-        print(json.dumps(counters, indent=2))
+            print(f"\n== span tree for {trace_id} "
+                  f"(status={trace['status']}, "
+                  f"slow={trace.get('slow')}) ==")
+            _print_tree(trace["tree"])
+            counters = {k: round(v, 2) for k, v in
+                        sorted(trace.get("counters", {}).items())}
+            print("\n== per-trace counters ==")
+            print(json.dumps(counters, indent=2))
+            if ops:
+                # second SST in the same segment, then provoke the two
+                # showcase ops: a compaction rewrite and a roll pass
+                samples2 = [{"name": "demo.cpu",
+                             "labels": {"host": f"h{i % 4}"},
+                             "timestamp": t0 + i * 1000 + 500,
+                             "value": float(i) * 2}
+                            for i in range(400)]
+                async with s.post(f"{base}/write",
+                                  json={"samples": samples2},
+                                  timeout=timeout) as r:
+                    assert r.status == 200, await r.text()
+                async with s.get(f"{base}/compact", timeout=timeout) as r:
+                    assert r.status == 200, await r.text()
+                async with s.post(f"{base}/admin/rollups",
+                                  json={"roll": True},
+                                  timeout=timeout) as r:
+                    assert r.status == 200, await r.text()
+                await _print_op_trace(s, base, timeout, "compaction")
+                await _print_op_trace(s, base, timeout, "rollup_pass",
+                                      deadline_s=5.0)
+                async with s.get(f"{base}/debug/tasks",
+                                 timeout=timeout) as r:
+                    tasks = await r.json()
+                print("\n== /debug/tasks (background loops) ==")
+                for lp in tasks["loops"]:
+                    print(f"  {lp['kind']:<18s} alive={lp['alive']} "
+                          f"hb_age={lp['heartbeat_age_s']:>7.3f}s "
+                          f"stalled={lp['stalled']} "
+                          f"errs={lp['consecutive_errors']}")
         server.cancel()
         try:
             await server
@@ -90,4 +164,9 @@ async def main(port: int) -> int:
 if __name__ == "__main__":
     parser = argparse.ArgumentParser("trace-demo")
     parser.add_argument("--port", type=int, default=5123)
-    sys.exit(asyncio.run(main(parser.parse_args().port)))
+    parser.add_argument("--ops", action="store_true",
+                        help="also provoke + pretty-print background "
+                             "op traces (compaction, roll pass) and "
+                             "the /debug/tasks loop table")
+    args = parser.parse_args()
+    sys.exit(asyncio.run(main(args.port, ops=args.ops)))
