@@ -21,6 +21,9 @@ SCANCACHE_SCHEDULES ?= 40
 ROLLUP_SEED ?= 1337
 ROLLUP_SCHEDULES ?= 24
 
+PIPELINE_SEED ?= 1337
+PIPELINE_SCHEDULES ?= 10
+
 chaos:
 	TORTURE_SEED=$(TORTURE_SEED) TORTURE_SCHEDULES=$(TORTURE_SCHEDULES) \
 	WAL_TORTURE_SEED=$(WAL_TORTURE_SEED) \
@@ -29,9 +32,12 @@ chaos:
 	SCANCACHE_SCHEDULES=$(SCANCACHE_SCHEDULES) \
 	ROLLUP_SEED=$(ROLLUP_SEED) \
 	ROLLUP_SCHEDULES=$(ROLLUP_SCHEDULES) \
+	PIPELINE_SEED=$(PIPELINE_SEED) \
+	PIPELINE_SCHEDULES=$(PIPELINE_SCHEDULES) \
 	python -m pytest tests/test_fault_injection.py tests/test_torture.py \
 	tests/test_objstore_middleware.py tests/test_wal.py \
-	tests/test_scan_cache.py tests/test_rollup.py -q
+	tests/test_scan_cache.py tests/test_rollup.py \
+	tests/test_pipeline.py -q
 
 # stdlib AST lint gate (the reference CI runs fmt+clippy -D warnings;
 # this image ships no ruff/flake8, so the gate is tools/lint.py)

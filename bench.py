@@ -22,11 +22,13 @@ backend — such numbers are NOT device numbers.
 
 Env knobs: BENCH_ROWS (default 10_000_000), BENCH_ITERS (default 20),
 BENCH_CONFIG (default 1 = end-to-end engine; 0 = device kernel
-microbench; 2-11 delegate to horaedb_tpu.bench.suite, 6 being the
+microbench; 2-13 delegate to horaedb_tpu.bench.suite, 6 being the
 manifest snapshot codec, 7 the mixed read/write churn workload,
 8 the durable-ingest WAL group-commit bench, 9 the tiered scan-cache
-cold ladder, 10 the query-tracing overhead A/B, and 11 the
-standing-rollup dashboard mix vs the raw cold scan).
+cold ladder, 10 the query-tracing overhead A/B, 11 the
+standing-rollup dashboard mix vs the raw cold scan, 12 the
+background-plane overhead A/B, and 13 the pipelined cold-scan ladder
+vs the [scan.pipeline] off control).
 """
 
 import asyncio
@@ -526,7 +528,7 @@ def main() -> None:
     try:
         config = int(os.environ.get("BENCH_CONFIG", 1))
     except ValueError:
-        sys.exit(f"BENCH_CONFIG must be 0-12, got "
+        sys.exit(f"BENCH_CONFIG must be 0-13, got "
                  f"{os.environ.get('BENCH_CONFIG')!r}")
 
     ensure_responsive_backend()
@@ -542,7 +544,7 @@ def main() -> None:
         from horaedb_tpu.bench.suite import RUNNERS
 
         if config not in RUNNERS:
-            sys.exit(f"BENCH_CONFIG must be 0-12, got {config}")
+            sys.exit(f"BENCH_CONFIG must be 0-13, got {config}")
         result = RUNNERS[config](rows, iters)
     # a config's own backend/fallback labels win (config 6 is pure host
     # work and must never read as a device number)

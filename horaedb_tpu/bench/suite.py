@@ -1828,9 +1828,193 @@ def run_config12(rows: int, iters: int) -> dict:
     }
 
 
+def run_config13(rows: int, iters: int) -> dict:
+    """Cold-scan pipeline ladder (ISSUE 8): the config-9 workload and
+    25 ms-latency seeded fault store, measured with the pipelined cold
+    path against the `[scan.pipeline] enabled = false` control —
+    everything else identical.
+
+      cached          tier-1 hit (the denominator for cold_vs_cached)
+      tier2_cold      tier-1 evicted, tier-2 encoded parts warm —
+                      fetch serves from host RAM, pipeline overlaps
+                      decode with device rounds
+      true_cold       both tiers cleared: the full-latency object
+                      store read, pipelined (fetch depth hides the
+                      per-segment round trips)
+      true_cold_pipeline_off   the control: same store, same data,
+                      pipeline disabled (the pre-change pump)
+      tier2_cold_pipeline_off  decode/device control without store IO
+
+    Done-bars: true_cold >= 2.5x faster than the pipeline-off control;
+    cold within 3x of cached (or the measured gap + blocking cause
+    recorded in ROADMAP item 1).  Data-plane GETs per leg prove which
+    tier served."""
+    import os
+
+    import pyarrow as pa
+
+    from horaedb_tpu.metric_engine import MetricEngine
+    from horaedb_tpu.objstore import (
+        FaultInjectingStore,
+        MemoryObjectStore,
+        WrappedObjectStore,
+    )
+    from horaedb_tpu.storage.config import StorageConfig, from_dict
+    from horaedb_tpu.storage.read import plan_stage_snapshot
+    from horaedb_tpu.storage.types import TimeRange
+
+    class DataGetCounter(WrappedObjectStore):
+        def __init__(self, inner):
+            super().__init__(inner)
+            self.data_gets = 0
+
+        async def _call(self, op: str, *args):
+            if op in ("get", "get_range") and str(args[0]).endswith(
+                    (".sst", ".enc")):
+                self.data_gets += 1
+            return await super()._call(op, *args)
+
+    lat_s = float(os.environ.get("BENCH_STORE_LATENCY_MS", "25")) / 1e3
+    hosts = 100
+    interval = 10_000
+    bucket_ms = 60_000
+    per_host = max(60, rows // hosts)
+    span = per_host * interval
+    segment_ms = 2 * 3600 * 1000
+    T0 = (1_700_000_000_000 // segment_ms) * segment_ms
+    rng = np.random.default_rng(13)
+    n = per_host * hosts
+    ts = T0 + np.repeat(
+        np.arange(per_host, dtype=np.int64) * interval, hosts)
+    host_id = np.tile(np.arange(hosts, dtype=np.int32), per_host)
+    vals = (rng.random(n) * 100).astype(np.float64)
+    names = pa.array([f"host_{i:03d}" for i in range(hosts)])
+    _check_i32_span(np.asarray([span]), "config13")
+    k_cold = max(3, iters // 3)
+
+    def cfg_of(pipelined: bool):
+        return from_dict(StorageConfig, {
+            "scheduler": {"schedule_interval": "1h"},
+            "scan": {"cache_max_rows": n * 4,
+                     "cache": {"tier2_max_bytes": 1 << 30},
+                     "pipeline": {"enabled": pipelined}},
+        })
+
+    async def ingest(e):
+        chunk = max(1, 1_000_000 // hosts) * hosts
+        for lo in range(0, n, chunk):
+            hi = min(n, lo + chunk)
+            await e.write_arrow("cpu", ["host"], pa.record_batch({
+                "host": pa.DictionaryArray.from_arrays(
+                    pa.array(host_id[lo:hi]), names),
+                "timestamp": pa.array(ts[lo:hi], type=pa.int64()),
+                "value": pa.array(vals[lo:hi], type=pa.float64()),
+            }))
+
+    async def query(e):
+        return await e.query_downsample(
+            "cpu", [], TimeRange.new(T0, T0 + span),
+            bucket_ms=bucket_ms, aggs=("avg",))
+
+    async def timed(e, reps: int, reset=None, profile: bool = False):
+        times, prof = [], {}
+        for i in range(reps):
+            if reset is not None:
+                reset()
+            before = plan_stage_snapshot() if profile and i == 0 else None
+            t0 = time.perf_counter()
+            await query(e)
+            times.append(time.perf_counter() - t0)
+            if before is not None:
+                after = plan_stage_snapshot()
+                prof = {kk: round(after[kk] - before[kk], 4)
+                        for kk in after if after[kk] != before[kk]}
+        return float(np.percentile(times, 50)), prof
+
+    async def go():
+        out = {"store_latency_ms": lat_s * 1e3}
+        store = DataGetCounter(FaultInjectingStore(
+            MemoryObjectStore(), seed=13,
+            latency_range=(lat_s, lat_s)))
+        e = await MetricEngine.open("cfg13", store,
+                                    segment_ms=segment_ms,
+                                    config=cfg_of(True))
+        try:
+            await ingest(e)
+        finally:
+            await e.close()
+
+        gets_mark = store.data_gets
+
+        def leg_gets() -> int:
+            nonlocal gets_mark
+            prev, gets_mark = gets_mark, store.data_gets
+            return gets_mark - prev
+
+        for label, pipelined in (("", True), ("_pipeline_off", False)):
+            e = await MetricEngine.open("cfg13", store,
+                                        segment_ms=segment_ms,
+                                        config=cfg_of(pipelined))
+            try:
+                table = e.tables["data"]
+                await query(e)  # compile + warm both tiers
+                leg_gets()
+                if pipelined:
+                    cached, _ = await timed(e, iters)
+                    out["cached_p50_ms"] = round(cached * 1e3, 3)
+                    out["data_gets_cached"] = leg_gets()
+                tier2, prof2 = await timed(
+                    e, k_cold, reset=table.reader.scan_cache.clear,
+                    profile=pipelined)
+                out[f"tier2_cold{label}_p50_ms"] = round(tier2 * 1e3, 3)
+                out[f"data_gets_tier2{label}"] = leg_gets()
+                if pipelined:
+                    out["stage_profile_tier2"] = prof2
+                cold, prof0 = await timed(
+                    e, k_cold,
+                    reset=lambda t=table: _clear_scan_tiers(t),
+                    profile=pipelined)
+                out[f"true_cold{label}_p50_ms"] = round(cold * 1e3, 3)
+                out[f"data_gets_true_cold{label}"] = leg_gets()
+                if pipelined:
+                    out["stage_profile_true_cold"] = prof0
+                    out["pipeline_high_water_mb"] = round(
+                        table.reader._pipeline_high_water / 2**20, 1)
+            finally:
+                await e.close()
+        return out
+
+    out = asyncio.run(go())
+    cached = out["cached_p50_ms"]
+    cold = out["true_cold_p50_ms"]
+    off = out["true_cold_pipeline_off_p50_ms"]
+    out["pipeline_speedup_true_cold"] = round(off / cold, 2)
+    out["pipeline_speedup_tier2"] = round(
+        out["tier2_cold_pipeline_off_p50_ms"]
+        / out["tier2_cold_p50_ms"], 2)
+    out["cold_vs_cached"] = round(cold / cached, 2)
+    _log(f"config13: cached {cached:.1f} ms | tier2-cold "
+         f"{out['tier2_cold_p50_ms']:.1f} ms "
+         f"({out['pipeline_speedup_tier2']}x vs off) | true-cold "
+         f"{cold:.1f} ms ({out['pipeline_speedup_true_cold']}x vs "
+         f"off {off:.1f} ms) | cold/cached {out['cold_vs_cached']}x")
+    return {
+        "metric": (f"pipelined cold scan: true-cold downsample p50 over "
+                   f"a seeded {out['store_latency_ms']:.0f}ms-latency "
+                   f"store, {n / 1e6:.1f}M rows"),
+        "value": out["true_cold_p50_ms"],
+        "unit": "ms",
+        # done-bar: pipelined true-cold >= 2.5x the disabled control
+        "vs_baseline": out["pipeline_speedup_true_cold"],
+        "rows": n,
+        **out,
+    }
+
+
 RUNNERS = {2: run_config2, 3: run_config3, 4: run_config4, 5: run_config5,
            6: run_config6, 7: run_config7, 8: run_config8, 9: run_config9,
-           10: run_config10, 11: run_config11, 12: run_config12}
+           10: run_config10, 11: run_config11, 12: run_config12,
+           13: run_config13}
 
 
 def main() -> None:

@@ -162,6 +162,31 @@ class ScanCacheConfig:
 
 
 @dataclass
+class ScanPipelineConfig:
+    """Cold-scan pipelining ([scan.pipeline]): the cold read path runs
+    as a bounded producer/consumer pipeline — a fetch stage that keeps
+    up to `depth` segments' store reads in flight (tier-2-resident
+    parts skip the store entirely), a decode/merge stage on the CPU
+    pool, and the device stage consuming finished windows — instead of
+    phase-at-a-time per segment.  `enabled = false` reproduces the
+    pre-pipeline sequential path exactly (results are bit-identical
+    either way; the seeded chaos suite asserts it)."""
+
+    enabled: bool = True
+    # segments in flight across the whole pipeline (fetch started ->
+    # consumed); replaces [scan] prefetch_segments when enabled.  On a
+    # 25 ms-latency object store every unit of depth hides another
+    # segment's round trips behind the current segment's decode.
+    depth: int = 32
+    # host-RAM byte budget for in-flight pipeline state (fetched
+    # encoded parts/tables + decoded-but-unconsumed windows).  A slow
+    # device stage backpressures fetch/decode here instead of
+    # ballooning RAM; one oversized segment is still always admitted
+    # (progress over the soft bound).
+    inflight_bytes: int = 256 << 20
+
+
+@dataclass
 class ScanConfig:
     """Device scan execution knobs (no reference analogue — the TPU
     build's HBM-budget control, SURVEY.md hard part #5)."""
@@ -219,6 +244,11 @@ class ScanConfig:
     decode_workers: int = 0
     # tiered scan-cache knobs ([scan.cache])
     cache: ScanCacheConfig = field(default_factory=ScanCacheConfig)
+    # cold-scan pipelining knobs ([scan.pipeline]); when enabled the
+    # pipeline's depth/inflight_bytes supersede prefetch_segments on
+    # the cold path (the off path keeps using prefetch_segments)
+    pipeline: ScanPipelineConfig = field(
+        default_factory=ScanPipelineConfig)
 
 
 @dataclass
@@ -257,6 +287,7 @@ _NESTED = {
     "scheduler": SchedulerConfig,
     "scan": ScanConfig,
     "cache": ScanCacheConfig,
+    "pipeline": ScanPipelineConfig,
     "threads": ThreadsConfig,
     "retry": RetryConfig,
     "scrub": ScrubConfig,

@@ -169,6 +169,15 @@ class EncodedSegmentCache:
         trace_add("cache_tier2_bytes", nbytes)
         return {nm: cols[nm] for nm in want}, n
 
+    def peek(self, sst_id: int, want) -> bool:
+        """Stats-free residency probe: True iff get() would hit.  No
+        LRU bump, no hit/miss counters, no trace attribution — the
+        scan pipeline's is-it-worth-it probe runs this over every
+        to-read segment and must not distort cache telemetry (the real
+        read that follows does the counting)."""
+        entry = self._entries.get(sst_id)
+        return entry is not None and set(want) <= entry[0].keys()
+
     def put(self, sst_id: int, cols: dict, n_rows: int) -> None:
         """Read-path insert of a COMPLETE part (all rows of the SST for
         these columns).  ZERO-COPY: the arrays are deserialize's views

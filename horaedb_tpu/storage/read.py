@@ -157,6 +157,14 @@ def plan_stage_snapshot() -> dict:
         out[f"{s}_rows"] = int(c.value)
     for s, c in _STAGE_BYTES.items():
         out[f"{s}_bytes"] = int(c.value)
+    from horaedb_tpu.storage import pipeline as pipeline_mod
+
+    stalls = pipeline_mod.stall_counts()
+    for s in pipeline_mod.PIPELINE_STAGES:
+        h = pipeline_mod.STAGE_SECONDS[s]
+        out[f"pipeline_{s}_s"] = round(h.sum, 6)
+        out[f"pipeline_{s}_calls"] = h.count
+        out[f"pipeline_stalls_{s}"] = stalls[s]
     return out
 # segment tables held in memory at once by _prefetch_tables (bounds BOTH
 # the row-scan and aggregate paths — including compaction's scan);
@@ -287,6 +295,14 @@ class ScanPlan:
     # fresh SST in the same segment but outside the requested range
     # must not leak rows into the results)
     range: Optional[TimeRange] = None
+    # set by _cached_windows when it routes this plan through the scan
+    # pipeline (pipeline_on() AND the has-store-I/O probe passed); the
+    # device stage reads it to decide whether aggregation rounds
+    # overlap the window feed — one decision per scan, both layers
+    # agree (an all-tier-2-resident scan overlapping device rounds
+    # with decode measurably LOSES on low-core hosts, same contention
+    # as the fetch/decode stages)
+    pipeline_active: bool = False
 
 
 class ParquetReader:
@@ -355,6 +371,9 @@ class ParquetReader:
         self.encoded_cache = EncodedSegmentCache(
             config.scan.cache.tier2_max_bytes,
             write_through=config.scan.cache.write_through)
+        # high-water of pipeline in-flight host bytes observed by this
+        # reader's scans (pipeline.PipelineBudget; /stats "pipeline")
+        self._pipeline_high_water = 0
         self.mesh = None
         self._mesh_agg_fns: dict = {}
         self._mesh_merge_fns: dict = {}
@@ -397,9 +416,15 @@ class ParquetReader:
     # ---- execution ---------------------------------------------------------
 
     async def execute(self, plan: ScanPlan) -> AsyncIterator[pa.RecordBatch]:
-        async for _seg_start, batch in self.execute_segments(plan):
-            if batch is not None:
-                yield batch
+        seg_iter = self.execute_segments(plan)
+        try:
+            async for _seg_start, batch in seg_iter:
+                if batch is not None:
+                    yield batch
+        finally:
+            # an abandoned consumer must drain the pipeline NOW, not
+            # at GC-time async-gen finalization
+            await seg_iter.aclose()
 
     async def execute_segments(self, plan: ScanPlan):
         """Like execute(), but yields (segment_start, batch_or_None) —
@@ -530,6 +555,16 @@ class ParquetReader:
             finally:
                 await mesh_iter.aclose()
             return
+        if self.pipeline_on() and self._pipeline_has_io(plan, to_read):
+            plan.pipeline_active = True
+            pipe_iter = self._cached_windows_pipelined(plan, cached,
+                                                       to_read)
+            try:
+                async for out in pipe_iter:
+                    yield out
+            finally:
+                await pipe_iter.aclose()
+            return
 
         # the shared _segment_feed owns the streamed/bulk split and the
         # prefetch priming; pump() adds the merge-dispatch LOOKAHEAD on
@@ -557,14 +592,8 @@ class ParquetReader:
                 return
             dispatched: list = []
             if table.num_rows:
-                def encode_and_dispatch(tbl=table):
-                    if isinstance(tbl, sidecar.EncodedSegment):
-                        return self._dispatch_encoded_windows(tbl)
-                    batch = tbl.combine_chunks().to_batches()[0]
-                    return self._dispatch_merged_windows(batch)
-
-                dispatched = await self._run_pool(plan.pool,
-                                                  encode_and_dispatch)
+                dispatched = await self._run_pool(
+                    plan.pool, self._dispatch_segment_table, table)
             pending.append((fseg, "bulk", dispatched, read_s))
 
         try:
@@ -581,31 +610,8 @@ class ParquetReader:
                 read_seg, kind, dispatched, read_s = pending.popleft()
                 assert read_seg is seg
                 if kind == "stream":
-                    t0 = time.perf_counter()
-                    es_iter = await self._open_sidecar_stream(seg, plan)
-                    if es_iter is not None:
-                        try:
-                            async for es in es_iter:
-                                dispatched.extend(await self._run_pool(
-                                    plan.pool,
-                                    self._dispatch_encoded_windows, es))
-                        except Exception as exc:  # noqa: BLE001
-                            # nothing has been yielded for this segment
-                            # yet (windows buffer here), so a clean
-                            # whole-segment fallback is safe
-                            logger.warning(
-                                "sidecar stream failed for segment %s "
-                                "(%s); falling back to parquet",
-                                seg.segment_start, exc)
-                            dispatched = []
-                            es_iter = None
-                    if es_iter is None:
-                        async for batch in self._stream_window_batches(
-                                seg, plan):
-                            dispatched.extend(await self._run_pool(
-                                plan.pool,
-                                self._dispatch_merged_windows, batch))
-                    read_s = time.perf_counter() - t0
+                    dispatched, read_s = \
+                        await self._read_streamed_dispatched(seg, plan)
                 windows = await self._run_pool(
                     plan.pool, self._finalize_windows, dispatched)
                 if plan.use_cache:
@@ -614,6 +620,121 @@ class ParquetReader:
                 yield seg, windows, read_s
         finally:
             await feed.aclose()
+
+    def pipeline_on(self) -> bool:
+        """Whether OVERWRITE cold scans run through the bounded
+        producer/consumer pipeline (storage/pipeline.py).  Meshed scans
+        keep their own round scheduler; [scan.pipeline] enabled = false
+        reproduces the pre-pipeline pump exactly."""
+        return self.config.scan.pipeline.enabled and self.mesh is None
+
+    def _pipeline_has_io(self, plan: ScanPlan, to_read: list) -> bool:
+        """Whether pipelining this scan can pay for itself: the
+        pipeline exists to hide object-store latency behind decode and
+        device work, so a scan whose every bulk segment is already
+        tier-2 resident (zero store I/O — the post-flush / warm-cache
+        regime) runs the sequential pump instead.  On low-core hosts
+        the stages' concurrency measurably INFLATES the same CPU work
+        (GIL + XLA intra-op contention: tier2-cold 56-segment A/B
+        showed encode_merge 2.8x and device rounds 2.3x slower wall
+        under overlap, 0.7x end to end) — with no latency left to hide
+        there is nothing to win it back.  Streamed segments read the
+        store incrementally and any non-resident bulk segment fetches
+        it, so either makes the pipeline worthwhile.  The probe is the
+        cache's stats-free peek — it must not bump LRU recency or
+        hit/miss telemetry (the real reads that follow do that)."""
+        if not self._sidecar_plan_ok(plan):
+            return bool(to_read)  # every read is a store read
+        leaf_cols = {lf.column for lf in plan.prune_leaves or []}
+
+        def resident(seg: SegmentPlan) -> bool:
+            if self.encoded_cache.is_assembly_failed(
+                    frozenset(f.id for f in seg.ssts)):
+                return False
+            want = set(seg.columns) | leaf_cols
+            return all(self.encoded_cache.peek(f.id, want)
+                       for f in seg.ssts)
+
+        return any(self._stream_segment(seg) or not resident(seg)
+                   for seg in to_read)
+
+    async def _cached_windows_pipelined(self, plan: ScanPlan,
+                                        cached: dict, to_read: list):
+        """Pipelined twin of the pump below: fetch and decode/merge run
+        as background stages (storage/pipeline.py) while this consumer
+        — the device stage's doorstep — yields segments in plan order.
+        Same outputs, same cache puts, same error positions; only the
+        schedule differs (tests/test_pipeline.py asserts
+        bit-identically)."""
+        from horaedb_tpu.storage.pipeline import ScanPipeline
+
+        pipe = ScanPipeline(self, plan, to_read)
+        try:
+            for seg in plan.segments:
+                # cooperative deadline checkpoint between segments,
+                # same position as the pump's
+                deadline_checkpoint()
+                if id(seg) in cached:
+                    yield seg, cached[id(seg)], 0.0
+                    continue
+                got, windows, read_s = await pipe.next_segment()
+                assert got is seg
+                if plan.use_cache:
+                    self.scan_cache.put(self._cache_key(seg, plan),
+                                        windows)
+                yield seg, windows, read_s
+        finally:
+            # deterministic teardown: cancels the stage tasks and
+            # AWAITS them, draining any in-flight pool job before the
+            # caller proceeds to table/engine teardown
+            await pipe.aclose()
+
+    async def _read_streamed_dispatched(self, seg: SegmentPlan,
+                                        plan: ScanPlan):
+        """One streamed segment's windows, dispatched (pre-finalize):
+        sidecar stream first, whole-segment parquet-stream fallback.
+        Returns (dispatched, read_seconds) — shared by the sequential
+        pump and the pipeline's decode stage so the two cannot
+        drift."""
+        t0 = time.perf_counter()
+        dispatched: list = []
+        es_iter = await self._open_sidecar_stream(seg, plan)
+        if es_iter is not None:
+            try:
+                async for es in es_iter:
+                    dispatched.extend(await self._run_pool(
+                        plan.pool, self._dispatch_encoded_windows, es))
+            except Exception as exc:  # noqa: BLE001
+                # nothing has been yielded for this segment yet
+                # (windows buffer here), so a clean whole-segment
+                # fallback is safe
+                logger.warning(
+                    "sidecar stream failed for segment %s (%s); "
+                    "falling back to parquet", seg.segment_start, exc)
+                dispatched = []
+                es_iter = None
+        if es_iter is None:
+            async for batch in self._stream_window_batches(seg, plan):
+                dispatched.extend(await self._run_pool(
+                    plan.pool, self._dispatch_merged_windows, batch))
+        return dispatched, time.perf_counter() - t0
+
+    def _dispatch_segment_table(self, table) -> list:
+        """Pool-side encode+merge dispatch of one bulk segment's read
+        result (pa.Table or sidecar.EncodedSegment) — the ONE body
+        shared by the sequential pump and the pipeline's decode stage
+        so the two cannot drift."""
+        if isinstance(table, sidecar.EncodedSegment):
+            return self._dispatch_encoded_windows(table)
+        batch = table.combine_chunks().to_batches()[0]
+        return self._dispatch_merged_windows(batch)
+
+    def _decode_segment_windows(self, table, plan: ScanPlan) -> list:
+        """The pipeline's decode stage body, one pool dispatch per
+        segment: encode + k-way merge + window planning + finalize
+        fused — no intermediate hand-back to the event loop between
+        them.  `table` is a pa.Table or sidecar.EncodedSegment."""
+        return self._finalize_windows(self._dispatch_segment_table(table))
 
     async def _cached_windows_mesh(self, plan: ScanPlan, cached: dict,
                                    to_read: list):
@@ -828,24 +949,7 @@ class ParquetReader:
 
         async def read(seg: SegmentPlan):
             await sem.acquire()
-            t0 = time.perf_counter()
-            table = None
-            stage = "sidecar_read"
-            if self._sidecar_plan_ok(plan):
-                table = await self._read_segment_encoded(seg, plan)
-            if table is None:
-                stage = "parquet_read"
-                table = await self._read_segment_table(
-                    seg, plan.pushdown, pool=plan.pool,
-                    leaves=plan.prune_leaves)
-            read_s = time.perf_counter() - t0
-            _STAGE_SECONDS[stage].observe(read_s)
-            _STAGE_ROWS[stage].inc(table.num_rows)
-            _STAGE_BYTES[stage].inc(table.nbytes)
-            trace_add(f"stage_{stage}_ms", read_s * 1e3)
-            trace_add(f"stage_{stage}_rows", table.num_rows)
-            trace_add(f"stage_{stage}_bytes", table.nbytes)
-            return table, read_s
+            return await self._read_segment_any(seg, plan)
 
         tasks = [asyncio.create_task(read(seg)) for seg in segments]
         try:
@@ -858,6 +962,40 @@ class ParquetReader:
         finally:
             for task in tasks:
                 task.cancel()
+            # drain, don't just cancel: a read whose pool job (sidecar
+            # deserialize, parquet decode) is mid-flight only finishes
+            # after the job does — awaiting here keeps cancelled-scan
+            # teardown from racing in-flight decode work (the PR 3
+            # discipline), and retrieves failed reads' exceptions
+            await asyncio.gather(*tasks, return_exceptions=True)
+
+    async def _read_segment_any(self, seg: SegmentPlan, plan: ScanPlan,
+                                runner=None):
+        """One bulk segment's cold read — tier-2/sidecar serve resident
+        parts and fetch only missing SSTs; parquet is the fallback —
+        with read-stage attribution.  Returns (table, read_seconds);
+        `table` is a pa.Table or sidecar.EncodedSegment.  Shared by the
+        sequential prefetch and the pipeline's fetch stage (which
+        bounds the CPU-side deserialize concurrency via `runner`)."""
+        t0 = time.perf_counter()
+        table = None
+        stage = "sidecar_read"
+        if self._sidecar_plan_ok(plan):
+            table = await self._read_segment_encoded(seg, plan,
+                                                     runner=runner)
+        if table is None:
+            stage = "parquet_read"
+            table = await self._read_segment_table(
+                seg, plan.pushdown, pool=plan.pool,
+                leaves=plan.prune_leaves)
+        read_s = time.perf_counter() - t0
+        _STAGE_SECONDS[stage].observe(read_s)
+        _STAGE_ROWS[stage].inc(table.num_rows)
+        _STAGE_BYTES[stage].inc(table.nbytes)
+        trace_add(f"stage_{stage}_ms", read_s * 1e3)
+        trace_add(f"stage_{stage}_rows", table.num_rows)
+        trace_add(f"stage_{stage}_bytes", table.nbytes)
+        return table, read_s
 
     def _sidecar_plan_ok(self, plan: ScanPlan) -> bool:
         """Whether this plan may serve bulk segments from device-layout
@@ -870,7 +1008,67 @@ class ParquetReader:
             return False
         return plan.pushdown is None or plan.prune_leaves is not None
 
-    async def _read_segment_encoded(self, seg: SegmentPlan, plan: ScanPlan
+    def _resident_segment_parts(self, seg: SegmentPlan,
+                                plan: ScanPlan) -> Optional[list]:
+        """Event-loop-side tier-2 residency probe: every SST's encoded
+        part for this plan's column set, straight from the cache — or
+        None when any part is missing (or a negative memo says the
+        sidecar path is doomed), in which case the full fetch path
+        decides between store reads and the parquet fallback.
+
+        The pipeline's fetch stage uses this so ALL-RESIDENT segments
+        never dispatch a pool job from fetch: on a 2-core host, N
+        in-flight fetches each racing an assemble job starved the
+        decode/device stages the consumer was actually waiting on
+        (priority inversion measured as tier2-cold 0.74x vs the
+        sequential pump) — resident segments instead assemble inside
+        the decode stage's one serial pool dispatch."""
+        if not self._sidecar_plan_ok(plan):
+            return None
+        if any(self.encoded_cache.is_missing(f.id) for f in seg.ssts):
+            return None
+        if self.encoded_cache.is_assembly_failed(
+                frozenset(f.id for f in seg.ssts)):
+            return None
+        want = set(seg.columns) | {lf.column
+                                   for lf in plan.prune_leaves or []}
+        parts = []
+        for f in seg.ssts:
+            part = self.encoded_cache.get(f.id, want)
+            if part is None:
+                return None
+            parts.append(part)
+        return parts
+
+    def _assemble_resident_segment(self, seg: SegmentPlan, parts: list,
+                                   plan: ScanPlan
+                                   ) -> Optional[sidecar.EncodedSegment]:
+        """Pool-side assemble of tier-2-resident parts with the same
+        stage attribution the fetch path gives an assembled segment.
+        None = assembly failed (the CALLER memoizes the composition on
+        the event loop and falls back to parquet — the cache's negative
+        memos are loop-owned)."""
+        t0 = time.perf_counter()
+        try:
+            es = sidecar.assemble_parts(parts, list(seg.columns),
+                                        plan.prune_leaves)
+        except Exception as exc:  # noqa: BLE001 — cache read only
+            logger.warning("sidecar assembly raised for segment %s: %s",
+                           seg.segment_start, exc)
+            es = None
+        if es is None:
+            return None
+        read_s = time.perf_counter() - t0
+        _STAGE_SECONDS["sidecar_read"].observe(read_s)
+        _STAGE_ROWS["sidecar_read"].inc(es.n)
+        _STAGE_BYTES["sidecar_read"].inc(es.nbytes)
+        trace_add("stage_sidecar_read_ms", read_s * 1e3)
+        trace_add("stage_sidecar_read_rows", es.n)
+        trace_add("stage_sidecar_read_bytes", es.nbytes)
+        return es
+
+    async def _read_segment_encoded(self, seg: SegmentPlan, plan: ScanPlan,
+                                    runner=None
                                     ) -> Optional[sidecar.EncodedSegment]:
         """Segment read that never touches parquet: serve each SST's
         encoded part from tier 2 when resident, fetch only the missing
@@ -879,7 +1077,9 @@ class ParquetReader:
         new small SST in an otherwise-unchanged segment) only that SST
         crosses the wire — and with write-through admission not even
         that.  None (→ parquet fallback) when any SST lacks a valid
-        sidecar."""
+        sidecar.  `runner` overrides the pool dispatch for the
+        CPU-bound deserialize/assemble steps (the pipeline bounds
+        fetch-stage CPU concurrency through it)."""
         if any(self.encoded_cache.is_missing(f.id) for f in seg.ssts):
             return None  # known-missing sidecar: skip the GETs entirely
         seg_ids = frozenset(f.id for f in seg.ssts)
@@ -888,8 +1088,9 @@ class ParquetReader:
         leaves = plan.prune_leaves
         want = set(seg.columns) | {lf.column for lf in leaves or []}
 
-        def runner(fn, *args):  # CPU-bound deserialize off the loop
-            return self._run_pool(plan.pool, fn, *args)
+        if runner is None:
+            def runner(fn, *args):  # CPU-bound deserialize off the loop
+                return self._run_pool(plan.pool, fn, *args)
 
         parts: list = [None] * len(seg.ssts)
         fetch: list[tuple[int, SstFile]] = []
@@ -933,9 +1134,8 @@ class ParquetReader:
             if res[1] == f.meta.num_rows:
                 self.encoded_cache.put(f.id, res[0], res[1])
         try:
-            es = await self._run_pool(
-                plan.pool, sidecar.assemble_parts, parts,
-                list(seg.columns), leaves)
+            es = await runner(sidecar.assemble_parts, parts,
+                              list(seg.columns), leaves)
         except Exception as exc:  # noqa: BLE001 — cache read only
             # a part that parses but is internally inconsistent can blow
             # up deep in eval/concat; the contract is fallback, not
@@ -1062,6 +1262,12 @@ class ParquetReader:
                 "misses": self.scan_cache.misses,
             },
             "encoded_cache": self.encoded_cache.stats(),
+            "pipeline": {
+                "enabled": self.pipeline_on(),
+                "depth": self.config.scan.pipeline.depth,
+                "inflight_bytes": self.config.scan.pipeline.inflight_bytes,
+                "high_water_bytes": self._pipeline_high_water,
+            },
             "stack_cache": {
                 "entries": len(self._stack_cache),
                 "bytes": self._stack_cache_bytes,
@@ -1816,50 +2022,110 @@ class ParquetReader:
         parts: dict[int, list] = {}
         pending: dict[int, int] = {}
         arrived: "deque[int]" = deque()
+        # pipelined device stage: ONE aggregation round runs as a
+        # background task while this loop keeps pulling/prepping the
+        # next windows from the (also pipelined) fetch/decode stages —
+        # rounds still apply strictly in dispatch order, so parts per
+        # segment are identical to the sequential path's.  The decision
+        # is plan.pipeline_active — set by _cached_windows once it has
+        # probed whether the scan has store I/O to hide — so it must be
+        # read AFTER the windows iterator starts (flush can only run
+        # then; asserted by the first-flush-after-first-window order)
+        def pipelined() -> bool:
+            return plan.pipeline_active
+        flush_task: Optional[asyncio.Task] = None
 
-        async def flush(k: int) -> None:
-            flushed = await self._run_pool(
-                plan.pool, self._flush_window_batch, queue[:k], spec, plan)
+        def _apply(flushed) -> None:
             for seg_start, part in flushed:
                 parts[seg_start].append(part)
                 pending[seg_start] -= 1
+
+        async def settle_flush() -> None:
+            nonlocal flush_task
+            if flush_task is None:
+                return
+            t, flush_task = flush_task, None
+            _apply(await t)
+
+        async def flush_round(chunk: list) -> list:
+            # stage seconds observed HERE, around the round itself
+            # (pool-queue wait included): settling happens at the NEXT
+            # flush, so measuring dispatch-to-settle would absorb the
+            # consumer's decode/fetch waits into stage="device" and
+            # contradict the stall counters the docs say to read
+            # alongside it
+            from horaedb_tpu.storage import pipeline as pipeline_mod
+
+            t0 = time.perf_counter()
+            out = await self._run_pool(
+                plan.pool, self._flush_window_batch, chunk, spec, plan)
+            pipeline_mod.observe_stage(
+                "device", time.perf_counter() - t0,
+                rows=sum(w.n_valid for _s, w, _p in chunk))
+            return out
+
+        async def flush(k: int) -> None:
+            nonlocal flush_task
+            chunk = queue[:k]
             del queue[:k]
+            if not pipelined():
+                _apply(await self._run_pool(
+                    plan.pool, self._flush_window_batch, chunk, spec,
+                    plan))
+                return
+            # stage-boundary checkpoint: no new device round for an
+            # expired query (the in-flight one drains via settle)
+            deadline_checkpoint()
+            await settle_flush()
+            flush_task = asyncio.create_task(flush_round(chunk))
 
         windows_iter = self._cached_windows(plan)
         try:
-            async for seg, windows, read_s in windows_iter:
-                t0 = time.perf_counter()
-                s = seg.segment_start
-                arrived.append(s)
-                parts[s] = []
-                pending[s] = 0
+            try:
+                async for seg, windows, read_s in windows_iter:
+                    t0 = time.perf_counter()
+                    s = seg.segment_start
+                    arrived.append(s)
+                    parts[s] = []
+                    pending[s] = 0
 
-                def prep_windows(ws=windows):
-                    out = []
-                    for w in ws:
-                        # same semantics as the row path: post-dedup rows
-                        _ROWS_SCANNED.inc(w.n_valid)
-                        prep = self._window_groups(w, spec, plan)
-                        if prep is not None:
-                            out.append((w, prep))
-                    return out
+                    def prep_windows(ws=windows):
+                        out = []
+                        for w in ws:
+                            # same semantics as the row path: post-dedup
+                            # rows
+                            _ROWS_SCANNED.inc(w.n_valid)
+                            prep = self._window_groups(w, spec, plan)
+                            if prep is not None:
+                                out.append((w, prep))
+                        return out
 
-                for w, prep in await self._run_pool(plan.pool, prep_windows):
-                    queue.append((s, w, prep))
-                    pending[s] += 1
-                while len(queue) >= batch_w:
-                    await flush(batch_w)
-                _SCAN_LATENCY.observe(read_s + (time.perf_counter() - t0))
-                while arrived and pending[arrived[0]] == 0:
-                    s0 = arrived.popleft()
-                    yield s0, parts.pop(s0)
+                    for w, prep in await self._run_pool(plan.pool,
+                                                        prep_windows):
+                        queue.append((s, w, prep))
+                        pending[s] += 1
+                    while len(queue) >= batch_w:
+                        await flush(batch_w)
+                    _SCAN_LATENCY.observe(read_s
+                                          + (time.perf_counter() - t0))
+                    while arrived and pending[arrived[0]] == 0:
+                        s0 = arrived.popleft()
+                        yield s0, parts.pop(s0)
+            finally:
+                await windows_iter.aclose()
+            if queue:
+                await flush(len(queue))
+            await settle_flush()
+            while arrived:
+                s0 = arrived.popleft()
+                yield s0, parts.pop(s0)
         finally:
-            await windows_iter.aclose()
-        if queue:
-            await flush(len(queue))
-        while arrived:
-            s0 = arrived.popleft()
-            yield s0, parts.pop(s0)
+            if flush_task is not None:
+                # cancelled/failed scan: drain the in-flight device
+                # round (the pool job runs to completion regardless) so
+                # it never races table teardown
+                flush_task.cancel()
+                await asyncio.gather(flush_task, return_exceptions=True)
 
     @staticmethod
     def finalize_aggregate(parts: list, spec: AggregateSpec):

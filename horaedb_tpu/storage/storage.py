@@ -324,11 +324,19 @@ class CloudObjectStorage(TimeMergeStorage):
                    first_plan: Optional[ScanPlan] = None,
                    keep_builtin: bool = False,
                    segment_filter=None) -> AsyncIterator[pa.RecordBatch]:
-        async for _seg, batch in self.scan_segments(
-                req, first_plan=first_plan, keep_builtin=keep_builtin,
-                segment_filter=segment_filter):
-            if batch is not None:
-                yield batch
+        # explicit aclose on abandonment: an `async for` left mid-loop
+        # does NOT close its source, and GC-time finalization would let
+        # the scan pipeline's in-flight tasks outlive the query into
+        # table teardown (deterministic-teardown discipline, PR 3/8)
+        seg_iter = self.scan_segments(req, first_plan=first_plan,
+                                      keep_builtin=keep_builtin,
+                                      segment_filter=segment_filter)
+        try:
+            async for _seg, batch in seg_iter:
+                if batch is not None:
+                    yield batch
+        finally:
+            await seg_iter.aclose()
 
     async def scan_segments(self, req: ScanRequest,
                             first_plan: Optional[ScanPlan] = None,
@@ -352,8 +360,9 @@ class CloudObjectStorage(TimeMergeStorage):
                              if s.segment_start not in done
                              and (segment_filter is None
                                   or segment_filter(s.segment_start))]
+            exec_iter = self.reader.execute_segments(plan)
             try:
-                async for seg_start, batch in self.reader.execute_segments(plan):
+                async for seg_start, batch in exec_iter:
                     if batch is None:
                         # explicit completion marker: only now is the
                         # segment retry-safe to skip (it may have
@@ -366,6 +375,10 @@ class CloudObjectStorage(TimeMergeStorage):
                     raise
                 logger.info("scan raced a compaction (sst vanished); "
                             "replanning remaining segments")
+            finally:
+                # deterministic teardown on abandonment/error: drain
+                # the read pipeline NOW, not at GC finalization
+                await exec_iter.aclose()
 
     async def scan_aggregate(self, req: ScanRequest, spec,
                              first_plan: Optional[ScanPlan] = None):

@@ -384,18 +384,28 @@ class IngestStorage(TimeMergeStorage):
             overlay = {s: b for s, b in overlay.items() if segment_filter(s)}
         if not overlay:
             # pure-SST fast path; first_plan is NOT reused — it may
-            # predate a flush that just emptied these memtables
-            async for b in self.inner.scan(req, keep_builtin=keep_builtin,
-                                           segment_filter=segment_filter):
-                yield b
+            # predate a flush that just emptied these memtables.
+            # Explicit aclose on abandonment: GC-time finalization
+            # would let the scan pipeline outlive the query
+            it = self.inner.scan(req, keep_builtin=keep_builtin,
+                                 segment_filter=segment_filter)
+            try:
+                async for b in it:
+                    yield b
+            finally:
+                await it.aclose()
             return
         mem_segs = set(overlay)
         # segments with no overlay: the unchanged plan/pushdown path
-        async for b in self.inner.scan(
-                req, keep_builtin=keep_builtin,
-                segment_filter=lambda s: s not in mem_segs
-                and (segment_filter is None or segment_filter(s))):
-            yield b
+        it = self.inner.scan(
+            req, keep_builtin=keep_builtin,
+            segment_filter=lambda s: s not in mem_segs
+            and (segment_filter is None or segment_filter(s)))
+        try:
+            async for b in it:
+                yield b
+        finally:
+            await it.aclose()
         # overlay segments: value-column leaves must apply AFTER the
         # cross-source dedup (filtering first would resurrect
         # overwritten rows), but the PK-only conjunct subtree drops
@@ -414,19 +424,24 @@ class IngestStorage(TimeMergeStorage):
                                  projections=req.projections)
         columns = plan_columns(schema, req.projections)
         buffered: dict[int, list] = {}
-        async for seg, batch in self.inner.scan_segments(
-                hybrid_req, keep_builtin=True,
-                segment_filter=lambda s: s in mem_segs):
-            if batch is not None:
-                buffered.setdefault(seg, []).append(batch)
-                continue
-            with span("memtable_overlay", segment=seg):
-                out = merge_memtable_overlay(
-                    schema, buffered.pop(seg, []), overlay.pop(seg, []),
-                    req.predicate, columns, keep_builtin)
-            if out is not None and out.num_rows:
-                trace_add("memtable_overlay_rows", out.num_rows)
-                yield out
+        seg_iter = self.inner.scan_segments(
+            hybrid_req, keep_builtin=True,
+            segment_filter=lambda s: s in mem_segs)
+        try:
+            async for seg, batch in seg_iter:
+                if batch is not None:
+                    buffered.setdefault(seg, []).append(batch)
+                    continue
+                with span("memtable_overlay", segment=seg):
+                    out = merge_memtable_overlay(
+                        schema, buffered.pop(seg, []),
+                        overlay.pop(seg, []),
+                        req.predicate, columns, keep_builtin)
+                if out is not None and out.num_rows:
+                    trace_add("memtable_overlay_rows", out.num_rows)
+                    yield out
+        finally:
+            await seg_iter.aclose()
         # segments living only in memtables (no SSTs yet)
         for seg in sorted(overlay):
             with span("memtable_overlay", segment=seg):

@@ -33,6 +33,13 @@ Checks, all hard failures:
     `registry.counter/gauge/histogram(...)` call must pass non-empty
     help text (docs/observability.md — /metrics is an operator
     surface; a bare series name is not documentation)
+  - pipeline/executor discipline under horaedb_tpu/storage/: CPU work
+    dispatched off the event loop must go through `runtimes.run` (or
+    asyncio.to_thread, which also copies contextvars) — bare
+    `loop.run_in_executor(...)`, `ThreadPoolExecutor(...)` and
+    `<pool>.submit(...)` do NOT propagate contextvars, so a scan
+    pipeline stage dispatched that way silently drops its trace/
+    deadline attribution (docs/observability.md, pipeline section)
   - loop-registry discipline under horaedb_tpu/: spawning a
     long-running loop coroutine (a callee whose name contains "loop")
     via bare `asyncio.create_task` / `loop.create_task` /
@@ -50,6 +57,7 @@ from __future__ import annotations
 import ast
 import pathlib
 import sys
+from typing import Optional
 
 DEFAULT_PATHS = ["horaedb_tpu", "tests", "bench.py", "__graft_entry__.py"]
 
@@ -184,6 +192,35 @@ def _rollup_scan_violation(node: ast.Call) -> bool:
         return False
     return any(tok in part.lower() for part in _receiver_chain(func)
                for tok in _ROLLUP_TOKENS)
+
+
+# executor-dispatch surfaces that DON'T copy contextvars: pipeline
+# stage work under horaedb_tpu/storage/ dispatched through these loses
+# the ambient trace and deadline (stage attribution silently drops).
+# runtimes.run copies the context explicitly and asyncio.to_thread
+# copies it by contract — those are the sanctioned dispatches.
+_EXECUTOR_CTORS = {"ThreadPoolExecutor", "ProcessPoolExecutor"}
+
+
+def _bare_executor_dispatch(node: ast.Call) -> Optional[str]:
+    """Reason string for `loop.run_in_executor(...)` /
+    `ThreadPoolExecutor(...)` / `<pool|executor>.submit(...)` calls —
+    context-dropping dispatch paths; None when the call is fine."""
+    func = node.func
+    if isinstance(func, ast.Attribute) and func.attr == "run_in_executor":
+        return "run_in_executor"
+    if isinstance(func, ast.Attribute) and func.attr == "submit":
+        if any("pool" in part.lower() or "executor" in part.lower()
+               for part in _receiver_chain(func)):
+            return "executor .submit"
+    name = None
+    if isinstance(func, ast.Name):
+        name = func.id
+    elif isinstance(func, ast.Attribute):
+        name = func.attr
+    if name in _EXECUTOR_CTORS:
+        return f"{name} construction"
+    return None
 
 
 # task-spawn surfaces; spawning a LOOP through any of these bypasses
@@ -337,6 +374,18 @@ def lint_file(path: pathlib.Path) -> list[str]:
                     "planner's coverage API (RollupManager.covers/"
                     "try_serve), which is what keeps stale cells from "
                     "serving (docs/rollups.md)")
+        elif (isinstance(node, ast.Call) and "horaedb_tpu" in path.parts
+                and "storage" in path.parts
+                and _bare_executor_dispatch(node) is not None):
+            src = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+            if "noqa" not in src:
+                problems.append(
+                    f"{path}:{node.lineno}: "
+                    f"{_bare_executor_dispatch(node)} under "
+                    "horaedb_tpu/storage/ — off-loop work goes through "
+                    "runtimes.run (contextvar propagation), or a scan "
+                    "pipeline stage silently drops its trace/deadline "
+                    "attribution")
         elif (isinstance(node, ast.Call) and "horaedb_tpu" in path.parts
                 and path.name != "loops.py"
                 and _unwatched_loop_spawn(node)):
