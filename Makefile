@@ -24,6 +24,9 @@ ROLLUP_SCHEDULES ?= 24
 PIPELINE_SEED ?= 1337
 PIPELINE_SCHEDULES ?= 10
 
+COMBINE_SEED ?= 1337
+COMBINE_SCHEDULES ?= 25
+
 chaos:
 	TORTURE_SEED=$(TORTURE_SEED) TORTURE_SCHEDULES=$(TORTURE_SCHEDULES) \
 	WAL_TORTURE_SEED=$(WAL_TORTURE_SEED) \
@@ -34,10 +37,12 @@ chaos:
 	ROLLUP_SCHEDULES=$(ROLLUP_SCHEDULES) \
 	PIPELINE_SEED=$(PIPELINE_SEED) \
 	PIPELINE_SCHEDULES=$(PIPELINE_SCHEDULES) \
+	COMBINE_SEED=$(COMBINE_SEED) \
+	COMBINE_SCHEDULES=$(COMBINE_SCHEDULES) \
 	python -m pytest tests/test_fault_injection.py tests/test_torture.py \
 	tests/test_objstore_middleware.py tests/test_wal.py \
 	tests/test_scan_cache.py tests/test_rollup.py \
-	tests/test_pipeline.py -q
+	tests/test_pipeline.py tests/test_combine.py -q
 
 # stdlib AST lint gate (the reference CI runs fmt+clippy -D warnings;
 # this image ships no ruff/flake8, so the gate is tools/lint.py)

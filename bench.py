@@ -261,10 +261,15 @@ def run_engine_headline(rows: int, iters: int) -> dict:
         # TRUE-cold: drop tier-1 HBM windows AND tier-2 host-RAM
         # encoded parts — otherwise the tier-2 cache (ISSUE 4) serves
         # the "cold" leg from RAM and the number stops measuring the
-        # full object-store path (bench config 9 measures the tiers)
+        # full object-store path (bench config 9 measures the tiers).
+        # The delta-summation parts memo (ISSUE 9) would likewise
+        # serve a repeat full-span "cold" query without scanning —
+        # config 14's refine leg measures it on purpose; here it must
+        # be cleared too.
         reader = e.tables["data"].reader
         reader.scan_cache.clear()
         reader.encoded_cache.clear()
+        reader.parts_memo.clear()
 
     async def bench(e: MetricEngine):
         t0 = time.perf_counter()

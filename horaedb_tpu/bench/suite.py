@@ -59,9 +59,12 @@ def _clear_scan_tiers(table) -> None:
     tier-2 host-RAM encoded parts — write-through admission would
     otherwise serve a 'cold' query from RAM and the leg would silently
     measure the tier-2 path instead (config 9 measures the tiers
-    explicitly)."""
+    explicitly).  The delta-summation parts memo (ISSUE 9) is a third
+    serving tier with the same hazard — config 14's refine leg
+    measures it on purpose; everywhere else cold means cold."""
     table.reader.scan_cache.clear()
     table.reader.encoded_cache.clear()
+    table.reader.parts_memo.clear()
 
 
 def _p50(fn, iters: int) -> float:
@@ -2011,10 +2014,255 @@ def run_config13(rows: int, iters: int) -> dict:
     }
 
 
+def run_config14(rows: int, iters: int) -> dict:
+    """Output-grid cliff ladder (ISSUE 9): the high-cardinality
+    full-span downsample — the shape whose combine/finalize went 4.4x
+    superlinear on the r5 scale ladder — measured with the sparse
+    combine against the `[scan.combine] mode = "dense"` control, plus
+    the two pushdown legs:
+
+      cold_full_span      hosts x buckets grid, every tier + the parts
+                          memo cleared per rep; sparse vs dense p50
+      topk                query_topk k=5 through the pushdown —
+                          materialized output cells must equal
+                          k x buckets x aggs (O(k x buckets),
+                          independent of host cardinality) while the
+                          would-be dense grid is hosts x buckets
+      range_refine        full-span query records per-segment partials;
+                          narrowed/refined ranges (the dashboard
+                          zoom/pan shape) re-serve them — memo-served
+                          segment fraction and refine p50 vs a
+                          memo-off control
+
+    Done-bars: dense/sparse >= the ISSUE-14 factor at the 200M rung
+    (vs_baseline is that ratio), the top-k bound holds exactly, the
+    refine leg serves >= 50% of partials from the memo — and every
+    leg's grids are bit-identical to the dense control."""
+    import os
+
+    import pyarrow as pa
+
+    from horaedb_tpu.metric_engine import MetricEngine
+    from horaedb_tpu.objstore import MemoryObjectStore
+    from horaedb_tpu.storage import combine as combine_mod
+    from horaedb_tpu.storage.config import StorageConfig, from_dict
+    from horaedb_tpu.storage.types import TimeRange
+
+    # the r5 scale-ladder shape (hosts x a LONG bucket axis, every
+    # window carrying all hosts) — the exact grid that went 4.4x
+    # superlinear; hosts = rows/200k matches the ladder's cardinality
+    # scaling at each rung
+    hosts = int(os.environ.get("BENCH_HOSTS", max(100, rows // 200_000)))
+    interval = 10_000
+    bucket_ms = 60_000
+    # spans are kept bucket-aligned (ticks a multiple of 6) and >= 4
+    # segments so the engine takes the ts-leaf-free aligned path on
+    # every leg — the dashboard shape the delta memo serves (a
+    # ts-bounded predicate is part of the memo key, so unaligned
+    # ranges safely never match)
+    per_host = -(-max(2880, rows // hosts) // 6) * 6
+    span = per_host * interval
+    segment_ms = 2 * 3600 * 1000
+    T0 = (1_700_000_000_000 // segment_ms) * segment_ms
+    rng = np.random.default_rng(14)
+    n = per_host * hosts
+    ts = T0 + np.repeat(
+        np.arange(per_host, dtype=np.int64) * interval, hosts)
+    host_id = np.tile(np.arange(hosts, dtype=np.int32), per_host)
+    vals = (rng.random(n) * 100).astype(np.float64)
+    names = pa.array([f"host_{i:05d}" for i in range(hosts)])
+    _check_i32_span(np.asarray([span]), "config14")
+    aggs = ("avg", "max")
+    k_cold = max(3, iters // 3)
+    num_buckets = -(-span // bucket_ms)
+
+    def cfg():
+        return from_dict(StorageConfig, {
+            "scheduler": {"schedule_interval": "1h"},
+            "scan": {"cache_max_rows": n * 4,
+                     "cache": {"tier2_max_bytes": 1 << 30},
+                     # hold every segment's partials at the 200M rung
+                     # so the refine leg measures the memo, not its
+                     # eviction policy
+                     "combine": {"memo_max_bytes": 1 << 29}},
+        })
+
+    async def ingest(e):
+        chunk = max(1, 1_000_000 // hosts) * hosts
+        for lo in range(0, n, chunk):
+            hi = min(n, lo + chunk)
+            await e.write_arrow("cpu", ["host"], pa.record_batch({
+                "host": pa.DictionaryArray.from_arrays(
+                    pa.array(host_id[lo:hi]), names),
+                "timestamp": pa.array(ts[lo:hi], type=pa.int64()),
+                "value": pa.array(vals[lo:hi], type=pa.float64()),
+            }))
+
+    def grid_bytes(out: dict) -> bytes:
+        return b"".join(np.asarray(out["aggs"][a]).tobytes()
+                        for a in sorted(out["aggs"])) + \
+            np.asarray(out["tsids"]).tobytes()
+
+    async def go():
+        out = {"hosts": hosts, "num_buckets": num_buckets,
+               "grid_cells": hosts * num_buckets}
+        e = await MetricEngine.open("cfg14", MemoryObjectStore(),
+                                    segment_ms=segment_ms, config=cfg())
+        try:
+            await ingest(e)
+            table = e.tables["data"]
+            reader = table.reader
+            full = TimeRange.new(T0, T0 + span)
+
+            async def full_span():
+                return await e.query_downsample(
+                    "cpu", [], full, bucket_ms=bucket_ms, aggs=aggs,
+                    use_rollup=False)
+
+            def true_cold():
+                _clear_scan_tiers(table)
+                reader.parts_memo.clear()
+
+            await full_span()  # compile warm-up
+            # --- leg 1: cold full-span, sparse vs dense control ---
+            # reps interleave modes so allocator/page-cache drift
+            # cannot bias one leg (2-core boxes showed ~25% rep
+            # variance when the legs ran back-to-back)
+            legs, times = {}, {"sparse": [], "dense": []}
+            for _ in range(k_cold):
+                for mode in ("sparse", "dense"):
+                    reader.config.scan.combine.mode = mode
+                    true_cold()
+                    t0 = time.perf_counter()
+                    legs[mode] = await full_span()
+                    times[mode].append(time.perf_counter() - t0)
+            for mode, ts_ in times.items():
+                out[f"cold_full_span_{mode}_p50_ms"] = round(
+                    float(np.percentile(ts_, 50)) * 1e3, 3)
+            reader.config.scan.combine.mode = "sparse"
+            assert grid_bytes(legs["sparse"]) == grid_bytes(
+                legs["dense"]), "sparse vs dense grids diverged"
+            out["bit_identical_full_span"] = True
+
+            # --- leg 2: top-k pushdown output bound ---
+            true_cold()
+            k = 5
+            m0 = combine_mod._MATERIALIZED.value
+            g0 = combine_mod._GRID.value
+            t0 = time.perf_counter()
+            top = await e.query_topk("cpu", [], full, bucket_ms, k=k,
+                                     by="max", aggs=aggs,
+                                     use_rollup=False)
+            out["topk_p50_ms"] = round((time.perf_counter() - t0) * 1e3,
+                                       3)
+            out["topk_materialized_cells"] = int(
+                combine_mod._MATERIALIZED.value - m0)
+            out["topk_grid_cells"] = int(combine_mod._GRID.value - g0)
+            want_cells = k * num_buckets * 3  # count, avg, max
+            assert out["topk_materialized_cells"] == want_cells, \
+                (out["topk_materialized_cells"], want_cells)
+            out["topk_bound_ok"] = True
+            # bit-identity vs the host-side dense rank
+            reader.config.scan.combine.mode = "dense"
+            true_cold()
+            top_dense = await e.query_topk("cpu", [], full, bucket_ms,
+                                           k=k, by="max", aggs=aggs,
+                                           use_rollup=False)
+            reader.config.scan.combine.mode = "sparse"
+            assert top["tsids"] == top_dense["tsids"]
+            assert grid_bytes(top) == grid_bytes(top_dense)
+
+            # --- leg 3: range refine (delta-summation memo) ---
+            def refine_ranges():
+                # zoom/pan refinements: bucket-aligned, >= one segment
+                # (the engine's aligned fast path — no ts leaf in the
+                # predicate, so the memo key matches the recording)
+                qspan = max(segment_ms,
+                            (span // 2 // bucket_ms) * bucket_ms)
+                for frac in (1 / 4, 1 / 3, 1 / 2, 2 / 5):
+                    lo = T0 + (int(span * frac) // bucket_ms) * bucket_ms
+                    hi = min(T0 + span, lo + qspan)
+                    yield TimeRange.new(lo, hi)
+
+            async def refine_leg(memo_on: bool):
+                true_cold()
+                await full_span()  # records per-segment partials
+                h0 = reader.parts_memo.stats()["hits"]
+                mm0 = reader.parts_memo.stats()["misses"]
+                times = []
+                for r in refine_ranges():
+                    # scan tiers cold, memo per the leg (NOT the
+                    # _clear_scan_tiers helper, which drops the memo)
+                    reader.scan_cache.clear()
+                    reader.encoded_cache.clear()
+                    if not memo_on:
+                        reader.parts_memo.clear()
+                    t0 = time.perf_counter()
+                    res = await e.query_downsample(
+                        "cpu", [], r, bucket_ms=bucket_ms, aggs=aggs,
+                        use_rollup=False)
+                    times.append(time.perf_counter() - t0)
+                st = reader.parts_memo.stats()
+                return (float(np.percentile(times, 50)),
+                        st["hits"] - h0,
+                        (st["hits"] - h0) + (st["misses"] - mm0), res)
+
+            p50_on, hits, probes, last_on = await refine_leg(True)
+            p50_off, _h, _p, last_off = await refine_leg(False)
+            out["refine_p50_ms"] = round(p50_on * 1e3, 3)
+            out["refine_memo_off_p50_ms"] = round(p50_off * 1e3, 3)
+            out["refine_memo_hit_segments"] = hits
+            out["refine_probe_segments"] = probes
+            out["refine_memo_fraction"] = round(hits / max(1, probes), 3)
+            assert grid_bytes(last_on) == grid_bytes(last_off), \
+                "memo-served refine diverged from recompute"
+            out["bit_identical_refine"] = True
+        finally:
+            await e.close()
+        return out
+
+    # the legs measure storage/combine.py (parts-path combine, top-k
+    # pushdown, delta memo); on accelerator backends the fused device
+    # aggregate would serve every query WITHOUT entering combine — the
+    # counters would read 0 and the A/B would time the fused path twice.
+    # Force the parts path so the asserts measure what they claim.
+    prev_fused = os.environ.get("HORAEDB_FUSED_AGG")
+    os.environ["HORAEDB_FUSED_AGG"] = "0"
+    try:
+        out = asyncio.run(go())
+    finally:
+        if prev_fused is None:
+            os.environ.pop("HORAEDB_FUSED_AGG", None)
+        else:
+            os.environ["HORAEDB_FUSED_AGG"] = prev_fused
+    sparse = out["cold_full_span_sparse_p50_ms"]
+    dense = out["cold_full_span_dense_p50_ms"]
+    out["combine_speedup_full_span"] = round(dense / sparse, 3)
+    out["refine_speedup"] = round(
+        out["refine_memo_off_p50_ms"] / out["refine_p50_ms"], 2)
+    _log(f"config14: cold full-span sparse {sparse:.1f} ms vs dense "
+         f"{dense:.1f} ms ({out['combine_speedup_full_span']}x) | "
+         f"top-k materialized {out['topk_materialized_cells']} cells "
+         f"vs grid {out['topk_grid_cells']} | refine memo fraction "
+         f"{out['refine_memo_fraction']} "
+         f"({out['refine_speedup']}x vs memo off)")
+    return {
+        "metric": (f"sparse combine: cold full-span downsample p50, "
+                   f"{out['hosts']} hosts x {out['num_buckets']} "
+                   f"buckets, {n / 1e6:.1f}M rows"),
+        "value": sparse,
+        "unit": "ms",
+        # done-bar: dense-control / sparse on the cold full-span leg
+        "vs_baseline": out["combine_speedup_full_span"],
+        "rows": n,
+        **out,
+    }
+
+
 RUNNERS = {2: run_config2, 3: run_config3, 4: run_config4, 5: run_config5,
            6: run_config6, 7: run_config7, 8: run_config8, 9: run_config9,
            10: run_config10, 11: run_config11, 12: run_config12,
-           13: run_config13}
+           13: run_config13, 14: run_config14}
 
 
 def main() -> None:

@@ -756,50 +756,12 @@ class Cluster:
 def _merge_downsample(results: list[dict], time_range: TimeRange,
                       bucket_ms: int) -> dict:
     """Merge per-region downsample grids by tsid (shared by the strict
-    and degraded gather paths).  Regions are series-disjoint in steady
-    state; during a split's TTL window an overlapping tsid combines
-    additively (sum/count/min/max; avg recomputed; `last` takes the
-    later sample time)."""
-    results = [r for r in results if r["tsids"]]
+    and degraded gather paths).  Delegates to the combine module's
+    cross-region merge, which allocates only the aggregates the regions
+    actually returned — a subset query no longer pays six full
+    groups x buckets grids at the coordinator."""
+    from horaedb_tpu.storage.combine import merge_downsample_results
+
     num_buckets = -(-(int(time_range.end) - int(time_range.start))
                     // bucket_ms)
-    if not results:
-        return {"tsids": [], "num_buckets": num_buckets, "aggs": {}}
-
-    import numpy as np
-
-    all_tsids = sorted({t for r in results for t in r["tsids"]})
-    idx = {t: i for i, t in enumerate(all_tsids)}
-    g = len(all_tsids)
-    agg = {"count": np.zeros((g, num_buckets)),
-           "sum": np.zeros((g, num_buckets)),
-           "min": np.full((g, num_buckets), np.inf),
-           "max": np.full((g, num_buckets), -np.inf),
-           "last": np.full((g, num_buckets), np.nan),
-           "last_ts": np.full((g, num_buckets), -np.inf)}
-    for r in results:
-        rows = np.asarray([idx[t] for t in r["tsids"]])
-        a = r["aggs"]
-        agg["count"][rows] += np.nan_to_num(np.asarray(a["count"]))
-        agg["sum"][rows] += np.nan_to_num(np.asarray(a["sum"]))
-        agg["min"][rows] = np.fmin(agg["min"][rows], np.asarray(a["min"]))
-        agg["max"][rows] = np.fmax(agg["max"][rows], np.asarray(a["max"]))
-        has = np.asarray(a["count"]) > 0
-        # winner by actual sample time (regions expose last_ts);
-        # ties break toward the later region in route order
-        cand_ts = np.nan_to_num(
-            np.asarray(a["last_ts"], dtype=np.float64), nan=-np.inf)
-        take = has & (cand_ts >= agg["last_ts"][rows])
-        last_rows = agg["last"][rows]
-        last_rows[take] = np.asarray(a["last"])[take]
-        agg["last"][rows] = last_rows
-        lt_rows = agg["last_ts"][rows]
-        lt_rows[take] = cand_ts[take]
-        agg["last_ts"][rows] = lt_rows
-    empty = agg["count"] == 0
-    with np.errstate(invalid="ignore"):
-        agg["avg"] = np.where(empty, np.nan,
-                              agg["sum"] / np.maximum(agg["count"], 1))
-    agg["min"] = np.where(empty, np.inf, agg["min"])
-    agg["max"] = np.where(empty, -np.inf, agg["max"])
-    return {"tsids": all_tsids, "num_buckets": num_buckets, "aggs": agg}
+    return merge_downsample_results(results, num_buckets)

@@ -162,6 +162,24 @@ class ScanCacheConfig:
 
 
 @dataclass
+class ScanCombineConfig:
+    """Aggregate combine/finalize knobs ([scan.combine]; see
+    storage/combine.py).  `mode = "sparse"` (default) folds partial
+    grids straight into the final output buffers as per-series bucket
+    runs and materializes only the requested aggregates — top-k
+    queries never build the full groups x buckets grid.  `"dense"`
+    reproduces the pre-sparse fold exactly (the bit-identity control
+    the chaos suite compares against)."""
+
+    mode: str = "sparse"
+    # byte budget for the delta-summation memo: per-segment aggregate
+    # partials keyed by the segment's exact SST set, served to
+    # narrowed/refined ranges of the same dashboard query shape so only
+    # delta segments recompute.  0 disables the memo entirely.
+    memo_max_bytes: int = 128 << 20
+
+
+@dataclass
 class ScanPipelineConfig:
     """Cold-scan pipelining ([scan.pipeline]): the cold read path runs
     as a bounded producer/consumer pipeline — a fetch stage that keeps
@@ -244,6 +262,9 @@ class ScanConfig:
     decode_workers: int = 0
     # tiered scan-cache knobs ([scan.cache])
     cache: ScanCacheConfig = field(default_factory=ScanCacheConfig)
+    # aggregate combine/finalize knobs ([scan.combine]): sparse-vs-dense
+    # fold mode and the delta-summation parts memo budget
+    combine: ScanCombineConfig = field(default_factory=ScanCombineConfig)
     # cold-scan pipelining knobs ([scan.pipeline]); when enabled the
     # pipeline's depth/inflight_bytes supersede prefetch_segments on
     # the cold path (the off path keeps using prefetch_segments)
@@ -287,6 +308,7 @@ _NESTED = {
     "scheduler": SchedulerConfig,
     "scan": ScanConfig,
     "cache": ScanCacheConfig,
+    "combine": ScanCombineConfig,
     "pipeline": ScanPipelineConfig,
     "threads": ThreadsConfig,
     "retry": RetryConfig,

@@ -30,7 +30,7 @@ import logging
 import threading
 import time
 import weakref
-from dataclasses import dataclass
+from dataclasses import dataclass, replace as dc_replace
 from typing import AsyncIterator, Optional
 
 import numpy as np
@@ -53,7 +53,7 @@ from horaedb_tpu.storage.types import (
     StorageSchema,
     TimeRange,
 )
-from horaedb_tpu.storage import parquet_io, sidecar
+from horaedb_tpu.storage import combine as combine_mod, parquet_io, sidecar
 from horaedb_tpu.utils import registry, trace_add
 
 logger = logging.getLogger(__name__)
@@ -371,6 +371,18 @@ class ParquetReader:
         self.encoded_cache = EncodedSegmentCache(
             config.scan.cache.tier2_max_bytes,
             write_through=config.scan.cache.write_through)
+        # combine mode validated at open, not first query (bad TOML
+        # must fail the server's boot, not a dashboard's first scan)
+        ensure(config.scan.combine.mode in combine_mod.COMBINE_MODES,
+               f"unknown [scan.combine] mode "
+               f"{config.scan.combine.mode!r}; expected one of "
+               f"{combine_mod.COMBINE_MODES}")
+        # delta-summation tier: per-segment aggregate partials keyed by
+        # the segment's exact SST set (event-loop owned, like the scan
+        # cache) — narrowed/refined dashboard ranges recompute only
+        # delta segments (storage/combine.py PartsMemo)
+        self.parts_memo = combine_mod.PartsMemo(
+            config.scan.combine.memo_max_bytes)
         # high-water of pipeline in-flight host bytes observed by this
         # reader's scans (pipeline.PipelineBudget; /stats "pipeline")
         self._pipeline_high_water = 0
@@ -1262,6 +1274,7 @@ class ParquetReader:
                 "misses": self.scan_cache.misses,
             },
             "encoded_cache": self.encoded_cache.stats(),
+            "parts_memo": self.parts_memo.stats(),
             "pipeline": {
                 "enabled": self.pipeline_on(),
                 "depth": self.config.scan.pipeline.depth,
@@ -1708,9 +1721,13 @@ class ParquetReader:
         sorted order; each grid is (len(group_values), num_buckets)."""
         if self.fused_aggregate_ok(plan):
             return await self.execute_aggregate_fused(plan, spec)
-        parts: list[tuple[np.ndarray, dict]] = []
-        async for _seg_start, seg_parts in self.aggregate_segments(plan, spec):
-            parts.extend(seg_parts)
+        # collected per segment and folded in segment order: memo-served
+        # segments may yield out of plan order, and the combine fold
+        # order is part of the bit-identity contract
+        done: dict[int, list] = {}
+        async for seg_start, seg_parts in self.aggregate_segments(plan, spec):
+            done[seg_start] = seg_parts
+        parts = [p for s in sorted(done) for p in done[s]]
         return self.finalize_aggregate(parts, spec)
 
     def fused_aggregate_ok(self, plan: Optional[ScanPlan] = None) -> bool:
@@ -2016,6 +2033,37 @@ class ParquetReader:
                "aggregate pushdown requires Overwrite mode")
         from collections import deque
 
+        # delta summation: segments whose partials are memoized (same
+        # SST set + compatible bucket grid) are served up front and
+        # dropped from the scan plan entirely — a narrowed/refined
+        # dashboard range re-scans only the delta segments.  Runs on
+        # the event loop (the memo is event-loop owned, like the scan
+        # cache).  Served segments may yield out of plan order; callers
+        # fold parts in sorted segment order (the bit-identity fold
+        # order), so order here is free.
+        memo = self.parts_memo
+        use_memo = memo.enabled and plan.use_cache
+        seg_keys: dict[int, tuple] = {}
+        memo_pred_key = ""
+        if use_memo:
+            memo_pred_key = filter_ops.canonical_predicate_key(
+                plan.predicate)
+            remaining = []
+            for seg in plan.segments:
+                key = self._cache_key(seg, plan)
+                seg_keys[seg.segment_start] = key
+                got = memo.probe(key, seg.segment_start,
+                                 self.segment_duration_ms, spec,
+                                 memo_pred_key)
+                if got is None:
+                    remaining.append(seg)
+                else:
+                    yield seg.segment_start, got
+            if len(remaining) < len(plan.segments):
+                plan = dc_replace(plan, segments=remaining)
+            if not remaining:
+                return
+
         batch_w = (self.mesh.devices.size if self.mesh is not None
                    else max(1, self.config.scan.agg_batch_windows))
         queue: list[tuple[int, encode.DeviceBatch, tuple]] = []
@@ -2110,7 +2158,11 @@ class ParquetReader:
                                           + (time.perf_counter() - t0))
                     while arrived and pending[arrived[0]] == 0:
                         s0 = arrived.popleft()
-                        yield s0, parts.pop(s0)
+                        seg_parts = parts.pop(s0)
+                        if use_memo:
+                            memo.store(seg_keys[s0], spec, memo_pred_key,
+                                       seg_parts)
+                        yield s0, seg_parts
             finally:
                 await windows_iter.aclose()
             if queue:
@@ -2118,7 +2170,11 @@ class ParquetReader:
             await settle_flush()
             while arrived:
                 s0 = arrived.popleft()
-                yield s0, parts.pop(s0)
+                seg_parts = parts.pop(s0)
+                if use_memo:
+                    memo.store(seg_keys[s0], spec, memo_pred_key,
+                               seg_parts)
+                yield s0, seg_parts
         finally:
             if flush_task is not None:
                 # cancelled/failed scan: drain the in-flight device
@@ -2127,20 +2183,52 @@ class ParquetReader:
                 flush_task.cancel()
                 await asyncio.gather(flush_task, return_exceptions=True)
 
-    @staticmethod
-    def finalize_aggregate(parts: list, spec: AggregateSpec):
-        group_values, grids = combine_aggregate_parts(parts, spec.num_buckets,
-                                                      which=spec.which)
-        # drop groups with no row in ANY bucket: the aligned fast path
-        # omits the ts leaf (query_downsample), so boundary-segment rows
-        # outside [start, end) can register a group whose every cell is
-        # empty — without this the aligned and ts-leaf paths return
-        # different tsid sets for the same data
-        if len(group_values):
-            nonzero = grids["count"].sum(axis=1) > 0
-            if not nonzero.all():
-                group_values = group_values[nonzero]
-                grids = {k: v[nonzero] for k, v in grids.items()}
+    def finalize_aggregate(self, parts: list, spec: AggregateSpec,
+                           top_k=None):
+        """Combine per-window parts into the user-facing grids.
+
+        Mode-dispatched through storage/combine.py ([scan.combine]):
+        the sparse fold pastes parts straight into the output buffers;
+        `dense` keeps the pre-sparse accumulator fold as the
+        bit-identity control.  A `top_k` spec pushes the ranking down
+        into combine (combine_top_k) so only the k winners' rows are
+        ever materialized — the full groups x buckets grid is never
+        built (the north-star 1B top-k's bound).  In `dense` mode the
+        pushdown is OFF too: the control materializes the full grid and
+        ranks host-side (apply_top_k), so the mode flag A/Bs the whole
+        pre-change path, not just the fold."""
+        mode = self.config.scan.combine.mode
+        t0 = time.perf_counter()
+        try:
+            if top_k is not None and mode != "dense":
+                # empty-group drop is built into the pushdown (groups
+                # are dropped before ranking, same cells as the dense
+                # drop below)
+                group_values, grids = combine_mod.combine_top_k(
+                    parts, spec.num_buckets, spec.which, top_k)
+            else:
+                group_values, grids = combine_mod.combine_parts(
+                    parts, spec.num_buckets, which=spec.which, mode=mode)
+                # drop groups with no row in ANY bucket: the aligned
+                # fast path omits the ts leaf (query_downsample), so
+                # boundary-segment rows outside [start, end) can
+                # register a group whose every cell is empty — without
+                # this the aligned and ts-leaf paths return different
+                # tsid sets for the same data
+                if len(group_values):
+                    nonzero = grids["count"].sum(axis=1) > 0
+                    if not nonzero.all():
+                        group_values = group_values[nonzero]
+                        grids = {k: v[nonzero] for k, v in grids.items()}
+                if top_k is not None:
+                    from horaedb_tpu.storage.plan import apply_top_k
+
+                    group_values, grids = apply_top_k(group_values,
+                                                      grids, top_k)
+        finally:
+            dt = time.perf_counter() - t0
+            _STAGE_SECONDS["combine"].observe(dt)
+            trace_add("stage_combine_ms", dt * 1e3)
         # last_ts is computed relative to range_start on device; expose it
         # as ABSOLUTE time so all downsample paths share one unit
         if len(group_values) and "last_ts" in grids:
@@ -2927,78 +3015,12 @@ def combine_aggregate_parts(parts: list[tuple[np.ndarray, int, dict]],
                             num_buckets: int,
                             which: tuple = downsample_ops.ALL_AGGS
                             ) -> tuple[np.ndarray, dict]:
-    """Combine per-window partial grids (from disjoint-or-overlapping
-    group sets) into one finalized grid, keyed by the union of group
-    values.  Each part is (group_values, bucket_lo, grids): its grids
-    cover LOCAL buckets [bucket_lo, bucket_lo + width) of the global
-    range, so a window only ever moves groups x window-span cells.
-    `last` combines by latest (range-relative) timestamp, later part
-    winning ties (parts arrive in segment/window order)."""
-    requested = set(which) | {"count"}
-    want = set(requested)
-    if "avg" in want:
-        want.add("sum")  # dependency only — not emitted unless requested
-    emit = [k for k in ("count", "sum", "min", "max", "avg", "last",
-                        "last_ts") if k in requested or
-            (k == "last_ts" and "last" in requested)]
-    if not parts:
-        empty = np.zeros((0, num_buckets), dtype=np.float32)
-        return np.asarray([]), {k: empty.copy() for k in emit}
-    all_values = np.unique(np.concatenate([v for v, _, _ in parts]))
-    g = len(all_values)
-    acc: dict = {"count": np.zeros((g, num_buckets), dtype=np.float64)}
-    if "sum" in want:
-        acc["sum"] = np.zeros((g, num_buckets), dtype=np.float64)
-    if "min" in want:
-        acc["min"] = np.full((g, num_buckets), np.inf, dtype=np.float64)
-    if "max" in want:
-        acc["max"] = np.full((g, num_buckets), -np.inf, dtype=np.float64)
-    if "last" in want:
-        acc["last"] = np.zeros((g, num_buckets), dtype=np.float64)
-        acc["last_ts"] = np.full((g, num_buckets), np.iinfo(np.int64).min,
-                                 dtype=np.int64)
-    for values, lo, p in parts:
-        rows = np.searchsorted(all_values, values)
-        width = p["count"].shape[1]
-        sl = slice(lo, lo + width)
-        acc["count"][rows, sl] += p["count"]
-        if "sum" in acc:
-            acc["sum"][rows, sl] += p["sum"]
-        if "min" in acc:
-            acc["min"][rows, sl] = np.minimum(acc["min"][rows, sl], p["min"])
-        if "max" in acc:
-            acc["max"][rows, sl] = np.maximum(acc["max"][rows, sl], p["max"])
-        if "last" in acc:
-            newer = p["last_ts"].astype(np.int64) >= acc["last_ts"][rows, sl]
-            has_data = p["count"] > 0
-            take = newer & has_data
-            last_rows = acc["last"][rows, sl]
-            last_rows[take] = p["last"][take]
-            acc["last"][rows, sl] = last_rows
-            lt_rows = acc["last_ts"][rows, sl]
-            lt_rows[take] = p["last_ts"].astype(np.int64)[take]
-            acc["last_ts"][rows, sl] = lt_rows
-    empty = acc["count"] == 0
-    out = {"count": acc["count"]}
-    # expose sum only when EXPLICITLY requested — it may be present in
-    # acc merely as avg's dependency
-    if "sum" in acc and "sum" in requested:
-        out["sum"] = acc["sum"]
-    if "sum" in acc and "avg" in want:
-        with np.errstate(invalid="ignore", divide="ignore"):
-            out["avg"] = np.where(empty, np.nan,
-                                  acc["sum"] / np.maximum(acc["count"], 1))
-    if "min" in acc:
-        out["min"] = acc["min"]
-    if "max" in acc:
-        out["max"] = acc["max"]
-    if "last" in acc:
-        out["last"] = np.where(empty, np.nan, acc["last"])
-        # exposed (as float, NaN for empty) so cross-region merges can
-        # pick `last` by actual sample time instead of region order
-        out["last_ts"] = np.where(empty, np.nan,
-                                  acc["last_ts"].astype(np.float64))
-    return all_values, out
+    """Compatibility shim over storage/combine.py's DENSE fold (the
+    bit-identity control).  The reader's own finalize path dispatches
+    by [scan.combine] mode instead; standalone callers (cluster-tier
+    helpers, old tests) keep this name."""
+    return combine_mod.combine_aggregate_parts(parts, num_buckets,
+                                               which=which)
 
 
 def _is_lex_sorted(keys: list[np.ndarray]) -> bool:
@@ -3167,20 +3189,22 @@ def _plan_pk_windows(pk1_codes: np.ndarray, window: int) -> list[np.ndarray]:
     _, inv, counts = np.unique(pk1_codes, return_inverse=True,
                                return_counts=True)
     order = np.argsort(inv, kind="stable")
-    boundaries = np.concatenate([[0], np.cumsum(counts)])
+    boundaries = np.cumsum(np.concatenate([[0], counts]))
+    # greedy packing by searchsorted over the cumulative histogram:
+    # O(windows x log keys) instead of a Python iteration per DISTINCT
+    # key (high-cardinality segments made this loop the window-prep
+    # hot spot on low-core hosts — ROADMAP item 1 residual)
+    nkeys = len(counts)
     windows: list[np.ndarray] = []
-    start_key = 0
-    acc = 0
-    for key in range(len(counts)):
-        c = int(counts[key])
-        if acc and acc + c > window:
-            windows.append(order[boundaries[start_key]:boundaries[key]])
-            start_key = key
-            acc = 0
-        acc += c
-    if acc:
-        windows.append(order[boundaries[start_key]:])
-    return [w for w in windows if len(w)]
+    s = 0
+    while s < nkeys:
+        e = int(np.searchsorted(boundaries, boundaries[s] + window,
+                                side="right")) - 1
+        if e <= s:
+            e = s + 1  # single code over budget: a window of its own
+        windows.append(order[boundaries[s]:boundaries[e]])
+        s = e
+    return windows
 
 
 def _eval_predicate_host(pred, batch: pa.RecordBatch) -> np.ndarray:

@@ -88,20 +88,42 @@ class ByteLRU:
     def get(self, key: CacheKey):
         entry = self._entries.get(key)
         if entry is None:
-            self.misses += 1
-            if self._misses is not None:
-                self._misses.inc()
-            if self.trace_tier:
-                trace_add(f"cache_{self.trace_tier}_misses")
+            self.record_miss()
             return None
         self._entries.move_to_end(key)
+        self._count_hit(entry)
+        return entry[0]
+
+    def peek_entry(self, key: CacheKey):
+        """Stats-free, recency-free lookup.  For callers that must
+        VALIDATE an entry before it counts as served (PartsMemo
+        coverage): they account the outcome themselves via
+        record_hit/record_miss, so a found-but-unusable entry is not
+        reported as a hit."""
+        entry = self._entries.get(key)
+        return None if entry is None else entry[0]
+
+    def record_miss(self) -> None:
+        self.misses += 1
+        if self._misses is not None:
+            self._misses.inc()
+        if self.trace_tier:
+            trace_add(f"cache_{self.trace_tier}_misses")
+
+    def record_hit(self, key: CacheKey) -> None:
+        entry = self._entries.get(key)
+        if entry is None:
+            return
+        self._entries.move_to_end(key)
+        self._count_hit(entry)
+
+    def _count_hit(self, entry) -> None:
         self.hits += 1
         if self._hits is not None:
             self._hits.inc()
         if self.trace_tier:
             trace_add(f"cache_{self.trace_tier}_hits")
             trace_add(f"cache_{self.trace_tier}_bytes", entry[1])
-        return entry[0]
 
     def put(self, key: CacheKey, value, nbytes: int) -> None:
         if self.max_bytes <= 0 or nbytes > self.max_bytes:
@@ -119,6 +141,13 @@ class ByteLRU:
     def clear(self) -> None:
         self._entries.clear()
         self._total_bytes = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    @property
+    def total_bytes(self) -> int:
+        return self._total_bytes
 
     def values(self):
         """Resident values in LRU order (no recency update) — the

@@ -381,22 +381,34 @@ class CloudObjectStorage(TimeMergeStorage):
                 await exec_iter.aclose()
 
     async def scan_aggregate(self, req: ScanRequest, spec,
-                             first_plan: Optional[ScanPlan] = None):
+                             first_plan: Optional[ScanPlan] = None,
+                             top_k=None):
         """Downsample pushdown: merge + GROUP BY group_col, time(bucket)
         on device; returns (group_values, grids).  See read.AggregateSpec.
         The fused path (single-device host_perm) accumulates into one
         query-global device grid and restarts whole on a compaction
         race; the parts path skips segments completed before the race
-        on its replan."""
+        on its replan.
+
+        `top_k` (a plan.TopKSpec) pushes the ranking into the combine:
+        the parts path folds per-group spans into a bounded score pass
+        and materializes only the k winners (combine_top_k) — the full
+        groups x buckets grid is never built.  The fused path's grids
+        already live on device, so it keeps the host-side slice."""
         if first_plan is None:
             first_plan = await self.build_scan_plan(req)
         if self.reader.fused_aggregate_ok(first_plan):
+            from horaedb_tpu.storage.plan import apply_top_k
+
             counted: set = set()  # ops metrics survive restarts
             plan = first_plan
             for attempt in range(self._SCAN_RETRIES + 1):
                 try:
-                    return await self.reader.execute_aggregate_fused(
+                    values, grids = await self.reader.execute_aggregate_fused(
                         plan, spec, counted=counted)
+                    if top_k is not None:
+                        values, grids = apply_top_k(values, grids, top_k)
+                    return values, grids
                 except NotFoundError:
                     if attempt == self._SCAN_RETRIES:
                         raise
@@ -421,7 +433,7 @@ class CloudObjectStorage(TimeMergeStorage):
                     raise
                 logger.info("aggregate scan raced a compaction; replanning")
         all_parts = [p for seg in sorted(done) for p in done[seg]]
-        return self.reader.finalize_aggregate(all_parts, spec)
+        return self.reader.finalize_aggregate(all_parts, spec, top_k=top_k)
 
     async def build_scan_plan(self, req: ScanRequest,
                               keep_builtin: bool = False) -> ScanPlan:
@@ -443,22 +455,15 @@ class CloudObjectStorage(TimeMergeStorage):
     def execute_plan(self, qp):
         """Execute a QueryPlan.  Row-scan plans return the async batch
         iterator; aggregate plans return an awaitable of
-        (group_values, grids), top-k-sliced when the plan has one.
-        The plan built by plan_query is the first attempt's scan plan —
-        one manifest lookup per query, not two."""
-        from horaedb_tpu.storage.plan import apply_top_k
-
+        (group_values, grids).  A top-k stage is pushed down into the
+        combine (scan_aggregate top_k=) so the parts path never builds
+        the full groups x buckets grid.  The plan built by plan_query
+        is the first attempt's scan plan — one manifest lookup per
+        query, not two."""
         if qp.aggregate is None:
             return self.scan(qp.request, first_plan=qp.scan)
-
-        async def agg():
-            values, grids = await self.scan_aggregate(
-                qp.request, qp.aggregate, first_plan=qp.scan)
-            if qp.top_k is not None:
-                values, grids = apply_top_k(values, grids, qp.top_k)
-            return values, grids
-
-        return agg()
+        return self.scan_aggregate(qp.request, qp.aggregate,
+                                   first_plan=qp.scan, top_k=qp.top_k)
 
     async def compact(self) -> None:
         if self.compact_scheduler is not None:

@@ -453,9 +453,10 @@ class IngestStorage(TimeMergeStorage):
                 yield out
 
     async def scan_aggregate(self, req: ScanRequest, spec,
-                             first_plan: Optional[ScanPlan] = None):
+                             first_plan: Optional[ScanPlan] = None,
+                             top_k=None):
         await self.flush_overlapping(req.range)
-        return await self.inner.scan_aggregate(req, spec)
+        return await self.inner.scan_aggregate(req, spec, top_k=top_k)
 
     async def plan_query(self, req: ScanRequest, spec=None, top_k=None):
         return await self.inner.plan_query(req, spec=spec, top_k=top_k)

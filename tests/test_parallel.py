@@ -710,7 +710,11 @@ class TestCachedMeshResidency:
 
         async def go():
             cfg = from_dict(StorageConfig, {
-                "scan": {"mesh_devices": 4, "max_window_rows": 512}})
+                "scan": {"mesh_devices": 4, "max_window_rows": 512,
+                         # this test exercises the mesh stack cache;
+                         # the parts memo would serve the repeat query
+                         # before the stack path is ever consulted
+                         "combine": {"memo_max_bytes": 0}}})
             e = await MetricEngine.open("resid", MemoryObjectStore(),
                                         segment_ms=7_200_000, config=cfg)
             try:

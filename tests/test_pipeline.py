@@ -401,6 +401,10 @@ def test_inflight_budget_bounds_host_ram(runtimes):
             async def cold_query():
                 s.reader.scan_cache.clear()
                 s.reader.encoded_cache.clear()
+                # the parts memo would serve the repeat query without
+                # running the pipeline at all — this test measures the
+                # pipeline's in-flight accounting, so start truly cold
+                s.reader.parts_memo.clear()
                 req = ScanRequest(range=TimeRange.new(0, 8 * SEGMENT_MS))
                 await s.scan_aggregate(req, agg_spec(0, 8 * SEGMENT_MS))
 

@@ -487,7 +487,8 @@ def test_stats_cache_section(runtimes):
             await scan_rows(s)
             stats = s.reader.cache_stats()
             assert set(stats) == {"scan_cache", "encoded_cache",
-                                  "stack_cache", "pipeline"}
+                                  "stack_cache", "pipeline",
+                                  "parts_memo"}
             assert stats["pipeline"]["enabled"] is True
             assert stats["encoded_cache"]["entries"] == 1
             assert stats["encoded_cache"]["admissions"] == 1

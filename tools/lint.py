@@ -47,6 +47,14 @@ Checks, all hard failures:
     through `loops.spawn(...)` so every one is registered, heartbeats,
     and appears in GET /debug/tasks (a loop born unwatched is a loop
     that hangs unseen; docs/observability.md, background plane)
+  - combine grid discipline under horaedb_tpu/: allocating a dense
+    `(groups, num_buckets)`-shaped array (np.zeros/full/empty/ones
+    with a 2-tuple shape whose second element is named like a bucket
+    count) outside storage/combine.py is an error — the output-grid
+    cliff the sparse combine killed (bench_results/scale_r5.md) grows
+    back one "just this once" grid at a time; aggregation output goes
+    through the combine API (combine_parts / combine_top_k /
+    merge_downsample_results)
 
 Usage: python tools/lint.py [paths...]   (default: horaedb_tpu tests
 bench.py __graft_entry__.py)
@@ -292,6 +300,39 @@ def _metric_call_without_help(node: ast.Call) -> bool:
     return isinstance(help_arg, ast.Constant) and help_arg.value == ""
 
 
+# numpy/jax array constructors that take a shape first argument; a
+# 2-tuple shape whose SECOND element is named like a bucket count is
+# the dense output-grid idiom the sparse combine replaced
+_GRID_ALLOCATORS = {"zeros", "full", "empty", "ones"}
+
+
+def _dense_grid_allocation(node: ast.Call) -> bool:
+    """True for `np.zeros((g, num_buckets))`-shaped calls — a dense
+    (groups, buckets) output grid allocated directly.  The bucket axis
+    is recognized by name ("bucket" in the second shape element's
+    identifier), so per-window partials and unrelated 2-D arrays don't
+    trip the rule."""
+    func = node.func
+    if not (isinstance(func, ast.Attribute)
+            and func.attr in _GRID_ALLOCATORS
+            and isinstance(func.value, ast.Name)
+            and func.value.id in ("np", "numpy", "jnp")):
+        return False
+    if not node.args:
+        return False
+    shape = node.args[0]
+    if not (isinstance(shape, ast.Tuple) and len(shape.elts) == 2):
+        return False
+    second = shape.elts[1]
+    if isinstance(second, ast.Name):
+        name = second.id
+    elif isinstance(second, ast.Attribute):
+        name = second.attr
+    else:
+        return False
+    return "bucket" in name.lower()
+
+
 def lint_file(path: pathlib.Path) -> list[str]:
     problems: list[str] = []
     text = path.read_text()
@@ -397,6 +438,18 @@ def lint_file(path: pathlib.Path) -> list[str]:
                     "common.loops.spawn(...) so the loop is registered, "
                     "heartbeats, and the watchdog can flag a stall "
                     "(GET /debug/tasks)")
+        elif (isinstance(node, ast.Call) and "horaedb_tpu" in path.parts
+                and not (path.name == "combine.py"
+                         and "storage" in path.parts)
+                and _dense_grid_allocation(node)):
+            src = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+            if "noqa" not in src:
+                problems.append(
+                    f"{path}:{node.lineno}: dense (groups, num_buckets) "
+                    "grid allocated outside storage/combine.py — the "
+                    "output-grid cliff grows back one grid at a time; "
+                    "go through the combine API (combine_parts / "
+                    "combine_top_k / merge_downsample_results)")
         elif (isinstance(node, ast.Call) and "horaedb_tpu" in path.parts
                 and _metric_call_without_help(node)):
             src = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
