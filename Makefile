@@ -27,6 +27,9 @@ PIPELINE_SCHEDULES ?= 10
 COMBINE_SEED ?= 1337
 COMBINE_SCHEDULES ?= 25
 
+TENANT_SEED ?= 1337
+TENANT_SCHEDULES ?= 20
+
 chaos:
 	TORTURE_SEED=$(TORTURE_SEED) TORTURE_SCHEDULES=$(TORTURE_SCHEDULES) \
 	WAL_TORTURE_SEED=$(WAL_TORTURE_SEED) \
@@ -39,10 +42,13 @@ chaos:
 	PIPELINE_SCHEDULES=$(PIPELINE_SCHEDULES) \
 	COMBINE_SEED=$(COMBINE_SEED) \
 	COMBINE_SCHEDULES=$(COMBINE_SCHEDULES) \
+	TENANT_SEED=$(TENANT_SEED) \
+	TENANT_SCHEDULES=$(TENANT_SCHEDULES) \
 	python -m pytest tests/test_fault_injection.py tests/test_torture.py \
 	tests/test_objstore_middleware.py tests/test_wal.py \
 	tests/test_scan_cache.py tests/test_rollup.py \
-	tests/test_pipeline.py tests/test_combine.py -q
+	tests/test_pipeline.py tests/test_combine.py \
+	tests/test_tenant.py -q
 
 # stdlib AST lint gate (the reference CI runs fmt+clippy -D warnings;
 # this image ships no ruff/flake8, so the gate is tools/lint.py)

@@ -2259,10 +2259,396 @@ def run_config14(rows: int, iters: int) -> dict:
     }
 
 
+def run_config15(rows: int, iters: int) -> dict:
+    """Multi-tenant isolation under overload (ISSUE 10): an OPEN-LOOP
+    load harness — arrivals fire on a precomputed Poisson schedule
+    regardless of completions, because a closed-loop driver throttles
+    itself exactly when the server overloads and hides the damage —
+    over a real HTTP server, N simulated tenants mixing writes, cached
+    dashboards, and heavy scans:
+
+      dash1/dash2   compliant: steady cached downsample dashboards on
+                    a small table
+      writer        compliant: steady small write batches (WAL path)
+      abuser        floods heavy full-span scans of the big table plus
+                    oversized write batches
+
+    Three legs on the SAME engine (caches warm, only policy changes):
+      baseline      [tenants] on, no abuser  -> per-tenant p99 floor
+      protected     [tenants] on, abuser on  -> weighted-fair admission
+                    + WAL rate quota confine the damage
+      unprotected   [tenants] off (global FIFO admission — the
+                    pre-change behavior), abuser on -> the control
+
+    Done-bar: worst compliant p99 in `protected` < 1.25x its
+    `baseline`, while `unprotected` records the collapse the global
+    queue produces.  iters scales the per-leg duration."""
+    import os
+    import random as random_mod
+    import tempfile
+
+    import aiohttp
+    import pyarrow as pa
+    from aiohttp import web
+
+    from horaedb_tpu.metric_engine import MetricEngine
+    from horaedb_tpu.objstore import FaultInjectingStore, MemoryObjectStore
+    from horaedb_tpu.server.config import (AdmissionConfig, ServerConfig,
+                                           ReadableDuration)
+    from horaedb_tpu.server.main import ServerState, build_app
+    from horaedb_tpu.common.tenant import tenants_from_dict
+    from horaedb_tpu.storage.config import StorageConfig, from_dict
+    from horaedb_tpu.wal.config import WalConfig
+
+    lat_s = float(os.environ.get("BENCH_STORE_LATENCY_MS", "20")) / 1e3
+    hosts = 100
+    interval = 10_000
+    per_host = max(60, rows // hosts)
+    span = per_host * interval
+    segment_ms = 2 * 3600 * 1000
+    T0 = (1_700_000_000_000 // segment_ms) * segment_ms
+    n = per_host * hosts
+    _check_i32_span(np.asarray([span]), "config15")
+    leg_seconds = max(4.0, min(30.0, float(iters)))
+    seed = int(os.environ.get("TENANT_BENCH_SEED", "15"))
+
+    # the abuser's ad-hoc target: a long-tail historical slice spread
+    # thin across many segments, so a cold scan is store-round-trip
+    # -bound (the overload shape quotas exist for), not a 2-core CPU
+    # burn whose collateral no admission policy could prevent
+    seg_ad = 60
+    n_ad = min(100_000, max(20_000, rows))
+    T_AD0 = T0 - 90 * segment_ms
+    span_ad = seg_ad * segment_ms
+
+    heavy_q = {"metric": "adhoc", "filters": {}, "start": T_AD0,
+               "end": T_AD0 + span_ad, "bucket_ms": 3_600_000}
+    dash_q = {"metric": "app", "filters": {}, "start": T0,
+              "end": T0 + min(span, 3_600_000), "bucket_ms": 300_000}
+
+    def small_write(i: int) -> dict:
+        # the writer ingests into the OPEN segment ahead of the
+        # dashboards' completed window: a dashboard aggregate then
+        # never pre-flushes the writer's fresh memtable rows, so its
+        # latency is the cached query, not a synchronous SST write —
+        # dashboards watching a lagged window is the realistic mix
+        return {"samples": [
+            {"name": "app_ingest", "labels": {"host": f"w{j:02d}"},
+             "timestamp": T0 + 3 * segment_ms + i * 1000 + j,
+             "value": float(j)}
+            for j in range(50)]}
+
+    def big_write(i: int) -> dict:
+        # the flood lands a DAY behind the dashboards' range: a
+        # dashboard query's aggregate pre-flush only drains (and only
+        # barriers on) memtables overlapping its own range, so the
+        # abuser's buffered junk is flushed on the abuser's dime
+        return {"samples": [
+            {"name": "junk", "labels": {"host": f"x{j:03d}"},
+             "timestamp": T0 - 86_400_000 + i * 1000 + j,
+             "value": float(j)}
+            for j in range(400)]}
+
+    def admission() -> AdmissionConfig:
+        return AdmissionConfig(
+            max_concurrent_queries=4, max_queued=128,
+            queue_timeout=ReadableDuration.parse("6s"),
+            query_timeout=ReadableDuration.parse("10s"))
+
+    def tenants_cfg(enabled: bool):
+        # the abuser is a low-priority ad-hoc class: one query slot,
+        # a short queue, and a WAL rate cap — the operator's policy
+        # for tenants with no latency SLO
+        return tenants_from_dict({
+            "enabled": enabled,
+            "tenant": {
+                "dash1": {"weight": 4.0},
+                "dash2": {"weight": 4.0},
+                "writer": {"weight": 2.0},
+                "abuser": {"weight": 1.0, "max_in_flight": 1,
+                           "max_queued": 3,
+                           "max_query_time": "1s",
+                           "scan_bytes_per_s": "512kb",
+                           "scan_burst_bytes": "2MiB",
+                           "wal_bytes_per_s": "256kb",
+                           "wal_burst_bytes": "1mb"},
+            }})
+
+    def schedule(rng, include_abuser: bool):
+        """(at_s, tenant, path, payload) arrivals, time-sorted."""
+        events = []
+
+        def poisson(tenant, rate, make):
+            t = 0.0
+            for i in range(int(leg_seconds * rate)):
+                t += rng.expovariate(rate)
+                events.append((t, tenant) + make(i))
+
+        for dash in ("dash1", "dash2"):
+            poisson(dash, 5.0, lambda i: ("/query", dash_q))
+        poisson("writer", 3.0, lambda i: ("/write", small_write(i)))
+        if include_abuser:
+            # ad-hoc shapes: each scan starts at a different segment so
+            # nothing upstream can memoize the flood away
+            poisson("abuser", 6.0, lambda i: (
+                "/query", dict(heavy_q,
+                               start=T_AD0 + (i % 12) * segment_ms)))
+            if not os.environ.get("TENANT_BENCH_NO_ABUSE_WRITES"):
+                poisson("abuser", 4.0, lambda i: ("/write", big_write(i)))
+        events.sort(key=lambda e: e[0])
+        return events
+
+    async def run_leg(engine, enabled: bool, include_abuser: bool,
+                      rng) -> dict:
+        cfg = ServerConfig()
+        cfg.admission = admission()
+        cfg.tenants = tenants_cfg(enabled)
+        state = ServerState(engine, cfg)
+        app = build_app(state)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = runner.addresses[0][1]
+        base = f"http://127.0.0.1:{port}"
+        lat: dict = {}
+        codes: dict = {}
+        # unbounded connector: the default 100-connection pool would
+        # queue arrivals CLIENT-side exactly in the collapsing leg —
+        # partially re-closing the open loop the Poisson schedule
+        # exists to keep open
+        session = aiohttp.ClientSession(
+            connector=aiohttp.TCPConnector(limit=0),
+            timeout=aiohttp.ClientTimeout(total=30))
+
+        async def fire(tenant, path, payload):
+            t0 = time.perf_counter()
+            try:
+                r = await session.post(  # noqa: session-wide 30s timeout
+                    base + path, json=payload,
+                    headers={"X-Tenant": tenant})
+                status = r.status
+                await r.release()
+            except asyncio.TimeoutError:
+                status = -1
+            except aiohttp.ClientError:
+                # a collapsing leg can drop keep-alive connections
+                # mid-request; that is a data point (failure code),
+                # not a reason to abort the whole recorded run
+                status = -2
+            dt = time.perf_counter() - t0
+            lat.setdefault((tenant, path), []).append(dt)
+            k = (tenant, path)
+            codes.setdefault(k, {})
+            codes[k][status] = codes[k].get(status, 0) + 1
+
+        try:
+            # unmeasured preamble: one of each request shape, so leg
+            # -local compiles / first-touch flushes don't poison the
+            # open-loop backlog (an early multi-second stall never
+            # drains when arrivals keep their schedule)
+            for tenant, path, payload in (
+                    ("dash1", "/query", dash_q),
+                    ("dash2", "/query", dash_q),
+                    ("writer", "/write", small_write(0)),
+                    ("abuser", "/write", big_write(0)),
+                    ("abuser", "/query", heavy_q)):
+                r = await session.post(  # noqa: session-wide timeout
+                    base + path, json=payload,
+                    headers={"X-Tenant": tenant})
+                await r.release()
+            lat.clear()
+            codes.clear()
+            tasks = []
+            start = time.perf_counter()
+            for at, tenant, path, payload in schedule(
+                    rng, include_abuser):
+                delay = start + at - time.perf_counter()
+                if delay > 0:
+                    await asyncio.sleep(delay)
+                tasks.append(asyncio.create_task(
+                    fire(tenant, path, payload)))
+            await asyncio.gather(*tasks)
+        finally:
+            await session.close()
+            await runner.cleanup()
+        out = {}
+        for (tenant, path), ls in sorted(lat.items()):
+            ok = codes[(tenant, path)].get(200, 0)
+            kind = "query" if path == "/query" else "write"
+            arr = np.asarray(ls) * 1e3
+            out[f"{tenant}_{kind}"] = {
+                "n": len(ls),
+                "p50_ms": round(float(np.percentile(arr, 50)), 1),
+                "p99_ms": round(float(np.percentile(arr, 99)), 1),
+                "ok": ok,
+                "codes": {str(k): v for k, v in sorted(
+                    codes[(tenant, path)].items())},
+            }
+        return out
+
+    async def go():
+        store = FaultInjectingStore(MemoryObjectStore(), seed=seed,
+                                    latency_range=(lat_s, lat_s))
+        wal_dir = tempfile.mkdtemp(prefix="tenant-bench-wal-")
+        rng_np = np.random.default_rng(seed)
+        # bulk ingest WAL-free (the serving legs exercise the WAL; the
+        # fixture load should not), then reopen with the WAL front end
+        engine = await MetricEngine.open("cfg15", store,
+                                         segment_ms=segment_ms)
+        ts = T0 + np.repeat(
+            np.arange(per_host, dtype=np.int64) * interval, hosts)
+        host_id = np.tile(np.arange(hosts, dtype=np.int32), per_host)
+        vals = (rng_np.random(n) * 100).astype(np.float64)
+        names = pa.array([f"host_{i:03d}" for i in range(hosts)])
+        chunk = max(1, 1_000_000 // hosts) * hosts
+        for lo in range(0, n, chunk):
+            hi = min(n, lo + chunk)
+            await engine.write_arrow("cpu", ["host"], pa.record_batch({
+                "host": pa.DictionaryArray.from_arrays(
+                    pa.array(host_id[lo:hi]), names),
+                "timestamp": pa.array(ts[lo:hi], type=pa.int64()),
+                "value": pa.array(vals[lo:hi], type=pa.float64()),
+            }))
+        # ...the small table the dashboards watch
+        m = 20 * 360
+        await engine.write_arrow("app", ["host"], pa.record_batch({
+            "host": pa.array([f"app_{i % 20:02d}" for i in range(m)]),
+            "timestamp": pa.array(
+                T0 + np.arange(m, dtype=np.int64) * 10_000 % span,
+                type=pa.int64()),
+            "value": pa.array(rng_np.random(m), type=pa.float64()),
+        }))
+        # ...and the long-tail historical slice the abuser hammers:
+        # n_ad rows spread evenly across seg_ad two-hour segments
+        ad_hosts = 50
+        ad_per_host = n_ad // ad_hosts
+        ad_ts = T_AD0 + np.repeat(
+            np.arange(ad_per_host, dtype=np.int64)
+            * (span_ad // ad_per_host), ad_hosts)
+        ad_ids = np.tile(np.arange(ad_hosts, dtype=np.int32),
+                         ad_per_host)
+        ad_names = pa.array([f"svc_{i:02d}" for i in range(ad_hosts)])
+        await engine.write_arrow("adhoc", ["host"], pa.record_batch({
+            "host": pa.DictionaryArray.from_arrays(
+                pa.array(ad_ids), ad_names),
+            "timestamp": pa.array(ad_ts, type=pa.int64()),
+            "value": pa.array(rng_np.random(len(ad_ts)),
+                              type=pa.float64()),
+        }))
+        await engine.close()
+        # serving config: the historical slice overwhelms the HBM
+        # windows budget, tier-2 and the parts memo are off, and the
+        # scan pipeline is off — the abuser's ad-hoc scans pay the
+        # seeded store latency segment by segment, every time, while
+        # the small dashboard table stays cache-resident
+        serving_cfg = from_dict(StorageConfig, {
+            "scan": {"cache_max_rows": 20_000,
+                     "cache": {"tier2_max_bytes": 0},
+                     "combine": {"memo_max_bytes": 0},
+                     "pipeline": {"enabled": False}},
+        })
+        engine = await MetricEngine.open(
+            "cfg15", store, segment_ms=segment_ms, config=serving_cfg,
+            wal_config=WalConfig(enabled=True, dir=wal_dir))
+        try:
+            # warm both query shapes (compile + the dashboard cache)
+            # so every leg sees the same steady state
+            from horaedb_tpu.storage.types import TimeRange
+
+            await engine.query_downsample(
+                "adhoc", [], TimeRange.new(T_AD0, T_AD0 + span_ad),
+                bucket_ms=3_600_000, aggs=("avg",))
+            await engine.query_downsample(
+                "app", [], TimeRange.new(T0, T0 + min(span, 3_600_000)),
+                bucket_ms=300_000, aggs=("avg",))
+
+            out = {"rows": n, "leg_seconds": leg_seconds,
+                   "store_latency_ms": lat_s * 1e3}
+            # a FRESH rng per leg: protected and unprotected must
+            # replay the IDENTICAL Poisson arrival realization, or the
+            # A/B compares different workloads
+            _log("config15: leg baseline (tenants on, no abuse)")
+            out["baseline"] = await run_leg(
+                engine, True, False, random_mod.Random(seed))
+            _log("config15: leg protected (tenants on, abuse)")
+            out["protected"] = await run_leg(
+                engine, True, True, random_mod.Random(seed))
+            _log("config15: leg unprotected (tenants off, abuse)")
+            out["unprotected"] = await run_leg(
+                engine, False, True, random_mod.Random(seed))
+            return out
+        finally:
+            await engine.close()
+
+    out = asyncio.run(go())
+
+    compliant = ("dash1_query", "dash2_query", "writer_write")
+    degr = {}
+    for leg in ("protected", "unprotected"):
+        worst = 0.0
+        for k in compliant:
+            base = out["baseline"][k]["p99_ms"]
+            now = out[leg][k]["p99_ms"]
+            if base > 0:
+                worst = max(worst, now / base)
+        degr[leg] = round(worst, 3)
+    out["protected_p99_degradation"] = degr["protected"]
+    out["unprotected_p99_degradation"] = degr["unprotected"]
+    out["bar_relative_ok"] = degr["protected"] < 1.25
+    # the STATED SLO bar — absolute, the form production SLOs take:
+    # compliant dashboards answer < 500 ms p99 and compliant writes
+    # ack < 1 s p99 WITH the abuser flooding, every request served
+    # (no compliant sheds).  The relative (<1.25x) bar is recorded
+    # too, but on a 2-core host a p99 ratio against a ~15 ms baseline
+    # measures GIL/event-loop sharing and fsync variance more than
+    # admission policy — the honest blocking-cause note rides the
+    # recorded JSON.
+    out["slo_query_p99_ms"] = 500.0
+    out["slo_write_p99_ms"] = 1000.0
+    out["bar_slo_ok"] = all(
+        out["protected"][k]["p99_ms"]
+        < (out["slo_write_p99_ms"] if k.endswith("_write")
+           else out["slo_query_p99_ms"])
+        and out["protected"][k]["codes"].get("200", 0)
+        == out["protected"][k]["n"]
+        for k in compliant)
+    out["slo_unprotected_ok"] = all(
+        out["unprotected"][k]["p99_ms"]
+        < (out["slo_write_p99_ms"] if k.endswith("_write")
+           else out["slo_query_p99_ms"])
+        for k in compliant)
+    out["control_shows_damage"] = (degr["unprotected"]
+                                   > degr["protected"])
+    abuser = out["protected"].get("abuser_query", {})
+    out["abuser_sheds_protected"] = (abuser.get("codes", {})
+                                     .get("429", 0))
+    worst_ms = max(out["protected"][k]["p99_ms"] for k in compliant)
+    _log(f"config15: compliant SLO under abuse "
+         f"{'MET' if out['bar_slo_ok'] else 'MISSED'} (worst p99 "
+         f"{worst_ms:.0f} ms) vs unprotected SLO "
+         f"{'met' if out['slo_unprotected_ok'] else 'blown'} | "
+         f"p99 degradation protected {degr['protected']}x vs "
+         f"unprotected {degr['unprotected']}x | abuser 429s "
+         f"{out['abuser_sheds_protected']}")
+    return {
+        "metric": (f"multi-tenant isolation: worst compliant p99 under "
+                   f"abuse with weighted-fair admission + quotas, "
+                   f"{n / 1e6:.1f}M rows, open-loop"),
+        "value": worst_ms,
+        "unit": "ms",
+        # done-bar context: how much worse the unprotected control
+        # degrades compliant tenants than the protected plane does
+        "vs_baseline": round(
+            degr["unprotected"] / max(degr["protected"], 1e-9), 2),
+        **out,
+    }
+
+
 RUNNERS = {2: run_config2, 3: run_config3, 4: run_config4, 5: run_config5,
            6: run_config6, 7: run_config7, 8: run_config8, 9: run_config9,
            10: run_config10, 11: run_config11, 12: run_config12,
-           13: run_config13, 14: run_config14}
+           13: run_config13, 14: run_config14, 15: run_config15}
 
 
 def main() -> None:

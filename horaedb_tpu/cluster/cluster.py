@@ -93,6 +93,11 @@ class Cluster:
         # pings drive open -> half-open recovery
         self.breakers: dict[int, CircuitBreaker] = {}
         self._breaker_config = BreakerConfig()
+        # last load survey (survey_load): region stats + the hot-shard
+        # split/rebalance plan, refreshed by the health monitor every
+        # _SURVEY_EVERY rounds and surfaced on /debug/tasks
+        self.rebalance_survey: Optional[dict] = None
+        self._health_rounds = 0
 
     @property
     def breaker_config(self) -> BreakerConfig:
@@ -301,13 +306,22 @@ class Cluster:
     def _health_backlog(self) -> dict:
         """/debug/tasks hint: which peers are failing and the last
         heartbeat error per region (with its timestamp)."""
-        return {
+        out = {
             "dead_regions": sorted(self.dead_regions),
             "consecutive_fails": {str(r): n for r, n
                                   in self._health_fails.items() if n},
             "last_errors": {str(r): dict(e) for r, e
                             in self._health_errors.items()},
         }
+        if self.rebalance_survey is not None:
+            # the hot-shard signal rides the same surface: an operator
+            # watching /debug/tasks sees the split/rebalance plan next
+            # to the liveness it derives from
+            out["rebalance"] = {
+                "at_ms": self.rebalance_survey["at_ms"],
+                "plan": self.rebalance_survey["plan"],
+            }
+        return out
 
     async def stop_health_monitor(self) -> None:
         if self._health_task is not None:
@@ -366,6 +380,10 @@ class Cluster:
                     br.record_failure()
         return alive
 
+    # load surveys (region_stats RPCs to every peer) are heavier than
+    # pings: refresh the rebalance plan every Nth health round
+    _SURVEY_EVERY = 6
+
     async def _health_loop(self, hb, interval_s: float) -> None:
         while True:
             hb.beat()
@@ -375,6 +393,12 @@ class Cluster:
                 with op_trace("health_round", slow_s=max(interval_s,
                                                          5.0)):
                     await self.check_health_once()
+                    self._health_rounds += 1
+                    if self._health_rounds % self._SURVEY_EVERY == 0:
+                        # per-region load -> hot-shard split/rebalance
+                        # recommendation (cached; /debug/tasks +
+                        # /admin/rebalance read it)
+                        await self.survey_load()
                 hb.ok()
             except asyncio.CancelledError:
                 raise
@@ -393,10 +417,17 @@ class Cluster:
         """Propose region moves from the REAL load signal: regions whose
         stored bytes exceed `skew_ratio` x the mean are flagged with the
         detach/adopt recipe (ownership handoff over the shared store —
-        no data copy).  Returns [] when balanced.  The operator (or an
+        no data copy) plus a split recipe when the hot region serves
+        several routing rules (a hot SHARD is relieved by splitting its
+        key range, not by moving the whole thing to an equally-sized
+        victim).  Returns [] when balanced.  The operator (or an
         external controller loop) executes the moves; this node cannot
         know its peers' capacities."""
-        stats = await self.region_stats()
+        return self._rebalance_from_stats(await self.region_stats(),
+                                          skew_ratio)
+
+    def _rebalance_from_stats(self, stats: dict[int, dict],
+                              skew_ratio: float) -> list[dict]:
         sized = {rid: s["bytes"] for rid, s in stats.items()
                  if s["bytes"] >= 0}
         if len(sized) < 2:
@@ -404,19 +435,47 @@ class Cluster:
         mean = sum(sized.values()) / len(sized)
         if mean <= 0:
             return []
+        next_rid = max(list(sized) + [r.region_id
+                       for r in self.routing.rules]) + 1
         plan = []
         for rid, b in sorted(sized.items(), key=lambda kv: -kv[1]):
             if b > skew_ratio * mean:
-                plan.append({
+                rules = stats[rid].get("rules", 0)
+                entry = {
                     "region": rid,
                     "bytes": b,
                     "mean_bytes": round(mean),
+                    "rules": rules,
                     "reason": f"stores {b / mean:.1f}x the mean",
                     "proposal": ("detach_region({rid}) here; "
                                  "adopt_region({rid}) on a lighter node"
                                  .format(rid=rid)),
-                })
+                }
+                if rules >= 1:
+                    # hot shard: halve its key share in place; the new
+                    # region can then move independently
+                    entry["split_proposal"] = (
+                        f"split_region({rid}, pivot_key=<median series "
+                        f"hash>, new_region_id={next_rid}, "
+                        "table_ttl_ms=<table TTL>)")
+                    entry["new_region_id"] = next_rid
+                    next_rid += 1
+                plan.append(entry)
         return plan
+
+    async def survey_load(self, skew_ratio: float = 2.0) -> dict:
+        """One load survey: per-region rows/bytes plus the rebalance/
+        split plan, cached for /debug/tasks (the health monitor runs
+        this periodically) and served by POST /admin/rebalance."""
+        stats = await self.region_stats()
+        out = {
+            "at_ms": now_ms(),
+            "skew_ratio": skew_ratio,
+            "region_stats": {str(r): s for r, s in sorted(stats.items())},
+            "plan": self._rebalance_from_stats(stats, skew_ratio),
+        }
+        self.rebalance_survey = out
+        return out
 
     # ---- write ------------------------------------------------------------
 

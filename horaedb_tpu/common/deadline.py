@@ -134,14 +134,31 @@ class deadline_scope:
         _CURRENT.reset(self._token)
 
 
+# cooperative-cancellation hooks: other ambient budgets (the tenant
+# scan-byte quota, common/tenant.py) raise at the SAME checkpoints the
+# deadline machinery uses, so every long host loop that is deadline
+# -aware is automatically quota-aware — no second set of call sites to
+# keep in sync.  Hooks must be cheap no-ops outside their own scope.
+_CHECKPOINT_HOOKS: tuple = ()
+
+
+def add_checkpoint_hook(fn) -> None:
+    global _CHECKPOINT_HOOKS
+    if fn not in _CHECKPOINT_HOOKS:
+        _CHECKPOINT_HOOKS = _CHECKPOINT_HOOKS + (fn,)
+
+
 def checkpoint() -> None:
     """Cooperative cancellation point: a cheap no-op when no deadline
     is bound, else raises DeadlineExceeded once it has lapsed.  Long
     host-side loops (merge-scan segments, gather merges) call this once
-    per iteration."""
+    per iteration.  Registered budget hooks (tenant quotas) fire here
+    too, deadline bound or not."""
     dl = _CURRENT.get()
     if dl is not None:
         dl.check()
+    for fn in _CHECKPOINT_HOOKS:
+        fn()
 
 
 def remaining_budget(cap_s: Optional[float]) -> Optional[float]:

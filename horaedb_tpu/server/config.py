@@ -17,6 +17,7 @@ from dataclasses import dataclass, field
 from typing import Any, Optional
 
 from horaedb_tpu.common import Error, ReadableDuration, ensure
+from horaedb_tpu.common.tenant import TenantsConfig, tenants_from_dict
 from horaedb_tpu.cluster.breaker import BreakerConfig
 from horaedb_tpu.metric_engine.meta import MetaConfig
 from horaedb_tpu.rollup.config import RollupConfig, rollup_from_dict
@@ -50,9 +51,14 @@ class AdmissionConfig:
         default_factory=lambda: ReadableDuration.parse("30s"))
     max_timeout: ReadableDuration = field(
         default_factory=lambda: ReadableDuration.parse("5m"))
-    # hint returned on 429/503 responses
+    # floor for the Retry-After hint on 429/503 responses; the served
+    # value is load-aware (derived from queue depth / observed service
+    # rate, capped at max_retry_after) and falls back to this floor
+    # when no service rate has been observed yet
     retry_after: ReadableDuration = field(
         default_factory=lambda: ReadableDuration.parse("1s"))
+    max_retry_after: ReadableDuration = field(
+        default_factory=lambda: ReadableDuration.parse("60s"))
 
 
 @dataclass
@@ -150,6 +156,10 @@ class ServerConfig:
     port: int = 5000
     test: TestConfig = field(default_factory=TestConfig)
     admission: AdmissionConfig = field(default_factory=AdmissionConfig)
+    # per-tenant isolation: weighted-fair admission over per-tenant
+    # queues + scan-byte / WAL-rate quotas (common/tenant.py); disabled
+    # reproduces the global single-FIFO admission exactly
+    tenants: TenantsConfig = field(default_factory=TenantsConfig)
     # circuit breaker / RPC policy for a cluster-backed server's
     # scatter-gather plane (applied when the served engine is a Cluster)
     breaker: BreakerConfig = field(default_factory=BreakerConfig)
@@ -194,6 +204,9 @@ def _dc_from_dict(cls: type, data: dict[str, Any]) -> Any:
         elif key == "admission":
             ensure(isinstance(value, dict), f"{where} expects a config table")
             kwargs[key] = _dc_from_dict(AdmissionConfig, value)
+        elif key == "tenants" and cls is ServerConfig:
+            ensure(isinstance(value, dict), f"{where} expects a config table")
+            kwargs[key] = tenants_from_dict(value)
         elif key == "breaker":
             ensure(isinstance(value, dict), f"{where} expects a config table")
             kwargs[key] = _dc_from_dict(BreakerConfig, value)

@@ -28,18 +28,26 @@ import asyncio
 import logging
 import math
 import random
+import time
 from collections import deque
 from typing import Optional
 
 from aiohttp import web
 
-from horaedb_tpu.common import Error, now_ms
+from horaedb_tpu.common import Error, ensure, now_ms
 from horaedb_tpu.common.deadline import (
     Deadline,
     DeadlineExceeded,
     deadline_scope,
 )
 from horaedb_tpu.common.loops import loops
+from horaedb_tpu.common.tenant import (
+    QuotaExceeded,
+    TenantRegistry,
+    current_tenant,
+    tenant_scope,
+    tenants_from_dict,
+)
 from horaedb_tpu.metric_engine import Label, MetricEngine, Sample
 from horaedb_tpu.objstore import LocalObjectStore
 from horaedb_tpu.server.config import (AdmissionConfig, ServerConfig,
@@ -51,12 +59,21 @@ from horaedb_tpu.utils import tracing
 logger = logging.getLogger(__name__)
 
 # endpoints under query admission control + the query deadline; writes
-# get the write deadline but are never shed (back-pressure belongs to
-# the storage write path), admin/ops endpoints run unbounded
+# get the write deadline (and, tenants enabled, the per-tenant WAL rate
+# gate) but are never queue-shed (back-pressure belongs to the storage
+# write path), admin/ops endpoints run unbounded.  EVERY registered
+# route must appear in exactly one of these three sets — tools/lint.py
+# rejects a handler outside them, so no future endpoint can silently
+# bypass the admission+tenant middleware chain.
 _QUERY_ENDPOINTS = frozenset({
     "/query", "/query_arrow", "/query_topk", "/query_multi",
     "/label_values", "/label_names", "/metrics_list"})
 _WRITE_ENDPOINTS = frozenset({"/write", "/write_arrow"})
+_UNGOVERNED_ENDPOINTS = frozenset({
+    "/", "/toggle", "/compact", "/metrics", "/stats",
+    "/admin/scrub", "/admin/flush", "/admin/rollups",
+    "/admin/tenants", "/admin/rebalance",
+    "/debug/traces", "/debug/traces/{trace_id}", "/debug/tasks"})
 
 _SHED = registry.counter(
     "server_queries_shed_total",
@@ -73,18 +90,66 @@ _QUEUED_QUERIES = registry.gauge(
     "server_queued_queries", "queries waiting for an admission slot")
 
 
+class _ServiceRate:
+    """Observed admission service rate: completions per second over a
+    sliding window.  The denominator of the load-aware Retry-After —
+    backoff guidance derived from queue depth / this rate tracks how
+    overloaded the server actually is, where a constant hint tells a
+    client to come back into the same collapse."""
+
+    WINDOW_S = 30.0
+
+    def __init__(self, clock=time.monotonic):
+        self._clock = clock
+        self._done: deque[float] = deque()
+
+    def _prune(self, now: float) -> None:
+        while self._done and now - self._done[0] > self.WINDOW_S:
+            self._done.popleft()
+
+    def record(self) -> None:
+        now = self._clock()
+        self._done.append(now)
+        self._prune(now)
+
+    def per_second(self) -> Optional[float]:
+        now = self._clock()
+        self._prune(now)
+        if len(self._done) < 2:
+            return None
+        dt = now - self._done[0]
+        return len(self._done) / dt if dt > 0 else None
+
+
+def _load_aware_retry_after(cfg: AdmissionConfig, queued: int,
+                            rate: Optional[float]) -> str:
+    """Retry-After seconds for a 429/503: the estimated time to drain
+    the queue ahead of a retry ((queued+1) / observed service rate),
+    floored at [admission] retry_after and capped at max_retry_after.
+    Falls back to the floor before any completion has been observed."""
+    floor = max(1, math.ceil(cfg.retry_after.seconds))
+    cap = max(floor, math.ceil(cfg.max_retry_after.seconds or 60.0))
+    if not rate or rate <= 0:
+        return str(floor)
+    eta = (queued + 1) / rate
+    return str(min(cap, max(floor, math.ceil(eta))))
+
+
 class AdmissionController:
     """Semaphore-bounded query pool with a bounded FIFO wait queue
     (docs/robustness.md).  `acquire` returns "ok" (slot held — caller
     must release), "shed" (queue full: answer 429 immediately), or
     "timeout" (waited out `queue_timeout`: answer 503).  Shedding fast
     keeps latency bounded for the queries that ARE admitted instead of
-    letting everyone collapse together."""
+    letting everyone collapse together.  This is the GLOBAL controller
+    ([tenants] disabled — the pre-tenant behavior, unchanged);
+    FairAdmissionController is the weighted-fair per-tenant upgrade."""
 
     def __init__(self, config: AdmissionConfig):
         self.config = config
         self._active = 0
         self._waiters: deque[asyncio.Future] = deque()
+        self.rate = _ServiceRate()
 
     @property
     def active(self) -> int:
@@ -142,7 +207,189 @@ class AdmissionController:
     def release(self) -> None:
         self._active -= 1
         _ACTIVE_QUERIES.set(self._active)
+        self.rate.record()
         self._wake()
+
+    def retry_after_s(self) -> str:
+        return _load_aware_retry_after(self.config, self.queued,
+                                       self.rate.per_second())
+
+
+class _TenantQueue:
+    """One tenant's admission state: its FIFO wait queue, in-flight
+    count, and stride-scheduling pass value, plus the pre-bound
+    per-tenant gauges."""
+
+    __slots__ = ("tenant", "waiters", "in_flight", "pass_",
+                 "active_gauge", "queued_gauge")
+
+    def __init__(self, tenant, pass_: float):
+        self.tenant = tenant
+        self.waiters: deque = deque()  # (arrival_seq, future)
+        self.in_flight = 0
+        self.pass_ = pass_
+        self.active_gauge = _ACTIVE_QUERIES.labels(tenant=tenant.name)
+        self.queued_gauge = _QUEUED_QUERIES.labels(tenant=tenant.name)
+
+
+class FairAdmissionController:
+    """Weighted-fair admission ([tenants] enabled): the global
+    [admission] slot pool is granted across PER-TENANT FIFO queues by
+    stride scheduling — each grant advances the tenant's virtual
+    "pass" by 1/weight, and a freed slot goes to the eligible tenant
+    (non-empty queue, under its max_in_flight cap) with the LOWEST
+    pass, oldest arrival breaking ties.  Tenants therefore receive
+    admission slots in proportion to their weights whenever they
+    contend — at any pool size, regardless of how deep a flooding
+    tenant's queue is — so the flood fills only its OWN queue (429s
+    scoped to it) and a compliant tenant's wait stays bounded by its
+    fair share, not by the abuser's backlog.  A tenant returning from
+    idle re-enters at the current virtual time (no banked priority,
+    no penalty), which is what makes the discipline starvation-free
+    in both directions."""
+
+    def __init__(self, config: AdmissionConfig):
+        self.config = config
+        self._active = 0
+        self._queues: dict[str, _TenantQueue] = {}
+        self._arrivals = 0
+        self._vtime = 0.0  # pass of the most recent grant
+        self.rate = _ServiceRate()
+
+    @property
+    def active(self) -> int:
+        return self._active
+
+    def queued(self, tenant=None) -> int:
+        if tenant is None:
+            return sum(len(q.waiters) for q in self._queues.values())
+        q = self._queues.get(tenant.name)
+        return len(q.waiters) if q is not None else 0
+
+    def occupancy(self) -> dict:
+        """/stats: per-tenant admission occupancy."""
+        return {name: {"in_flight": q.in_flight,
+                       "queued": len(q.waiters)}
+                for name, q in self._queues.items()
+                if q.in_flight or q.waiters}
+
+    def _q(self, tenant) -> _TenantQueue:
+        q = self._queues.get(tenant.name)
+        if q is None:
+            q = self._queues[tenant.name] = _TenantQueue(
+                tenant, pass_=self._vtime)
+        elif q.tenant is not tenant:
+            # a config reload re-points limits (weight/caps) without
+            # disturbing in-flight state or queued waiters; gauges
+            # rebind because the reload may have deregistered the old
+            # children (a removed-then-recreated tenant must not write
+            # into unrendered orphans)
+            q.tenant = tenant
+            q.active_gauge = _ACTIVE_QUERIES.labels(tenant=tenant.name)
+            q.queued_gauge = _QUEUED_QUERIES.labels(tenant=tenant.name)
+        if not q.waiters and q.in_flight == 0:
+            # returning from idle: re-enter at the current virtual
+            # time — an idle stretch must not bank priority (pass
+            # frozen in the past) nor penalize (pass ahead of vtime
+            # never happens; passes only advance on grants)
+            q.pass_ = max(q.pass_, self._vtime)
+        return q
+
+    def _under_cap(self, q: _TenantQueue) -> bool:
+        cap = q.tenant.limits.max_in_flight
+        return cap <= 0 or q.in_flight < cap
+
+    def _grant(self, q: _TenantQueue) -> None:
+        q.in_flight += 1
+        self._active += 1
+        self._vtime = max(self._vtime, q.pass_)
+        q.pass_ += 1.0 / q.tenant.limits.weight
+        q.active_gauge.set(q.in_flight)
+        _ACTIVE_QUERIES.set(self._active)
+
+    async def acquire(self, tenant, timeout_s: Optional[float]) -> str:
+        q = self._q(tenant)
+        if (not q.waiters and self._under_cap(q)
+                and self._active < self.config.max_concurrent_queries):
+            self._grant(q)
+            return "ok"
+        # two queue bounds: the tenant's own max_queued (the scoped
+        # shed that confines a flood), AND the operator's TOTAL
+        # [admission] max_queued — enabling [tenants] must not quietly
+        # turn an 8-entry queue bound into 64 x n_tenants of queued
+        # memory and worst-case wait
+        if (len(q.waiters) >= max(0, q.tenant.limits.max_queued)
+                or self.queued() >= self.config.max_queued):
+            return "shed"
+        fut = asyncio.get_running_loop().create_future()
+        self._arrivals += 1
+        entry = (self._arrivals, fut)
+        q.waiters.append(entry)
+        q.queued_gauge.set(len(q.waiters))
+        _QUEUED_QUERIES.set(self.queued())
+        try:
+            await asyncio.wait_for(fut, timeout_s)
+            return "ok"
+        except asyncio.TimeoutError:
+            self._give_back_racing_grant(q, fut)
+            return "timeout"
+        except asyncio.CancelledError:
+            self._give_back_racing_grant(q, fut)
+            raise
+        finally:
+            try:
+                q.waiters.remove(entry)
+            except ValueError:
+                pass  # granted and popped by _wake
+            q.queued_gauge.set(len(q.waiters))
+            _QUEUED_QUERIES.set(self.queued())
+
+    def _give_back_racing_grant(self, q: _TenantQueue,
+                                fut: asyncio.Future) -> None:
+        # same py3.12+ race as the global controller: a grant landing
+        # in the same tick as the timeout/cancel must be handed on
+        if fut.done() and not fut.cancelled():
+            self.release(q.tenant)
+
+    def release(self, tenant) -> None:
+        q = self._queues.get(tenant.name)
+        if q is not None:
+            q.in_flight -= 1
+            q.active_gauge.set(q.in_flight)
+        self._active -= 1
+        _ACTIVE_QUERIES.set(self._active)
+        self.rate.record()
+        self._wake()
+
+    def _wake(self) -> None:
+        while self._active < self.config.max_concurrent_queries:
+            best = None
+            best_key = None
+            for q in self._queues.values():
+                while q.waiters and q.waiters[0][1].done():
+                    # cancelled/timed-out head — acquire's finally
+                    # prunes its own entry, this is just hygiene
+                    q.waiters.popleft()
+                if not q.waiters or not self._under_cap(q):
+                    continue
+                key = (q.pass_, q.waiters[0][0])
+                if best_key is None or key < best_key:
+                    best, best_key = q, key
+            if best is None:
+                break
+            _seq, fut = best.waiters.popleft()
+            best.queued_gauge.set(len(best.waiters))
+            self._grant(best)
+            fut.set_result(True)
+        _QUEUED_QUERIES.set(self.queued())
+
+    def retry_after_s(self, tenant) -> str:
+        """Per-tenant backoff guidance: this tenant's queue depth over
+        the GLOBAL observed service rate (a conservative ETA — the
+        tenant's fair share drains at least this fast unless everyone
+        else is idle)."""
+        return _load_aware_retry_after(self.config, self.queued(tenant),
+                                       self.rate.per_second())
 
 
 class ServerState:
@@ -151,6 +398,15 @@ class ServerState:
         self.config = config
         self.write_enabled = True
         self.admission = AdmissionController(config.admission)
+        # [tenants]: weighted-fair per-tenant admission + quotas; None
+        # when disabled, and every tenant-aware path then falls back
+        # to the exact pre-tenant global behavior
+        self.tenants: Optional[TenantRegistry] = (
+            TenantRegistry(config.tenants) if config.tenants.enabled
+            else None)
+        self.fair_admission: Optional[FairAdmissionController] = (
+            FairAdmissionController(config.admission)
+            if self.tenants is not None else None)
         # [trace] applies to the process-wide recorder (the ring and
         # slow-query log are one per process, like the registry)
         tracing.recorder.configure(
@@ -238,8 +494,13 @@ def _tracing_middleware(state: ServerState):
             return await handler(request)
         incoming = request.headers.get(tracing.TRACE_HEADER)
         trace_id = incoming or tracing.new_trace_id()
-        trace = tracing.recorder.start(path, trace_id=trace_id,
-                                       forced=incoming is not None)
+        # the tenant middleware is outermost, so the ambient tenant —
+        # when [tenants] is on — labels the trace root
+        tenant = current_tenant()
+        trace = tracing.recorder.start(
+            path, trace_id=trace_id, forced=incoming is not None,
+            root_fields=({"tenant": tenant.name}
+                         if tenant is not None else None))
         if trace is None:
             # unsampled: the id still travels (response header +
             # downstream propagation via the ambient contextvars being
@@ -273,6 +534,40 @@ def _tracing_middleware(state: ServerState):
     return middleware
 
 
+def _tenant_middleware(state: ServerState):
+    """Tenant identity at ingress (docs/robustness.md, tenant
+    isolation): resolve the X-Tenant header (absent -> the "default"
+    tenant) against the [tenants] registry and bind the tenant as
+    ambient context for everything below — the trace root, weighted
+    -fair admission, the scan-byte budget's checkpoint hook, and the
+    WAL rate gate all read it from the contextvar.  A no-op when
+    [tenants] is disabled (the registry is None), so the pre-tenant
+    request path is byte-for-byte unchanged."""
+
+    @web.middleware
+    async def middleware(request: web.Request, handler):
+        reg = state.tenants
+        path = request.path
+        if reg is None or (path not in _QUERY_ENDPOINTS
+                           and path not in _WRITE_ENDPOINTS):
+            return await handler(request)
+        try:
+            tenant = reg.resolve(request.headers.get("X-Tenant"))
+        except Error as e:
+            return web.json_response({"error": str(e)}, status=400)
+        t0 = time.perf_counter()
+        try:
+            with tenant_scope(tenant):
+                return await handler(request)
+        finally:
+            # server-side per-tenant latency (quantiles on /stats);
+            # sheds and 504s count — a tenant's experienced latency
+            # includes its rejections
+            tenant.query_seconds.observe(time.perf_counter() - t0)
+
+    return middleware
+
+
 def _resilience_middleware(state: ServerState):
     """Request-lifecycle robustness (docs/robustness.md): mint ONE
     Deadline per request at ingress (per-endpoint default, shrinkable
@@ -280,19 +575,49 @@ def _resilience_middleware(state: ServerState):
     ambient deadline every layer below budgets against, enforce it with
     a hard 504 backstop, and run query endpoints through admission
     control (429 queue-full shed / 503 queued-wait timeout, both with
-    Retry-After)."""
+    a LOAD-AWARE Retry-After derived from queue depth and the observed
+    service rate).  An already-expired deadline fast-fails 504 BEFORE
+    consuming an admission slot, and one that expires while queued
+    answers 504 without ever holding a slot — dead requests must not
+    occupy queue capacity under overload.  With [tenants] enabled,
+    admission is weighted-fair over per-tenant queues and quota
+    breaches (QuotaExceeded from the scan/WAL budgets) map to 429s
+    scoped to the offending tenant."""
+
+    def _labeled(counter, tenant):
+        return (counter.labels(tenant=tenant.name)
+                if tenant is not None else counter)
+
+    def _timeout_504(timeout_s, tenant):
+        _labeled(_DEADLINE_504, tenant).inc()
+        return web.json_response(
+            {"error": f"deadline exceeded ({timeout_s:.3f}s budget)"},
+            status=504)
+
+    def _quota_429(exc: QuotaExceeded, tenant):
+        if tenant is not None:
+            tenant.quota_rejected(exc.resource)
+        return web.json_response(
+            {"error": str(exc), "quota": exc.resource,
+             "tenant": exc.tenant},
+            status=429,
+            headers={"Retry-After":
+                     str(max(1, math.ceil(exc.retry_after_s)))})
 
     @web.middleware
     async def middleware(request: web.Request, handler):
         cfg = state.config.admission
         path = request.path
-        if path in _QUERY_ENDPOINTS:
+        is_query = path in _QUERY_ENDPOINTS
+        is_write = path in _WRITE_ENDPOINTS
+        if is_query:
             default_s = cfg.query_timeout.seconds or None
-        elif path in _WRITE_ENDPOINTS:
+        elif is_write:
             default_s = cfg.write_timeout.seconds or None
         else:
             default_s = None  # ops/admin endpoints run unbounded
         timeout_s = default_s
+        tenant = current_tenant()  # bound by the tenant middleware
         raw = (request.headers.get("X-Deadline-Ms")
                or request.query.get("timeout_ms"))
         if raw is not None:
@@ -301,37 +626,84 @@ def _resilience_middleware(state: ServerState):
             except ValueError:
                 return web.json_response(
                     {"error": f"bad deadline: {raw!r}"}, status=400)
+            if asked_s <= 0 and (is_query or is_write):
+                # dead on arrival: the client declared its budget
+                # already spent — 504 before any slot, queue entry,
+                # WAL frame, or fsync is consumed
+                _labeled(_DEADLINE_504, tenant).inc()
+                return web.json_response(
+                    {"error": "deadline exceeded (budget spent before "
+                              "arrival)"}, status=504)
             cap = cfg.max_timeout.seconds or None
             timeout_s = max(0.001, min(asked_s, cap) if cap else asked_s)
-        retry_after = str(max(1, math.ceil(cfg.retry_after.seconds)))
+        if (tenant is not None and (is_query or is_write)
+                and tenant.limits.max_query_time.seconds > 0):
+            # operator-side per-tenant deadline cap: a no-SLO class
+            # cannot hold server time past its envelope, whatever the
+            # client asked for
+            tcap = tenant.limits.max_query_time.seconds
+            timeout_s = tcap if timeout_s is None else min(timeout_s,
+                                                           tcap)
         deadline = (Deadline.after(timeout_s, reason=path)
                     if timeout_s is not None else None)
+        fair = state.fair_admission if tenant is not None else None
+        # fast-fail: a request that arrives already out of time is
+        # answered 504 here, before it can consume an admission slot
+        # or queue capacity
+        if ((is_query or is_write) and deadline is not None
+                and deadline.remaining() <= 0.0):
+            return _timeout_504(timeout_s, tenant)
         admitted = False
         try:
-            if cfg.enabled and path in _QUERY_ENDPOINTS:
+            if cfg.enabled and is_query:
                 wait_s = cfg.queue_timeout.seconds
                 if deadline is not None:
                     wait_s = deadline.budget(wait_s)
                 with span("admission_wait",
-                          queued=state.admission.queued):
-                    outcome = await state.admission.acquire(wait_s)
+                          queued=(fair.queued(tenant)
+                                  if fair is not None
+                                  else state.admission.queued)):
+                    if fair is not None:
+                        outcome = await fair.acquire(tenant, wait_s)
+                    else:
+                        outcome = await state.admission.acquire(wait_s)
+                if (outcome == "ok" and deadline is not None
+                        and deadline.expired):
+                    # the grant raced the expiry: give the slot back —
+                    # a dead request must not occupy it
+                    if fair is not None:
+                        fair.release(tenant)
+                    else:
+                        state.admission.release()
+                    return _timeout_504(timeout_s, tenant)
                 if outcome == "shed":
-                    _SHED.inc()
+                    _labeled(_SHED, tenant).inc()
+                    retry = (fair.retry_after_s(tenant)
+                             if fair is not None
+                             else state.admission.retry_after_s())
+                    scope = (f" for tenant {tenant.name!r}"
+                             if tenant is not None else "")
                     return web.json_response(
-                        {"error": "overloaded: admission queue full"},
-                        status=429, headers={"Retry-After": retry_after})
+                        {"error": "overloaded: admission queue full"
+                                  + scope},
+                        status=429, headers={"Retry-After": retry})
                 if outcome == "timeout":
-                    _QUEUE_TIMEOUTS.inc()
+                    if deadline is not None and deadline.expired:
+                        # expired while queued: the request is dead —
+                        # 504, and it never held a slot
+                        return _timeout_504(timeout_s, tenant)
+                    _labeled(_QUEUE_TIMEOUTS, tenant).inc()
+                    retry = (fair.retry_after_s(tenant)
+                             if fair is not None
+                             else state.admission.retry_after_s())
                     return web.json_response(
                         {"error": "overloaded: timed out waiting for a "
                                   "query slot"},
-                        status=503, headers={"Retry-After": retry_after})
+                        status=503, headers={"Retry-After": retry})
                 admitted = True
             with deadline_scope(deadline):
-                if deadline is None:
-                    return await handler(request)
                 try:
-                    if path in _WRITE_ENDPOINTS:
+                    if deadline is None or is_write:
                         # writes are deadline-SCOPED (each outgoing RPC
                         # budgets against it) but never hard-cancelled:
                         # aborting a multi-region commit mid-flight
@@ -343,18 +715,35 @@ def _resilience_middleware(state: ServerState):
                     # never checkpoints cannot overrun its deadline
                     return await asyncio.wait_for(handler(request),
                                                   deadline.remaining())
+                except QuotaExceeded as exc:
+                    # a per-tenant resource budget fired (scan bytes at
+                    # a checkpoint, WAL rate ahead of group commit):
+                    # 429 scoped to the tenant, Retry-After from the
+                    # bucket's actual deficit
+                    return _quota_429(exc, tenant)
                 except (asyncio.TimeoutError, DeadlineExceeded):
+                    if deadline is None:
+                        raise  # not ours: no deadline was bound
                     deadline.cancel()
-                    _DEADLINE_504.inc()
-                    return web.json_response(
-                        {"error": f"deadline exceeded "
-                                  f"({timeout_s:.3f}s budget)"},
-                        status=504)
+                    return _timeout_504(timeout_s, tenant)
         finally:
             if admitted:
-                state.admission.release()
+                if fair is not None:
+                    fair.release(tenant)
+                else:
+                    state.admission.release()
 
     return middleware
+
+
+def _tenant_stats(state: ServerState) -> dict:
+    """Per-tenant isolation state: quotas, server-side latency
+    quantiles, and live admission occupancy — the shared body of the
+    /stats `tenants` section and GET /admin/tenants."""
+    tstats = state.tenants.stats()
+    for name, occ in state.fair_admission.occupancy().items():
+        tstats.setdefault(name, {}).update(occ)
+    return tstats
 
 
 def build_app(state: ServerState) -> web.Application:
@@ -368,7 +757,9 @@ def build_app(state: ServerState) -> web.Application:
         503, never 400."""
         from horaedb_tpu.objstore.middleware import DeadlineExceededError
 
-        if isinstance(e, DeadlineExceeded):
+        if isinstance(e, (DeadlineExceeded, QuotaExceeded)):
+            # the resilience middleware owns these mappings (504 and
+            # the tenant-scoped quota 429 respectively)
             raise e
         if isinstance(e, DeadlineExceededError):
             return web.json_response({"error": str(e)}, status=503)
@@ -539,6 +930,8 @@ def build_app(state: ServerState) -> web.Application:
         # loops — degraded maintenance surfaces BEFORE query latency)
         out = await state.engine.stats()
         out["loops"] = loops.summary()
+        if state.tenants is not None:
+            out["tenants"] = _tenant_stats(state)
         return web.json_response(out)
 
     @routes.post("/admin/flush")
@@ -601,6 +994,74 @@ def build_app(state: ServerState) -> web.Application:
         out = await rollups.stats()
         if rolled is not None:
             out["rolled_segments"] = rolled
+        return web.json_response(out)
+
+    @routes.get("/admin/tenants")
+    async def admin_tenants_status(_req: web.Request) -> web.Response:
+        """Per-tenant isolation state: configured limits, quota bucket
+        levels, server-side latency quantiles, admission occupancy."""
+        if state.tenants is None:
+            return web.json_response(
+                {"error": "tenants are not enabled on this server "
+                          "([tenants] enabled = true)"}, status=501)
+        return web.json_response({"enabled": True,
+                                  "tenants": _tenant_stats(state)})
+
+    @routes.post("/admin/tenants")
+    async def admin_tenants(req: web.Request) -> web.Response:
+        """Reload the [tenants] table at runtime: the body is a
+        [tenants]-shaped JSON object (default/tenant/auto knobs).
+        Limits re-point live (queued waiters keep their place); bucket
+        levels reset (a reload is a policy change, not an accounting
+        continuation); tenants REMOVED from the config have their
+        metric children deregistered so /metrics stops serving them.
+        Toggling `enabled` requires a restart — the middleware chain
+        is fixed at startup."""
+        if state.tenants is None:
+            return web.json_response(
+                {"error": "tenants are not enabled on this server "
+                          "([tenants] enabled = true)"}, status=501)
+        try:
+            body = await req.json()
+            if not isinstance(body, dict):
+                raise Error("body must be a JSON object")
+            body.setdefault("enabled", True)
+            new_cfg = tenants_from_dict(body)
+            ensure(new_cfg.enabled,
+                   "cannot disable [tenants] at runtime; restart with "
+                   "enabled = false")
+        except (TypeError, ValueError, Error) as e:
+            return web.json_response({"error": f"bad request: {e}"},
+                                     status=400)
+        removed = state.tenants.configure(new_cfg)
+        tstats = state.tenants.stats()
+        return web.json_response({"removed": removed, "tenants": tstats})
+
+    @routes.post("/admin/rebalance")
+    async def admin_rebalance(req: web.Request) -> web.Response:
+        """Hot-shard recommendation hook: the cluster's health monitor
+        keeps a split/rebalance proposal from its per-region load
+        survey (cluster.py, surfaced on /debug/tasks too); this
+        endpoint recomputes it on demand.  ?skew_ratio= overrides the
+        flag threshold for this call.  The operator (or an external
+        controller) executes the moves — this node cannot know its
+        peers' capacities."""
+        survey = getattr(state.engine, "survey_load", None)
+        if survey is None:
+            return web.json_response(
+                {"error": "rebalance is a cluster-tier operation; this "
+                          "server fronts a single engine"}, status=501)
+        skew = None
+        raw = req.query.get("skew_ratio")
+        if raw is not None:
+            try:
+                skew = float(raw)
+                ensure(skew > 1.0, "skew_ratio must be > 1")
+            except (ValueError, Error):
+                return web.json_response(
+                    {"error": f"bad skew_ratio: {raw!r}"}, status=400)
+        out = await (survey(skew_ratio=skew) if skew is not None
+                     else survey())
         return web.json_response(out)
 
     @routes.post("/write")
@@ -860,10 +1321,13 @@ def build_app(state: ServerState) -> web.Application:
         return web.json_response({"values": vals})
 
     # sized for the Arrow-IPC bulk data plane (default 1 MiB would 413
-    # any real ingest batch); tracing is OUTERMOST so the trace covers
-    # the admission wait and the 504 mapping
+    # any real ingest batch); the tenant middleware is outermost (the
+    # identity must be ambient before the trace roots and the
+    # admission decision), then tracing so the trace covers the
+    # admission wait and the 504 mapping
     app = web.Application(client_max_size=256 * 1024 * 1024,
-                          middlewares=[_tracing_middleware(state),
+                          middlewares=[_tenant_middleware(state),
+                                       _tracing_middleware(state),
                                        _resilience_middleware(state)])
     app.add_routes(routes)
     return app

@@ -41,6 +41,7 @@ import jax.numpy as jnp
 
 from horaedb_tpu.common.deadline import checkpoint as deadline_checkpoint
 from horaedb_tpu.common.error import Error, ensure
+from horaedb_tpu.common.tenant import charge_scan_bytes
 from horaedb_tpu.objstore import NotFoundError, ObjectStore
 from horaedb_tpu.ops import downsample as downsample_ops
 from horaedb_tpu.ops import encode, filter as filter_ops, merge as merge_ops
@@ -1007,6 +1008,9 @@ class ParquetReader:
         trace_add(f"stage_{stage}_ms", read_s * 1e3)
         trace_add(f"stage_{stage}_rows", table.num_rows)
         trace_add(f"stage_{stage}_bytes", table.nbytes)
+        # tenant scan-byte budget: charged where the stage bytes are
+        # attributed, observed at the deadline checkpoints
+        charge_scan_bytes(table.nbytes)
         return table, read_s
 
     def _sidecar_plan_ok(self, plan: ScanPlan) -> bool:
@@ -1077,6 +1081,7 @@ class ParquetReader:
         trace_add("stage_sidecar_read_ms", read_s * 1e3)
         trace_add("stage_sidecar_read_rows", es.n)
         trace_add("stage_sidecar_read_bytes", es.nbytes)
+        charge_scan_bytes(es.nbytes)
         return es
 
     async def _read_segment_encoded(self, seg: SegmentPlan, plan: ScanPlan,
@@ -1240,6 +1245,7 @@ class ParquetReader:
             _STAGE_BYTES["sidecar_read"].inc(nbytes)
             trace_add("stage_sidecar_read_rows", rows)
             trace_add("stage_sidecar_read_bytes", nbytes)
+            charge_scan_bytes(nbytes)
 
         return gen()
 

@@ -79,6 +79,20 @@ class _Family:
             return [] if not self._children else list(
                 self._children.values())
 
+    def remove(self, **kv) -> bool:
+        """Deregister one labeled child so it stops rendering — the
+        reload discipline for label values that name config-scoped
+        entities (a tenant removed from [tenants] must not serve
+        phantom series on /metrics forever).  Returns whether a child
+        was actually removed."""
+        if not kv:
+            return False
+        key = tuple(sorted(kv.items()))
+        with self._lock:
+            if not self._children:
+                return False
+            return self._children.pop(key, None) is not None
+
     def _render_base(self) -> bool:
         """Whether the label-less series line should be emitted: always
         for a never-labeled metric (back-compat), only-if-touched once
@@ -316,6 +330,14 @@ class MetricsRegistry:
                 self._metrics[name] = m
             assert isinstance(m, Histogram)
             return m
+
+    def family(self, name: str):
+        """The registered family for `name`, or None — the typed
+        factories (counter/gauge/histogram) create; this only looks
+        up (label-child removal at config reload must not mint a
+        family of the wrong type as a side effect)."""
+        with self._lock:
+            return self._metrics.get(name)
 
     def render(self) -> str:
         # snapshot the metric list under the registry lock, render

@@ -39,6 +39,7 @@ import logging
 
 from horaedb_tpu.common.error import ensure
 from horaedb_tpu.common.loops import loops
+from horaedb_tpu.common.tenant import current_tenant
 from horaedb_tpu.storage.config import UpdateMode
 from horaedb_tpu.storage.read import (
     ScanPlan,
@@ -202,6 +203,13 @@ class IngestStorage(TimeMergeStorage):
 
     async def write(self, req: WriteRequest) -> WriteResult:
         self.inner.validate_write(req)
+        # per-tenant ingest-rate gate, AHEAD of the group commit: a
+        # flooding tenant is rejected (QuotaExceeded -> 429) before its
+        # batch costs a WAL frame, an fsync share, or a seq — the
+        # write path's quota lives at the layer that owns the rate
+        tenant = current_tenant()
+        if tenant is not None:
+            tenant.admit_wal(req.batch.nbytes)
         t0 = time.perf_counter()
         seq = SstFile.allocate_id()
         # the span covers frame + enqueue + the group-commit fsync wait
@@ -282,16 +290,34 @@ class IngestStorage(TimeMergeStorage):
                     and not rng.overlaps(time_range):
                 continue
             flushed += await self._flush_segment(seg)
-        if any(self._flushing.values()):
+        if self._flushing_overlaps(time_range):
             # barrier: a background flush already in flight popped its
             # memtable before we looked — its SST + manifest commit
             # must land before callers replan from the manifest, or an
             # aggregate would silently omit acked rows.  _flush_segment
             # holds _flush_lock for its whole duration, so acquiring it
-            # once waits the in-flight flush out.
+            # once waits the in-flight flush out.  Only OVERLAPPING
+            # in-flight flushes matter: waiting on a disjoint segment's
+            # flush would couple tenants through the flush lock (a
+            # dashboard aggregate stalling behind another tenant's
+            # bulk-ingest flush; docs/robustness.md, tenant isolation).
             async with self._flush_lock:
                 pass
         return flushed
+
+    def _flushing_overlaps(self, time_range) -> bool:
+        """Whether any in-flight flush holds rows overlapping
+        `time_range` (None = any).  A drained memtable keeps its
+        entries until the SST commit lands (scan visibility), so its
+        time_range stays answerable; None ranges are treated as
+        overlapping — correctness over precision."""
+        for mts in self._flushing.values():
+            for mt in mts:
+                rng = mt.time_range
+                if (time_range is None or rng is None
+                        or rng.overlaps(time_range)):
+                    return True
+        return False
 
     async def _flush_segment(self, seg: int) -> int:
         """Drain one memtable to one SST.  Ordering is the crash-safety

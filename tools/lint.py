@@ -461,6 +461,9 @@ def lint_file(path: pathlib.Path) -> list[str]:
                     "(docs/observability.md)")
     if "wal" in path.parts and "horaedb_tpu" in path.parts:
         problems.extend(_lint_wal_module(path, tree, lines))
+    if ("horaedb_tpu" in path.parts and "server" in path.parts
+            and path.name == "main.py"):
+        problems.extend(_lint_server_routes(path, tree, lines))
     return problems
 
 
@@ -507,6 +510,83 @@ def _lint_wal_module(path: pathlib.Path, tree: ast.AST,
             f"{path}:{write_calls[0]}: file write in wal/ with no "
             "os.fsync anywhere in the module — an unfsynced WAL write "
             "must never be an ack point")
+    return problems
+
+
+# every HTTP route in server/main.py must be declared in one of these
+# endpoint sets: the admission+tenant middleware chain dispatches on
+# them, so a handler registered outside them silently bypasses
+# isolation (no tenant scope, no admission, no deadline default) —
+# exactly the hole a "quick internal endpoint" opens under overload
+_ENDPOINT_SETS = ("_QUERY_ENDPOINTS", "_WRITE_ENDPOINTS",
+                  "_UNGOVERNED_ENDPOINTS")
+_ROUTE_VERBS = {"get", "post", "put", "delete", "head", "patch", "route"}
+
+
+def _frozenset_literal(node: ast.AST) -> Optional[set]:
+    """The string members of a `frozenset({...})` / `frozenset([...])`
+    assignment value, or None when it isn't one."""
+    if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+            and node.func.id == "frozenset" and node.args):
+        return None
+    arg = node.args[0]
+    if not isinstance(arg, (ast.Set, ast.List, ast.Tuple)):
+        return None
+    out = set()
+    for e in arg.elts:
+        if not (isinstance(e, ast.Constant) and isinstance(e.value, str)):
+            return None
+        out.add(e.value)
+    return out
+
+
+def _lint_server_routes(path: pathlib.Path, tree: ast.AST,
+                        lines: list[str]) -> list[str]:
+    """Middleware-chain coverage for the HTTP server: collect the
+    module's endpoint frozensets and every `@routes.<verb>("<path>")`
+    decorator; a registered path missing from all three sets is an
+    error (docs/robustness.md, tenant isolation failure domains)."""
+    problems: list[str] = []
+    declared: set = set()
+    found_sets = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id in _ENDPOINT_SETS:
+                    members = _frozenset_literal(node.value)
+                    if members is not None:
+                        declared |= members
+                        found_sets.add(t.id)
+    missing_sets = set(_ENDPOINT_SETS) - found_sets
+    if missing_sets:
+        problems.append(
+            f"{path}:1: endpoint set(s) {sorted(missing_sets)} missing "
+            "or not frozenset literals — the admission+tenant "
+            "middleware chain dispatches on them")
+        return problems
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for dec in node.decorator_list:
+            if not (isinstance(dec, ast.Call)
+                    and isinstance(dec.func, ast.Attribute)
+                    and dec.func.attr in _ROUTE_VERBS
+                    and isinstance(dec.func.value, ast.Name)
+                    and dec.func.value.id == "routes"
+                    and dec.args
+                    and isinstance(dec.args[0], ast.Constant)
+                    and isinstance(dec.args[0].value, str)):
+                continue
+            route = dec.args[0].value
+            src = (lines[dec.lineno - 1]
+                   if dec.lineno <= len(lines) else "")
+            if route not in declared and "noqa" not in src:
+                problems.append(
+                    f"{path}:{dec.lineno}: route {route!r} registered "
+                    "outside the admission+tenant middleware chain — "
+                    "add it to _QUERY_ENDPOINTS / _WRITE_ENDPOINTS "
+                    "(governed) or _UNGOVERNED_ENDPOINTS (explicitly "
+                    "exempt ops/admin surface)")
     return problems
 
 
