@@ -30,6 +30,9 @@ COMBINE_SCHEDULES ?= 25
 TENANT_SEED ?= 1337
 TENANT_SCHEDULES ?= 20
 
+DECODE_SEED ?= 1337
+DECODE_SCHEDULES ?= 20
+
 chaos:
 	TORTURE_SEED=$(TORTURE_SEED) TORTURE_SCHEDULES=$(TORTURE_SCHEDULES) \
 	WAL_TORTURE_SEED=$(WAL_TORTURE_SEED) \
@@ -44,11 +47,13 @@ chaos:
 	COMBINE_SCHEDULES=$(COMBINE_SCHEDULES) \
 	TENANT_SEED=$(TENANT_SEED) \
 	TENANT_SCHEDULES=$(TENANT_SCHEDULES) \
+	DECODE_SEED=$(DECODE_SEED) \
+	DECODE_SCHEDULES=$(DECODE_SCHEDULES) \
 	python -m pytest tests/test_fault_injection.py tests/test_torture.py \
 	tests/test_objstore_middleware.py tests/test_wal.py \
 	tests/test_scan_cache.py tests/test_rollup.py \
 	tests/test_pipeline.py tests/test_combine.py \
-	tests/test_tenant.py -q
+	tests/test_tenant.py tests/test_device_decode.py -q
 
 # stdlib AST lint gate (the reference CI runs fmt+clippy -D warnings;
 # this image ships no ruff/flake8, so the gate is tools/lint.py)

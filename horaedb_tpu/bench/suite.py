@@ -2645,10 +2645,357 @@ def run_config15(rows: int, iters: int) -> dict:
     }
 
 
+def run_config16(rows: int, iters: int) -> dict:
+    """Device-native decode A/B (ISSUE 12): the config-13 cold-scan
+    workload and seeded 25 ms-latency fault store, measured with
+    `[scan.decode] mode = "device"` against TWO host-decode controls —
+    everything else identical:
+
+      host      the CPU-default control (numpy f64 window partials):
+                what a CPU deployment actually runs today;
+      xla_host  the accelerator-SHAPED control (host decode feeding
+                the same XLA window kernel the fused dispatch calls,
+                HORAEDB_HOST_AGG=0): kernel cost held equal, so the
+                delta isolates WHERE decode/merge/filter ran — the
+                comparison that transfers to accelerator backends;
+      device    the fused dispatch ([scan.decode] mode="device").
+
+    Legs per control: cached (sanity — decode never touches it),
+    tier2_cold (scan cache + parts memo evicted, tier-2 encoded parts
+    warm: pure decode cost, zero store I/O), true_cold (all tiers
+    cleared, pipelined), plus device-leg pipeline-off twins that
+    re-grade the parked config-13 2.5x cold-overlap bar and the r6
+    10M-rung pipeline-overhead caveat with host decode off the
+    critical path.
+
+    Each pipelined leg diffs plan_stage_snapshot for per-stage seconds
+    + STALL counts (PR 8's 137:1 device-starved-on-decode profile is
+    the number under attack — note the stall COUNTS saturate at one
+    per segment once the consumer has nothing left to compute, so the
+    starvation evidence is the device-stage occupancy collapse and
+    the per-stage seconds, recorded alongside the raw counts) and
+    records encoded-bytes-uploaded (stage device_decode) vs
+    host-decoded window bytes.  An in-bench byte-identity assert runs
+    device vs host under HORAEDB_HOST_AGG=0 on one cold query (the
+    chaos suite's comparability convention).  The device leg's
+    fallback-counter deltas are recorded (decode_fallbacks) — a
+    silently ineligible leg would otherwise time the host path twice
+    and read as a no-op win."""
+    import os
+
+    import pyarrow as pa
+
+    from horaedb_tpu.metric_engine import MetricEngine
+    from horaedb_tpu.objstore import (
+        FaultInjectingStore,
+        MemoryObjectStore,
+        WrappedObjectStore,
+    )
+    from horaedb_tpu.storage.config import StorageConfig, from_dict
+    from horaedb_tpu.storage.read import plan_stage_snapshot
+    from horaedb_tpu.storage.types import TimeRange
+    from horaedb_tpu.utils import registry
+
+    class DataGetCounter(WrappedObjectStore):
+        def __init__(self, inner):
+            super().__init__(inner)
+            self.data_gets = 0
+
+        async def _call(self, op: str, *args):
+            if op in ("get", "get_range") and str(args[0]).endswith(
+                    (".sst", ".enc")):
+                self.data_gets += 1
+            return await super()._call(op, *args)
+
+    lat_s = float(os.environ.get("BENCH_STORE_LATENCY_MS", "25")) / 1e3
+    hosts = 100
+    interval = 10_000
+    bucket_ms = 60_000
+    per_host = max(60, rows // hosts)
+    span = per_host * interval
+    segment_ms = 2 * 3600 * 1000
+    T0 = (1_700_000_000_000 // segment_ms) * segment_ms
+    rng = np.random.default_rng(16)
+    n = per_host * hosts
+    ts = T0 + np.repeat(
+        np.arange(per_host, dtype=np.int64) * interval, hosts)
+    host_id = np.tile(np.arange(hosts, dtype=np.int32), per_host)
+    vals = (rng.random(n) * 100).astype(np.float64)
+    names = pa.array([f"host_{i:03d}" for i in range(hosts)])
+    _check_i32_span(np.asarray([span]), "config16")
+    k_cold = max(3, iters // 3)
+
+    def cfg_of(mode: str, pipelined: bool = True):
+        return from_dict(StorageConfig, {
+            "scheduler": {"schedule_interval": "1h"},
+            "scan": {"cache_max_rows": n * 4,
+                     "cache": {"tier2_max_bytes": 1 << 30},
+                     "pipeline": {"enabled": pipelined},
+                     "decode": {"mode": mode}},
+        })
+
+    async def ingest(e):
+        chunk = max(1, 1_000_000 // hosts) * hosts
+        for lo in range(0, n, chunk):
+            hi = min(n, lo + chunk)
+            await e.write_arrow("cpu", ["host"], pa.record_batch({
+                "host": pa.DictionaryArray.from_arrays(
+                    pa.array(host_id[lo:hi]), names),
+                "timestamp": pa.array(ts[lo:hi], type=pa.int64()),
+                "value": pa.array(vals[lo:hi], type=pa.float64()),
+            }))
+
+    async def query(e):
+        return await e.query_downsample(
+            "cpu", [], TimeRange.new(T0, T0 + span),
+            bucket_ms=bucket_ms, aggs=("avg",))
+
+    def fallbacks() -> dict:
+        fam = registry.family("scan_decode_fallback_total")
+        return ({} if fam is None else
+                {c._labels[0][1]: int(c.value)
+                 for c in fam._snapshot_children()})
+
+    async def timed(e, reps: int, reset=None, profile: bool = False):
+        times, prof = [], {}
+        for i in range(reps):
+            if reset is not None:
+                reset()
+            before = plan_stage_snapshot() if profile and i == 0 else None
+            t0 = time.perf_counter()
+            await query(e)
+            times.append(time.perf_counter() - t0)
+            if before is not None:
+                after = plan_stage_snapshot()
+                prof = {kk: round(after[kk] - before[kk], 4)
+                        for kk in after if after[kk] != before[kk]}
+        return float(np.percentile(times, 50)), prof
+
+    def stall_ratio(prof: dict) -> float:
+        # device-starved-on-decode: consumer stalls per decode-stage
+        # stall (PR 8 measured 137:1 with host decode)
+        return round(prof.get("pipeline_stalls_device", 0)
+                     / max(1, prof.get("pipeline_stalls_decode", 0)), 2)
+
+    async def go():
+        out = {"store_latency_ms": lat_s * 1e3}
+        raw = MemoryObjectStore()
+        store = DataGetCounter(FaultInjectingStore(
+            raw, seed=16, latency_range=(lat_s, lat_s)))
+        e = await MetricEngine.open("cfg16", store,
+                                    segment_ms=segment_ms,
+                                    config=cfg_of("host"))
+        try:
+            await ingest(e)
+        finally:
+            await e.close()
+
+        gets_mark = store.data_gets
+
+        def leg_gets() -> int:
+            nonlocal gets_mark
+            prev, gets_mark = gets_mark, store.data_gets
+            return gets_mark - prev
+
+        # byte-identity gate before any timing: one cold query per
+        # mode under HORAEDB_HOST_AGG=0 (both paths then share the XLA
+        # window kernel; chaos-suite comparability convention)
+        os.environ["HORAEDB_HOST_AGG"] = "0"
+        try:
+            grids = {}
+            for mode in ("device", "host"):
+                e = await MetricEngine.open("cfg16", store,
+                                            segment_ms=segment_ms,
+                                            config=cfg_of(mode))
+                try:
+                    _clear_scan_tiers(e.tables["data"])
+                    grids[mode] = await query(e)
+                finally:
+                    await e.close()
+            dv, hv = grids["device"], grids["host"]
+            assert np.array_equal(dv["tsids"], hv["tsids"]), \
+                "tsid sets differ"
+            for kk in dv["aggs"]:
+                assert np.asarray(dv["aggs"][kk]).tobytes() == \
+                    np.asarray(hv["aggs"][kk]).tobytes(), \
+                    f"grid {kk} differs"
+            out["bit_identity"] = "byte-equal (HORAEDB_HOST_AGG=0)"
+        finally:
+            os.environ.pop("HORAEDB_HOST_AGG", None)
+
+        # three legs: the true CPU-default control (numpy f64 window
+        # partials — what a CPU deployment actually runs), the
+        # accelerator-shaped control (host decode + the same XLA
+        # window kernel the fused dispatch calls, HORAEDB_HOST_AGG=0 —
+        # isolates WHERE decode ran with kernel cost held equal), and
+        # the device-decode leg (kernel-agnostic: it never enters the
+        # window-aggregate path)
+        for mode, leg, host_agg in (("host", "host", None),
+                                    ("host", "xla_host", "0"),
+                                    ("device", "device", None)):
+            fb0 = fallbacks()
+            if host_agg is not None:
+                os.environ["HORAEDB_HOST_AGG"] = host_agg
+            e = await MetricEngine.open("cfg16", store,
+                                        segment_ms=segment_ms,
+                                        config=cfg_of(mode))
+            try:
+                table = e.tables["data"]
+                await query(e)  # compile + warm both tiers
+                leg_gets()
+                cached, _ = await timed(e, iters)
+                out[f"{leg}_cached_p50_ms"] = round(cached * 1e3, 3)
+
+                def tier2_reset(t=table):
+                    # drop HBM windows AND the parts memo but KEEP the
+                    # tier-2 encoded parts: the leg must measure pure
+                    # decode (zero store I/O), not the memo tier
+                    t.reader.scan_cache.clear()
+                    t.reader.parts_memo.clear()
+
+                tier2, prof2 = await timed(e, k_cold, reset=tier2_reset,
+                                           profile=True)
+                out[f"{leg}_tier2_cold_p50_ms"] = round(tier2 * 1e3, 3)
+                out[f"{leg}_stage_profile_tier2"] = prof2
+                cold, prof0 = await timed(
+                    e, k_cold,
+                    reset=lambda t=table: _clear_scan_tiers(t),
+                    profile=True)
+                out[f"{leg}_true_cold_p50_ms"] = round(cold * 1e3, 3)
+                out[f"{leg}_data_gets_true_cold"] = leg_gets()
+                out[f"{leg}_stage_profile_true_cold"] = prof0
+                out[f"{leg}_stall_ratio_true_cold"] = stall_ratio(prof0)
+                # GIL-bound host decode on the critical path: the
+                # seconds spent in per-row host work (merge + window
+                # planning + group prep inside encode_merge).  THE
+                # number the fused dispatch exists to remove — its
+                # own stage is pad + upload + XLA, no per-row Python
+                out[f"{leg}_host_decode_s_per_cold_query"] = round(
+                    prof0.get("encode_merge_s", 0.0), 4)
+            finally:
+                await e.close()
+                if host_agg is not None:
+                    os.environ.pop("HORAEDB_HOST_AGG", None)
+            if leg == "device":
+                fb1 = fallbacks()
+                out["decode_fallbacks"] = {
+                    k: v - fb0.get(k, 0) for k, v in fb1.items()
+                    if v != fb0.get(k, 0)}
+
+        # pipeline-off device legs: re-grade the parked config-13 2.5x
+        # cold-overlap bar and the r6 10M-rung pipeline-overhead caveat
+        # with host decode off the critical path
+        e = await MetricEngine.open("cfg16", store,
+                                    segment_ms=segment_ms,
+                                    config=cfg_of("device",
+                                                  pipelined=False))
+        try:
+            table = e.tables["data"]
+            await query(e)
+
+            def tier2_reset_off(t=table):
+                t.reader.scan_cache.clear()
+                t.reader.parts_memo.clear()
+
+            tier2_off, _ = await timed(e, k_cold, reset=tier2_reset_off)
+            out["device_tier2_cold_pipeline_off_p50_ms"] = round(
+                tier2_off * 1e3, 3)
+            cold_off, _ = await timed(
+                e, k_cold, reset=lambda t=table: _clear_scan_tiers(t))
+            out["device_true_cold_pipeline_off_p50_ms"] = round(
+                cold_off * 1e3, 3)
+        finally:
+            await e.close()
+
+        # zero-latency-store legs (same objects, the raw memory store
+        # underneath the fault wrapper): the r6 10M-rung caveat was
+        # [scan.pipeline] overhead measured with NOTHING to hide —
+        # re-grade it with host decode on vs off the critical path
+        for mode in ("host", "device"):
+            for pipelined in (True, False):
+                e = await MetricEngine.open(
+                    "cfg16", raw, segment_ms=segment_ms,
+                    config=cfg_of(mode, pipelined=pipelined))
+                try:
+                    table = e.tables["data"]
+                    await query(e)
+                    cold0, _ = await timed(
+                        e, k_cold,
+                        reset=lambda t=table: _clear_scan_tiers(t))
+                    key = (f"{mode}_true_cold_zero_latency"
+                           f"{'' if pipelined else '_pipeline_off'}"
+                           "_p50_ms")
+                    out[key] = round(cold0 * 1e3, 3)
+                finally:
+                    await e.close()
+        return out
+
+    out = asyncio.run(go())
+    dev_cold = out["device_true_cold_p50_ms"]
+    host_cold = out["host_true_cold_p50_ms"]
+    xla_cold = out["xla_host_true_cold_p50_ms"]
+    out["decode_speedup_true_cold_vs_cpu_default"] = round(
+        host_cold / dev_cold, 2)
+    out["decode_speedup_true_cold_vs_xla_control"] = round(
+        xla_cold / dev_cold, 2)
+    out["decode_speedup_tier2_vs_xla_control"] = round(
+        out["xla_host_tier2_cold_p50_ms"]
+        / out["device_tier2_cold_p50_ms"], 2)
+    out["regrade_pipeline_speedup_device"] = round(
+        out["device_true_cold_pipeline_off_p50_ms"] / dev_cold, 2)
+    out["regrade_tier2_pipeline_overhead_device"] = round(
+        out["device_tier2_cold_p50_ms"]
+        / out["device_tier2_cold_pipeline_off_p50_ms"], 2)
+    # the r6 10M-rung caveat re-grade: pipeline overhead over a
+    # zero-latency store (>1.0 = the pipeline costs wall with nothing
+    # to hide), host decode vs device decode on the critical path
+    out["regrade_r6_zero_latency_pipeline_overhead_host"] = round(
+        out["host_true_cold_zero_latency_p50_ms"]
+        / out["host_true_cold_zero_latency_pipeline_off_p50_ms"], 2)
+    out["regrade_r6_zero_latency_pipeline_overhead_device"] = round(
+        out["device_true_cold_zero_latency_p50_ms"]
+        / out["device_true_cold_zero_latency_pipeline_off_p50_ms"], 2)
+    prof_d = out["device_stage_profile_true_cold"]
+    prof_h = out["host_stage_profile_true_cold"]
+    out["encoded_bytes_uploaded_per_cold_query"] = int(
+        prof_d.get("device_decode_bytes", 0))
+    out["host_decoded_window_bytes_per_cold_query"] = int(
+        prof_h.get("pipeline_decode_bytes", 0))
+    out["host_decode_removed"] = (
+        f"{out['host_host_decode_s_per_cold_query']}s GIL-bound "
+        f"encode/merge per cold query on the host legs -> "
+        f"{out['device_host_decode_s_per_cold_query']}s on the device "
+        f"leg (pad+upload only)")
+    _log(f"config16: true-cold device {dev_cold:.1f} ms vs cpu-default "
+         f"host {host_cold:.1f} ms "
+         f"({out['decode_speedup_true_cold_vs_cpu_default']}x) vs "
+         f"xla-control {xla_cold:.1f} ms "
+         f"({out['decode_speedup_true_cold_vs_xla_control']}x) | "
+         f"stall ratio device {out['device_stall_ratio_true_cold']} vs "
+         f"host {out['host_stall_ratio_true_cold']} vs xla "
+         f"{out['xla_host_stall_ratio_true_cold']} | pipeline re-grade "
+         f"{out['regrade_pipeline_speedup_device']}x")
+    return {
+        "metric": (f"device-native decode: true-cold downsample p50 "
+                   f"over a seeded {out['store_latency_ms']:.0f}ms"
+                   f"-latency store, {n / 1e6:.1f}M rows, device vs "
+                   f"host decode"),
+        "value": out["device_true_cold_p50_ms"],
+        "unit": "ms",
+        # done-bar: decode-starvation reduced vs the accelerator-shaped
+        # control (the CPU-default control's numpy twin is faster than
+        # XLA-CPU kernels — the documented backend trade; see notes)
+        "vs_baseline": out["decode_speedup_true_cold_vs_xla_control"],
+        "rows": n,
+        **out,
+    }
+
+
 RUNNERS = {2: run_config2, 3: run_config3, 4: run_config4, 5: run_config5,
            6: run_config6, 7: run_config7, 8: run_config8, 9: run_config9,
            10: run_config10, 11: run_config11, 12: run_config12,
-           13: run_config13, 14: run_config14, 15: run_config15}
+           13: run_config13, 14: run_config14, 15: run_config15,
+           16: run_config16}
 
 
 def main() -> None:

@@ -1,0 +1,627 @@
+"""Device-native decode: fuse sidecar decode + filter + bucket-aggregate
+into ONE device dispatch (ROADMAP item 2).
+
+The cold aggregate scan's measured wall is HOST work: the pipeline's
+stall profile shows the device stage starved 137:1 on decode, and the
+r6 ladder's 200M cold wall is GIL-bound Python/numpy encode/merge the
+pipeline can only *overlap*, never shrink.  The sidecar already stores
+columns in a device-shaped layout (int32 dict codes, int32 epoch
+offsets, raw float32 — storage/sidecar.py), yet the host still k-way
+-merges, windows, uniques and stacks them before the device ever runs.
+
+This module moves that whole chain onto the accelerator.  For an
+eligible aggregate plan, an `EncodedSegment`'s encoded buffers upload
+RAW (pad + device_put — memcpy-shaped host work) and one jitted
+program does:
+
+  leaf filter   — the plan's pushed PK-leaf conjunction evaluated in
+                  ENCODED space (constants pre-translated host-side via
+                  the same ops.filter helpers the host mask uses);
+  merge-dedup   — lax.sort by (valid, pk codes..., seq, row) and a
+                  keep-last-of-PK-run mask: the device twin of the host
+                  k-way merge + `_host_dedup_keep`, with dropped rows
+                  MASKED (gid = -1), never compacted, so shapes stay
+                  static.  The row-index tiebreak reproduces the host
+                  merge's stable ordering bit-for-bit, which is what
+                  keeps f32 per-cell accumulation order — and therefore
+                  the grids' bytes — identical to the host path;
+  aggregate     — ops.downsample.window_local_partials over the sorted,
+                  masked rows: the SAME partial-grid kernel the host
+                  window path vmaps, so the emitted part has the exact
+                  conventions storage/combine.py folds.
+
+The output is one per-segment part `(group_values, bucket_lo, grids)`
+— the shape `read._flush_window_batch` produces — so everything
+downstream (sparse/dense combine, top-k pushdown, the delta-summation
+parts memo) is untouched and the host-decode path remains the
+bit-identity control ([scan.decode] mode = "host"; the seeded chaos
+suite byte-compares the two, tests/test_device_decode.py).
+
+Ineligible plans/segments fall back to host decode with an explicit
+per-reason counter (`scan_decode_fallback_total{reason=}`) so a
+silently-ineligible plan is visible instead of quietly slow
+(docs/observability.md).  The Pallas partials kernel
+(ops/pallas_kernels.py) slots in behind the same
+HORAEDB_DOWNSAMPLE_IMPL knob, with its failure guard reporting
+"no TPU" and "kernel bug" as distinct reasons instead of a bare
+try/except.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from horaedb_tpu.ops import downsample
+from horaedb_tpu.ops import filter as filter_ops
+from horaedb_tpu.ops.filter import (
+    _const_code_exact,
+    _const_code_lower,
+    _const_code_upper,
+)
+from horaedb_tpu.utils import registry, trace_add
+
+logger = logging.getLogger(__name__)
+
+# every way a plan or segment can decline the device-decode path, so
+# operators can tell "misconfigured dashboard" from "unsupported data"
+# (docs/observability.md).  pallas_* reasons come from the kernel-impl
+# guard (see use_pallas_partials): off-TPU interpret failures and real
+# kernel bugs must not be one indistinguishable except clause.
+FALLBACK_REASONS = (
+    "mesh",            # meshed scans keep their own round scheduler
+    "append_mode",     # BytesMerge needs exact Arrow bytes
+    "no_sidecar",      # plan can't serve from sidecars at all
+    "predicate",       # predicate not a device-evaluable PK conjunction
+    "parquet",         # this segment fell back to a parquet read
+    "encoding",        # a column's encoding has no device decode
+    "dtype",           # a column's dtype isn't the device layout
+    "budget",          # segment exceeds [scan.decode] max_upload_bytes
+    "range",           # epoch-to-range shift overflows int32
+    "pallas_no_tpu",   # pallas impl failed off-TPU (interpret mode)
+    "pallas_error",    # pallas impl failed ON TPU — a real kernel bug
+)
+
+_FALLBACKS = registry.counter(
+    "scan_decode_fallback_total",
+    "aggregate segments/plans that fell back to host decode, by reason "
+    "— a silently-ineligible plan shows up here instead of being "
+    "quietly slow")
+_FALLBACK_CHILDREN = {r: _FALLBACKS.labels(reason=r)
+                      for r in FALLBACK_REASONS}
+
+
+def note_fallback(reason: str) -> None:
+    child = _FALLBACK_CHILDREN.get(reason)
+    if child is None:  # unknown reasons still count, labeled verbatim
+        child = _FALLBACKS.labels(reason=reason)
+        _FALLBACK_CHILDREN[reason] = child
+    child.inc()
+    trace_add(f"decode_fallback_{reason}", 1)
+
+
+# ---------------------------------------------------------------------------
+# leaf compilation: predicate leaves -> encoded-space ops
+# ---------------------------------------------------------------------------
+
+# opcodes are STATIC (they select the compare emitted at trace time);
+# constants are traced int32 so varied dashboards share one program
+_OP_EQ, _OP_LT, _OP_LE, _OP_GT, _OP_GE, _OP_RANGE, _OP_IN = range(7)
+_EDGE_NAMES = {_OP_LT: "lt", _OP_LE: "le", _OP_GT: "gt", _OP_GE: "ge"}
+
+# an In leaf beyond this many resolved codes would trace a (capacity x
+# k) compare — fall back to host decode instead of trading HBM for it
+_IN_MAX_CODES = 64
+
+
+class _EmptyMatch(Exception):
+    """A leaf provably matches nothing (Eq/In constant absent from the
+    dictionary): the segment contributes an empty part, no dispatch."""
+
+
+_I32_LO, _I32_HI = -(2**31), 2**31 - 1
+
+
+def _exact_i32(c) -> Optional[int]:
+    """An equality constant as int32, or None when it cannot match any
+    code (out-of-range) — the host mask's numpy compare upcasts and
+    yields all-False there; int32-casting unguarded would wrap (old
+    numpy) or raise OverflowError (numpy >= 1.24)."""
+    c = int(c)
+    return c if _I32_LO <= c <= _I32_HI else None
+
+
+def _thresh_i32(c) -> int:
+    """A comparison threshold clamped to int32.  Callers must first
+    resolve the out-of-range edges where a clamp would NOT compare
+    identically (a raw int32 column may legitimately hold I32_LO or
+    I32_HI — see _numeric_edge): after that, clamping is exact."""
+    return int(np.clip(int(c), _I32_LO, _I32_HI))
+
+
+# what an out-of-int32 numeric threshold means for each comparison —
+# the host mask compares unclamped via numpy upcast, so a below-range
+# `col > c` is a TAUTOLOGY (keep every row, incl. a raw code of
+# I32_LO) and an above-range `col >= c` matches NOTHING; a clamp alone
+# would wrongly include/exclude codes equal to the int32 extremes.
+# Values: "taut" = drop the leaf (no constraint), "empty" = the leaf
+# provably matches nothing, None = in range (clamp is exact).
+def _numeric_edge(op: int, t: int) -> Optional[str]:
+    if t < _I32_LO:
+        return {"lt": "empty", "le": "empty",
+                "gt": "taut", "ge": "taut"}[_EDGE_NAMES[op]]
+    if t > _I32_HI:
+        return {"lt": "taut", "le": "taut",
+                "gt": "empty", "ge": "empty"}[_EDGE_NAMES[op]]
+    return None
+
+
+def leaf_shape_supported(leaves) -> bool:
+    """Plan-level check: every pushed leaf is a type the device program
+    can evaluate.  Mirrors parquet_io.conjunct_leaves_ex's leaf list;
+    constants translate per segment (they need the encodings)."""
+    F = filter_ops
+    for leaf in leaves or []:
+        if not isinstance(leaf, (F.Eq, F.Lt, F.Le, F.Gt, F.Ge, F.In,
+                                 F.TimeRangePred)):
+            return False
+        if isinstance(leaf, F.In) and len(list(leaf.values)) > _IN_MAX_CODES:
+            return False
+    return True
+
+
+def compile_leaves(leaves, encodings) -> tuple[tuple, tuple]:
+    """Translate a leaf conjunction into ((column, opcode), ...) static
+    program + per-leaf int32 constant arrays, in ENCODED space — the
+    exact semantics of ops.filter.eval_predicate's host mask (including
+    the dict-code Le/Gt asymmetry), computed with the same helpers.
+
+    Raises _EmptyMatch when a leaf provably matches nothing and
+    ValueError when a leaf/encoding combination has no device form
+    (caller counts reason="predicate"/"encoding")."""
+    F = filter_ops
+    prog: list = []
+    consts: list = []
+    for leaf in leaves or []:
+        enc = encodings.get(leaf.column)
+        if enc is None:
+            raise ValueError(f"leaf column {leaf.column!r} missing")
+        if isinstance(leaf, F.Eq):
+            c = _const_code_exact(enc, leaf.value)
+            c = None if c is None else _exact_i32(c)
+            if c is None:
+                raise _EmptyMatch
+            prog.append((leaf.column, _OP_EQ))
+            consts.append(np.asarray([c], dtype=np.int32))
+        elif isinstance(leaf, F.In):
+            codes = sorted(ci for ci in (
+                _exact_i32(c) for c in (_const_code_exact(enc, v)
+                                        for v in leaf.values)
+                if c is not None) if ci is not None)
+            if not codes:
+                raise _EmptyMatch
+            prog.append((leaf.column, _OP_IN))
+            consts.append(np.asarray(codes, dtype=np.int32))
+        elif isinstance(leaf, (F.Lt, F.Le, F.Gt, F.Ge)):
+            # dict thresholds are searchsorted indices (always in
+            # range); numeric/offset map exactly as eval_predicate's
+            # host mask, with numeric out-of-int32 edges resolved to
+            # tautology / empty-match BEFORE the clamp (a raw int32
+            # column may hold the int32 extremes)
+            if enc.kind == "dict":
+                if isinstance(leaf, F.Lt):
+                    op, t = _OP_LT, _const_code_lower(enc, leaf.value)
+                elif isinstance(leaf, F.Le):
+                    op, t = _OP_LT, _const_code_upper(enc, leaf.value)
+                elif isinstance(leaf, F.Gt):
+                    op, t = _OP_GE, _const_code_upper(enc, leaf.value)
+                else:
+                    op, t = _OP_GE, _const_code_lower(enc, leaf.value)
+            else:
+                if isinstance(leaf, F.Lt):
+                    op, t = _OP_LT, _const_code_lower(enc, leaf.value)
+                elif isinstance(leaf, F.Le):
+                    op, t = _OP_LE, _const_code_upper(enc, leaf.value)
+                elif isinstance(leaf, F.Gt):
+                    op, t = _OP_GT, _const_code_lower(enc, leaf.value)
+                else:
+                    op, t = _OP_GE, _const_code_lower(enc, leaf.value)
+                if enc.kind == "numeric":
+                    edge = _numeric_edge(op, int(t))
+                    if edge == "empty":
+                        raise _EmptyMatch
+                    if edge == "taut":
+                        continue  # no constraint: drop the leaf
+            prog.append((leaf.column, op))
+            consts.append(np.asarray([_thresh_i32(t)], dtype=np.int32))
+        elif isinstance(leaf, F.TimeRangePred):
+            lo_t = _const_code_lower(enc, leaf.start)
+            hi_t = _const_code_lower(enc, leaf.end)
+            lo_edge = hi_edge = None
+            if enc.kind == "numeric":
+                lo_edge = _numeric_edge(_OP_GE, int(lo_t))
+                hi_edge = _numeric_edge(_OP_LT, int(hi_t))
+            if lo_edge == "empty" or hi_edge == "empty":
+                raise _EmptyMatch
+            if lo_edge == "taut" and hi_edge == "taut":
+                continue
+            if lo_edge == "taut":
+                prog.append((leaf.column, _OP_LT))
+                consts.append(np.asarray([_thresh_i32(hi_t)],
+                                         dtype=np.int32))
+            elif hi_edge == "taut":
+                prog.append((leaf.column, _OP_GE))
+                consts.append(np.asarray([_thresh_i32(lo_t)],
+                                         dtype=np.int32))
+            else:
+                prog.append((leaf.column, _OP_RANGE))
+                consts.append(np.asarray(
+                    [_thresh_i32(lo_t), _thresh_i32(hi_t)],
+                    dtype=np.int32))
+        else:
+            raise ValueError(f"unsupported leaf {type(leaf).__name__}")
+    return tuple(prog), tuple(consts)
+
+
+# ---------------------------------------------------------------------------
+# the fused program
+# ---------------------------------------------------------------------------
+
+
+def _leaf_mask(col, op: int, c):
+    if op == _OP_EQ:
+        return col == c[0]
+    if op == _OP_LT:
+        return col < c[0]
+    if op == _OP_LE:
+        return col <= c[0]
+    if op == _OP_GT:
+        return col > c[0]
+    if op == _OP_GE:
+        return col >= c[0]
+    if op == _OP_RANGE:
+        return (col >= c[0]) & (col < c[1])
+    # _OP_IN: small resolved-code set, compare-broadcast then any
+    return (col[:, None] == c[None, :]).any(axis=1)
+
+
+def _lex_sorted_np(keys: list) -> bool:
+    """Host twin of read._is_lex_sorted over unpadded encoded columns:
+    one vectorized compare pass decides whether the device program can
+    skip its O(n log n) sort entirely — single-SST/post-compaction
+    segments (the steady-state cold-scan shape) arrive (pk, seq)-sorted
+    already, exactly the check the host k-way merge starts with."""
+    n = len(keys[0])
+    if n <= 1:
+        return True
+    still_equal = np.ones(n - 1, dtype=bool)
+    for c in keys:
+        if bool(np.any(still_equal & (c[:-1] > c[1:]))):
+            return False
+        still_equal &= c[:-1] == c[1:]
+        if not still_equal.any():
+            return True
+    return True
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "key_slots", "num_pks", "group_pos", "ts_pos", "val_slot",
+    "leaf_prog", "g_pad", "width", "which", "use_pallas", "presorted"))
+def _decode_aggregate_jit(cols: tuple, n_valid, leaf_consts: tuple,
+                          shift, lo, total, bucket_ms, *,
+                          key_slots: tuple, num_pks: int,
+                          group_pos: int, ts_pos: int,
+                          val_slot: int, leaf_prog: tuple,
+                          g_pad: int, width: int, which: tuple,
+                          use_pallas: bool, presorted: bool = False):
+    """THE fused dispatch: encoded columns in, partial grids out.
+
+    `cols` is the tuple of uploaded int32 code columns (pad capacity);
+    `key_slots` indexes the sort keys into it — the first `num_pks`
+    are the PK code columns, then seq, then any non-PK group/ts column
+    (appended AFTER seq so they cannot perturb the dedup order; with
+    (pk, seq) effectively unique they only ride along to come back
+    sorted).  `group_pos`/`ts_pos` locate the group/ts columns inside
+    the sorted key outputs; `val_slot` indexes the f32 value column
+    (carried, not a key).  `leaf_prog` is the static (column-slot,
+    opcode) program from compile_leaves with `leaf_consts` its traced
+    constants.
+
+    Dropped rows (padding, leaf-filtered, dup-shadowed) are masked to
+    gid = -1, never compacted — static shapes, no host round trip.
+    Returns ({partial grids}, kept_rows)."""
+    cap = cols[0].shape[0]
+    iota = jnp.arange(cap, dtype=jnp.int32)
+    valid = iota < jnp.asarray(n_valid, jnp.int32)
+    for (slot, op), c in zip(leaf_prog, leaf_consts):
+        valid = valid & _leaf_mask(cols[slot], op, c)
+
+    if presorted:
+        # rows already arrive (pk, seq)-sorted (host-checked, the
+        # single-SST/post-compaction shape): the run-boundary masks
+        # below work in place.  Leaf-failed rows cannot split a run —
+        # prune leaves are PK-only, so an equal-PK run passes or fails
+        # as a whole — and padding rows are trailing.
+        valid_s = valid
+        keys_s = tuple(cols[i] for i in key_slots)
+        val_s = cols[val_slot]
+    else:
+        # sort by (invalid, pks..., seq, ..., row): invalid rows sink
+        # as a block; the row index makes the key total, so equal-
+        # (pk, seq) duplicates keep their concatenation order — the
+        # host radix merge's stability contract (_plan_merge_perm)
+        operands = [(~valid).astype(jnp.int32)] \
+            + [cols[i] for i in key_slots] + [iota, cols[val_slot]]
+        n_keys = 2 + len(key_slots)
+        sorted_ops = jax.lax.sort(tuple(operands), num_keys=n_keys)
+        valid_s = sorted_ops[0] == 0
+        keys_s = sorted_ops[1:1 + len(key_slots)]
+        val_s = sorted_ops[-1]
+    # keep-last per PK run among surviving rows (_host_dedup_keep):
+    # a row survives iff valid and (last row | next row invalid | any
+    # pk differs from the next row).  Run boundaries compare the PK
+    # keys ONLY — seq orders within a run, it never splits one.
+    differs_next = jnp.zeros(cap - 1, dtype=bool)
+    for c in keys_s[:num_pks]:
+        differs_next = differs_next | (c[:-1] != c[1:])
+    kept = valid_s & jnp.concatenate(
+        [differs_next | ~valid_s[1:], jnp.ones(1, dtype=bool)])
+
+    gid = jnp.where(kept, keys_s[group_pos], jnp.int32(-1))
+    ts_s = keys_s[ts_pos]
+    n_rows = jnp.sum(kept.astype(jnp.int32))
+    if use_pallas:
+        from horaedb_tpu.ops.pallas_kernels import pallas_window_partials
+
+        shift32 = jnp.asarray(shift, jnp.int32)
+        lo32 = jnp.asarray(lo, jnp.int32)
+        bucket32 = jnp.asarray(bucket_ms, jnp.int32)
+        gid = jnp.where(
+            (ts_s + shift32) // bucket32
+            < jnp.asarray(total, jnp.int32), gid, -1)
+        grids = pallas_window_partials(
+            ts_s + shift32 - lo32 * bucket32, gid, val_s, cap, bucket32,
+            num_groups=g_pad, num_buckets=width, which=which,
+            interpret=jax.devices()[0].platform != "tpu")
+    else:
+        grids = downsample.window_local_partials(
+            ts_s, gid, val_s, jnp.arange(g_pad, dtype=jnp.int32),
+            shift, lo, total, bucket_ms, num_groups=g_pad,
+            num_buckets=width, which=which)
+    return grids, n_rows
+
+
+# ---------------------------------------------------------------------------
+# dispatch / finalize wrappers
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class DevicePart:
+    """A segment's finished aggregate partial from the device-decode
+    path, shaped to coexist with DeviceBatch windows in a segment's
+    `windows` list (n_valid/nbytes feed the same pipeline accounting).
+    `part` is (group_values, bucket_lo, grids) — exactly what
+    `_flush_window_batch` emits — or None when the segment provably
+    contributes nothing (an Eq/In constant absent from the
+    dictionary)."""
+
+    part: Optional[tuple]
+    n_valid: int   # post-dedup surviving rows (ops-metric parity)
+    nbytes: int    # host bytes of the downloaded grids
+
+
+class DecodeDispatch:
+    """One segment's in-flight fused dispatch: the jit call has been
+    issued (device work runs async); finalize() downloads the grids and
+    shapes the part.  Split so the pipeline's decode stage can dispatch
+    segment k+1's upload while segment k's kernel still runs."""
+
+    __slots__ = ("outs", "n_rows", "values", "lo", "w_eff", "bucket_ms",
+                 "t_dispatch", "upload_bytes", "src_rows")
+
+    def __init__(self, outs, n_rows, values, lo, w_eff, bucket_ms,
+                 t_dispatch, upload_bytes, src_rows):
+        self.outs = outs
+        self.n_rows = n_rows
+        self.values = values
+        self.lo = lo
+        self.w_eff = w_eff
+        self.bucket_ms = bucket_ms
+        self.t_dispatch = t_dispatch
+        self.upload_bytes = upload_bytes
+        self.src_rows = src_rows
+
+    def finalize(self) -> DevicePart:
+        t0 = time.perf_counter()
+        g = len(self.values)
+        # mirror _flush_window_batch's emission exactly: slice to the
+        # real group count and the query-clipped width, then re-base
+        # window-local last_ts to range_start-relative int64.  The
+        # slices COPY (ascontiguousarray): a view would pin the full
+        # (g_pad, width) download while nbytes counted only the slice
+        # — the PartsMemo views-pin-bases defect, not repeated here
+        grids = {k: np.ascontiguousarray(np.asarray(v)[:g, :self.w_eff])
+                 for k, v in self.outs.items()}
+        if "last_ts" in grids:
+            lt = grids["last_ts"].astype(np.int64)
+            grids["last_ts"] = np.where(
+                grids["count"] > 0, lt + self.lo * self.bucket_ms, lt)
+        n_rows = int(self.n_rows)
+        nbytes = sum(int(a.nbytes) for a in grids.values())
+        part = DevicePart(part=(self.values, self.lo, grids),
+                          n_valid=n_rows, nbytes=nbytes)
+        observe_decode_stage(self.t_dispatch
+                             + (time.perf_counter() - t0),
+                             rows=self.src_rows,
+                             nbytes=self.upload_bytes)
+        return part
+
+
+# stage attribution twins ride the same labeled families as every other
+# plan stage (docs/observability.md); read.py's plan_stage_snapshot
+# includes "device_decode" so bench diffs pick it up
+_STAGE_SECONDS = registry.histogram(
+    "scan_stage_seconds", "wall seconds per merge-scan plan stage"
+).labels(stage="device_decode")
+_STAGE_ROWS = registry.counter(
+    "scan_stage_rows_total", "rows entering each plan stage"
+).labels(stage="device_decode")
+_STAGE_BYTES = registry.counter(
+    "scan_stage_bytes_total", "bytes entering each plan stage"
+).labels(stage="device_decode")
+
+
+def observe_decode_stage(seconds: float, rows: int, nbytes: int) -> None:
+    _STAGE_SECONDS.observe(seconds)
+    trace_add("stage_device_decode_ms", seconds * 1e3)
+    if rows:
+        _STAGE_ROWS.inc(rows)
+        trace_add("stage_device_decode_rows", rows)
+    if nbytes:
+        _STAGE_BYTES.inc(nbytes)
+        trace_add("stage_device_decode_bytes", nbytes)
+
+
+def use_pallas_partials() -> bool:
+    """Whether the fused dispatch should route its aggregate through
+    the Pallas partials kernel — the same measured-before-adoption knob
+    as the fused single-shot aggregate (HORAEDB_DOWNSAMPLE_IMPL)."""
+    return downsample.downsample_impl() == "pallas"
+
+
+def classify_pallas_failure() -> str:
+    """Distinguish 'this host has no TPU' (interpret-mode gaps, an
+    environment fact) from 'the kernel is broken on real hardware' (a
+    bug CI must surface) — the two must not share one except clause."""
+    try:
+        on_tpu = jax.devices()[0].platform == "tpu"
+    except Exception:  # noqa: BLE001 — no backend at all counts as no TPU
+        on_tpu = False
+    return "pallas_error" if on_tpu else "pallas_no_tpu"
+
+
+def prepare_dispatch(es, spec, pk_names: list, seq_name: str,
+                     leaves, max_bytes: int, width: int,
+                     pad_capacity) -> "DecodeDispatch | DevicePart | str":
+    """Validate one EncodedSegment against the fused program's layout
+    and dispatch it.  Returns a DecodeDispatch (in flight), a DevicePart
+    (provably-empty segment, no dispatch), or a fallback reason string
+    (the caller counts it and takes the host path)."""
+    encs = es.encodings
+    # layout gates, cheapest first; reasons mirror FALLBACK_REASONS
+    for name in (spec.group_col, spec.ts_col, spec.value_col, seq_name,
+                 *pk_names):
+        if name not in es.columns:
+            return "encoding"
+    ts_enc = encs[spec.ts_col]
+    if ts_enc.kind not in ("offset", "numeric"):
+        return "encoding"
+    g_enc = encs[spec.group_col]
+    if g_enc.kind != "dict" or g_enc.dictionary is None \
+            or len(g_enc.dictionary) == 0:
+        return "encoding"  # codes must BE dense ids over a known space
+    if es.columns[spec.value_col].dtype != np.float32:
+        return "dtype"
+    for name in (spec.ts_col, seq_name, *pk_names):
+        if es.columns[name].dtype != np.int32:
+            return "dtype"
+    shift = int(ts_enc.epoch) - spec.range_start
+    if abs(shift) >= 2**31:
+        return "range"
+    cap = pad_capacity(es.n)
+
+    try:
+        prog, consts = compile_leaves(leaves, encs)
+    except _EmptyMatch:
+        return DevicePart(part=None, n_valid=0, nbytes=0)
+    except (ValueError, OverflowError):
+        return "predicate"
+
+    # upload slots: pk codes, then seq (the dedup order), then any
+    # non-PK group/ts column appended AFTER seq — sort keys past
+    # (pk, seq, ..., row) refine an effectively-total order, so they
+    # ride along only to come back in sorted row order; the value
+    # column and any leaf-only columns complete the upload set
+    key_names = list(pk_names)
+    key_names.append(seq_name)
+    for nm in (spec.group_col, spec.ts_col):
+        if nm not in key_names:
+            key_names.append(nm)
+    slot_of: dict = {}
+    upload_names: list = []
+    for nm in key_names + [spec.value_col] \
+            + [c for c, _op in prog]:
+        if nm not in slot_of:
+            slot_of[nm] = len(upload_names)
+            upload_names.append(nm)
+    # HBM admission over the ACTUAL upload set (non-PK group/ts and
+    # leaf-only columns included — undercounting would admit a
+    # segment over budget and OOM the device instead of falling back)
+    if cap * 4 * len(upload_names) > max_bytes:
+        return "budget"
+
+    # one vectorized compare pass decides whether the device program
+    # needs its sort at all — the steady-state cold scan (one compacted
+    # SST per segment) skips it, so decode stays a pad + upload +
+    # elementwise program there
+    presorted = _lex_sorted_np(
+        [es.columns[nm] for nm in pk_names] + [es.columns[seq_name]])
+    local_ok = ts_enc.kind == "offset"
+    lo = max(0, shift // spec.bucket_ms) if local_ok else 0
+    use_width = width if local_ok else spec.num_buckets
+    g = len(g_enc.dictionary)
+    g_pad = max(8, 1 << (g - 1).bit_length())
+    w_eff = min(use_width, spec.num_buckets - lo)
+
+    t0 = time.perf_counter()
+    upload_bytes = 0
+    cols_dev = []
+    for nm in upload_names:
+        arr = es.columns[nm]
+        padded = np.zeros(cap, dtype=arr.dtype)  # calloc: tail free
+        padded[:es.n] = arr
+        upload_bytes += int(padded.nbytes)
+        cols_dev.append(jax.device_put(padded))
+    key_slots = tuple(slot_of[nm] for nm in key_names)
+    # group/ts positions INSIDE the sorted key outputs
+    group_pos = key_names.index(spec.group_col)
+    ts_pos = key_names.index(spec.ts_col)
+    leaf_prog = tuple((slot_of[c], op) for c, op in prog)
+    consts_dev = tuple(jnp.asarray(c) for c in consts)
+
+    def run(pallas: bool):
+        return _decode_aggregate_jit(
+            tuple(cols_dev), es.n, consts_dev,
+            np.int32(shift), np.int32(lo), np.int32(spec.num_buckets),
+            np.int32(spec.bucket_ms),
+            key_slots=key_slots, num_pks=len(pk_names),
+            group_pos=group_pos, ts_pos=ts_pos,
+            val_slot=slot_of[spec.value_col], leaf_prog=leaf_prog,
+            g_pad=g_pad, width=use_width, which=spec.which,
+            use_pallas=pallas, presorted=presorted)
+
+    if use_pallas_partials():
+        try:
+            outs, n_rows = run(True)
+        except Exception as exc:  # noqa: BLE001 — guarded, classified
+            reason = classify_pallas_failure()
+            note_fallback(reason)
+            logger.warning("pallas decode kernel failed (%s): %s; "
+                           "using the XLA program", reason, exc)
+            outs, n_rows = run(False)
+    else:
+        outs, n_rows = run(False)
+    return DecodeDispatch(outs=outs, n_rows=n_rows,
+                          values=g_enc.dictionary, lo=lo, w_eff=w_eff,
+                          bucket_ms=spec.bucket_ms,
+                          t_dispatch=time.perf_counter() - t0,
+                          upload_bytes=upload_bytes, src_rows=es.n)

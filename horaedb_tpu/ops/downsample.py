@@ -20,6 +20,7 @@ combine correctly under collectives, NaNs would not.
 from __future__ import annotations
 
 import functools
+import logging
 import os
 
 import jax
@@ -163,6 +164,13 @@ _IMPLS = ("xla", "pallas")
 _impl = "xla"
 
 
+def downsample_impl() -> str:
+    """The selected fused-downsample implementation (see
+    set_downsample_impl) — read by ops/device_decode.py so the fused
+    decode dispatch rides the same measured-before-adoption knob."""
+    return _impl
+
+
 def set_downsample_impl(name: str) -> None:
     """Select the fused downsample implementation: "xla" (segment ops,
     the default) or "pallas" (ops.pallas_kernels compare-broadcast
@@ -198,10 +206,25 @@ def time_bucket_aggregate(ts_offset: jax.Array, group_ids: jax.Array,
             pallas_time_bucket_aggregate,
         )
 
-        return pallas_time_bucket_aggregate(
-            ts_offset, group_ids, values, n_valid, bucket_ms,
-            num_groups=num_groups, num_buckets=num_buckets, which=which,
-            interpret=jax.devices()[0].platform != "tpu")
+        try:
+            return pallas_time_bucket_aggregate(
+                ts_offset, group_ids, values, n_valid, bucket_ms,
+                num_groups=num_groups, num_buckets=num_buckets,
+                which=which,
+                interpret=jax.devices()[0].platform != "tpu")
+        except Exception as exc:  # noqa: BLE001 — guarded, classified
+            # explicit reason reporting instead of a bare swallow:
+            # CPU-only CI must be able to tell "this box has no TPU"
+            # (interpret-mode gap, an environment fact) from a real
+            # kernel bug on hardware (docs/observability.md,
+            # scan_decode_fallback_total)
+            from horaedb_tpu.ops import device_decode
+
+            reason = device_decode.classify_pallas_failure()
+            device_decode.note_fallback(reason)
+            logging.getLogger(__name__).warning(
+                "pallas downsample kernel failed (%s): %s; "
+                "serving the XLA path", reason, exc)
     return _time_bucket_aggregate_impl(
         ts_offset, group_ids, values, n_valid, bucket_ms,
         num_groups=num_groups, num_buckets=num_buckets, which=which)

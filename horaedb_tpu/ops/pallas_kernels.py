@@ -111,31 +111,27 @@ def _agg_kernel(meta_ref, ts_ref, gid_ref, val_ref, *out_refs,
         refs["last"][0, :] = jnp.where(take, blk_val, refs["last"][0, :])
 
 
-@functools.partial(jax.jit, static_argnames=("num_groups", "num_buckets",
-                                             "which", "interpret"))
-def pallas_time_bucket_aggregate(ts_offset: jax.Array, group_ids: jax.Array,
-                                 values: jax.Array, n_valid, bucket_ms,
-                                 num_groups: int, num_buckets: int,
-                                 which: tuple = None,
-                                 interpret: bool = False) -> dict:
-    """Pallas twin of ops.downsample.time_bucket_aggregate, including
-    `last` (value at max ts per cell, later row winning ties).  Same
-    contract: int32 ts offsets and group codes, capacity-padded, rows
-    [0, n_valid) real.  `which` (static) limits the accumulators the
-    kernel materializes — cost scales with the requested aggregates,
-    like the XLA path."""
-    from horaedb_tpu.ops import downsample
-
-    which = tuple(sorted(set(which))) if which is not None \
-        else downsample.ALL_AGGS
+def _fields_for(which: tuple) -> tuple:
+    """Accumulator fields for a canonical `which` tuple, dependencies
+    included (avg needs sum, last needs last_ts, count always)."""
     want = set(which)
     if "avg" in want:
         want.add("sum")
     if "last" in want:
         want.add("last_ts")
     want.add("count")
-    fields = tuple(f for f in _FIELDS if f in want)
+    return tuple(f for f in _FIELDS if f in want)
 
+
+def _pallas_partial_grids(ts_offset: jax.Array, group_ids: jax.Array,
+                          values: jax.Array, n_valid, bucket_ms,
+                          num_groups: int, num_buckets: int,
+                          fields: tuple, interpret: bool) -> dict:
+    """Run the compare-broadcast kernel and reshape its flat cell
+    outputs into (num_groups, num_buckets) PARTIAL grids with the
+    segment-op identities the XLA path produces (min/max empties read
+    +/-inf, last_ts I32_MIN, last 0) — the shape combine folds and
+    finalize_aggregate consumes."""
     capacity = ts_offset.shape[0]
     num_cells = num_groups * num_buckets
     cells_padded = pl.cdiv(num_cells, CELL_TILE) * CELL_TILE
@@ -180,4 +176,48 @@ def pallas_time_bucket_aggregate(ts_offset: jax.Array, group_ids: jax.Array,
         partial["min"] = jnp.where(empty, jnp.inf, partial["min"])
     if "max" in partial:
         partial["max"] = jnp.where(empty, -jnp.inf, partial["max"])
+    return partial
+
+
+@functools.partial(jax.jit, static_argnames=("num_groups", "num_buckets",
+                                             "which", "interpret"))
+def pallas_time_bucket_aggregate(ts_offset: jax.Array, group_ids: jax.Array,
+                                 values: jax.Array, n_valid, bucket_ms,
+                                 num_groups: int, num_buckets: int,
+                                 which: tuple = None,
+                                 interpret: bool = False) -> dict:
+    """Pallas twin of ops.downsample.time_bucket_aggregate, including
+    `last` (value at max ts per cell, later row winning ties).  Same
+    contract: int32 ts offsets and group codes, capacity-padded, rows
+    [0, n_valid) real.  `which` (static) limits the accumulators the
+    kernel materializes — cost scales with the requested aggregates,
+    like the XLA path."""
+    from horaedb_tpu.ops import downsample
+
+    which = tuple(sorted(set(which))) if which is not None \
+        else downsample.ALL_AGGS
+    partial = _pallas_partial_grids(
+        ts_offset, group_ids, values, n_valid, bucket_ms,
+        num_groups=num_groups, num_buckets=num_buckets,
+        fields=_fields_for(which), interpret=interpret)
     return downsample.finalize_aggregate(partial, which=which)
+
+
+def pallas_window_partials(ts_offset: jax.Array, group_ids: jax.Array,
+                           values: jax.Array, n_valid, bucket_ms,
+                           num_groups: int, num_buckets: int,
+                           which: tuple, interpret: bool = False) -> dict:
+    """PARTIAL-grid twin of pallas_time_bucket_aggregate for the fused
+    device-decode dispatch (ops/device_decode.py): same kernel, no
+    finalize — the emitted grids carry the partial conventions
+    (min/max empties +/-inf, last_ts I32_MIN) that the host combine
+    fold (storage/combine.py) consumes directly.  Callers pre-mask
+    out-of-range rows to gid = -1 (the decode program's filter/dedup
+    masks), matching ops.downsample.window_local_partials.  Traced:
+    meant to be called INSIDE an enclosing jit (the fused dispatch),
+    so it carries no jit wrapper of its own."""
+    return _pallas_partial_grids(
+        ts_offset, group_ids, values, n_valid, bucket_ms,
+        num_groups=num_groups, num_buckets=num_buckets,
+        fields=_fields_for(tuple(sorted(set(which)))),
+        interpret=interpret)

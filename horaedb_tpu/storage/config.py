@@ -180,6 +180,35 @@ class ScanCombineConfig:
 
 
 @dataclass
+class ScanDecodeConfig:
+    """Device-native decode ([scan.decode]; see ops/device_decode.py):
+    eligible aggregate scans upload a segment's ENCODED sidecar buffers
+    raw and fuse dict-decode + leaf filter + merge-dedup +
+    bucket-aggregate into one jitted device dispatch, so host CPU
+    touches the bytes only to move them (ROADMAP item 2).
+
+    mode:
+      "auto"   — engage on accelerator backends for plans the fused
+                 aggregate declines anyway (the oversized/cold shape);
+                 never on XLA-CPU, where host numpy decode measured
+                 faster (the host_agg trade).
+      "device" — force the fused dispatch wherever structurally
+                 eligible (bench A/Bs and the chaos suite's device leg;
+                 takes precedence over the fused aggregate).
+      "host"   — the pre-change host decode everywhere: THE bit
+                 -identity control (the seeded chaos suite
+                 byte-compares the two).
+    HORAEDB_DEVICE_DECODE=1/0 forces device/host over the config.
+    Structurally-ineligible plans/segments fall back per reason to
+    scan_decode_fallback_total{reason=} (docs/observability.md)."""
+
+    mode: str = "auto"
+    # HBM admission per segment dispatch: a segment whose padded upload
+    # would exceed this decodes on host instead (reason="budget")
+    max_upload_bytes: int = 256 << 20
+
+
+@dataclass
 class ScanPipelineConfig:
     """Cold-scan pipelining ([scan.pipeline]): the cold read path runs
     as a bounded producer/consumer pipeline — a fetch stage that keeps
@@ -270,6 +299,10 @@ class ScanConfig:
     # the cold path (the off path keeps using prefetch_segments)
     pipeline: ScanPipelineConfig = field(
         default_factory=ScanPipelineConfig)
+    # device-native decode knobs ([scan.decode]): fuse sidecar decode +
+    # filter + bucket-aggregate into one device dispatch for eligible
+    # aggregate scans; "host" reproduces the pre-change path exactly
+    decode: ScanDecodeConfig = field(default_factory=ScanDecodeConfig)
 
 
 @dataclass
@@ -310,6 +343,7 @@ _NESTED = {
     "cache": ScanCacheConfig,
     "combine": ScanCombineConfig,
     "pipeline": ScanPipelineConfig,
+    "decode": ScanDecodeConfig,
     "threads": ThreadsConfig,
     "retry": RetryConfig,
     "scrub": ScrubConfig,

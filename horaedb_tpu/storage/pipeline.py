@@ -109,8 +109,18 @@ def observe_stage(stage: str, seconds: float, rows: int = 0,
 
 def windows_nbytes(windows: list) -> int:
     """Host bytes held by a segment's decoded windows (column arrays;
-    memo allowances are charged by the scan cache, not here)."""
-    return sum(int(c.nbytes) for w in windows for c in w.columns.values())
+    memo allowances are charged by the scan cache, not here).  A
+    device-decoded segment's entry is a finished aggregate partial
+    (ops.device_decode.DevicePart) whose host footprint is just its
+    downloaded grids."""
+    total = 0
+    for w in windows:
+        cols = getattr(w, "columns", None)
+        if cols is None:
+            total += int(getattr(w, "nbytes", 0))
+        else:
+            total += sum(int(c.nbytes) for c in cols.values())
+    return total
 
 
 class PipelineBudget:

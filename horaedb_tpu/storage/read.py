@@ -54,6 +54,7 @@ from horaedb_tpu.storage.types import (
     StorageSchema,
     TimeRange,
 )
+from horaedb_tpu.ops import device_decode
 from horaedb_tpu.storage import combine as combine_mod, parquet_io, sidecar
 from horaedb_tpu.utils import registry, trace_add
 
@@ -72,7 +73,8 @@ _ROWS_SCANNED = registry.counter(
 # per stage; per-QUERY attribution additionally lands on the ambient
 # trace via tracing.trace_add (docs/observability.md).
 _PLAN_STAGES = ("parquet_read", "sidecar_read", "encode_merge",
-                "stack_build", "device_aggregate", "combine")
+                "stack_build", "device_decode", "device_aggregate",
+                "combine")
 _STAGE_SECONDS = {
     s: registry.histogram("scan_stage_seconds",
                           "wall seconds per merge-scan plan stage"
@@ -82,12 +84,14 @@ _STAGE_SECONDS = {
 _STAGE_ROWS = {
     s: registry.counter("scan_stage_rows_total",
                         "rows entering each plan stage").labels(stage=s)
-    for s in ("parquet_read", "sidecar_read", "encode_merge")
+    for s in ("parquet_read", "sidecar_read", "encode_merge",
+              "device_decode")
 }
 _STAGE_BYTES = {
     s: registry.counter("scan_stage_bytes_total",
                         "bytes entering each plan stage").labels(stage=s)
-    for s in ("parquet_read", "sidecar_read", "stack_build")
+    for s in ("parquet_read", "sidecar_read", "stack_build",
+              "device_decode")
 }
 # cache-effectiveness counters (ops parity with scan_cache_*): the
 # replay and stack LRUs are the reason repeat/varied queries are fast —
@@ -166,6 +170,11 @@ def plan_stage_snapshot() -> dict:
         out[f"pipeline_{s}_s"] = round(h.sum, 6)
         out[f"pipeline_{s}_calls"] = h.count
         out[f"pipeline_stalls_{s}"] = stalls[s]
+        # rows/bytes too: bench A/Bs diff decoded-window bytes against
+        # the device path's encoded-bytes-uploaded (config 16)
+        out[f"pipeline_{s}_rows"] = int(pipeline_mod.STAGE_ROWS[s].value)
+        out[f"pipeline_{s}_bytes"] = int(
+            pipeline_mod.STAGE_BYTES[s].value)
     return out
 # segment tables held in memory at once by _prefetch_tables (bounds BOTH
 # the row-scan and aggregate paths — including compaction's scan);
@@ -177,6 +186,9 @@ _CACHE_BYTES_PER_ROW = 32
 # fused replay plans kept per reader (weakref-only entries; see
 # ParquetReader._replay_cache)
 _REPLAY_SLOTS = 8
+
+# [scan.decode] modes (validated at reader open; docs/example.toml)
+DECODE_MODES = ("auto", "device", "host")
 
 
 # guards every window's memo put: memo stores run on worker-pool
@@ -304,6 +316,13 @@ class ScanPlan:
     # with decode measurably LOSES on low-core hosts, same contention
     # as the fetch/decode stages)
     pipeline_active: bool = False
+    # set by aggregate_segments when this plan is eligible for the
+    # fused device-decode dispatch ([scan.decode]; ops/device_decode.py):
+    # the decode stage uploads eligible EncodedSegments' raw encoded
+    # buffers and fuses filter + merge-dedup + bucket-aggregate into
+    # one jitted program, emitting finished per-segment parts instead
+    # of host windows.  None = host decode (row scans, the control)
+    decode_spec: Optional["AggregateSpec"] = None
 
 
 class ParquetReader:
@@ -378,6 +397,12 @@ class ParquetReader:
                f"unknown [scan.combine] mode "
                f"{config.scan.combine.mode!r}; expected one of "
                f"{combine_mod.COMBINE_MODES}")
+        # decode mode validated at open too: bad TOML fails the boot,
+        # not a dashboard's first cold scan
+        ensure(config.scan.decode.mode in DECODE_MODES,
+               f"unknown [scan.decode] mode "
+               f"{config.scan.decode.mode!r}; expected one of "
+               f"{DECODE_MODES}")
         # delta-summation tier: per-segment aggregate partials keyed by
         # the segment's exact SST set (event-loop owned, like the scan
         # cache) — narrowed/refined dashboard ranges recompute only
@@ -606,7 +631,7 @@ class ParquetReader:
             dispatched: list = []
             if table.num_rows:
                 dispatched = await self._run_pool(
-                    plan.pool, self._dispatch_segment_table, table)
+                    plan.pool, self._dispatch_segment_table, table, plan)
             pending.append((fseg, "bulk", dispatched, read_s))
 
         try:
@@ -627,7 +652,7 @@ class ParquetReader:
                         await self._read_streamed_dispatched(seg, plan)
                 windows = await self._run_pool(
                     plan.pool, self._finalize_windows, dispatched)
-                if plan.use_cache:
+                if plan.use_cache and self._cacheable_windows(windows):
                     self.scan_cache.put(self._cache_key(seg, plan),
                                         windows)
                 yield seg, windows, read_s
@@ -692,7 +717,7 @@ class ParquetReader:
                     continue
                 got, windows, read_s = await pipe.next_segment()
                 assert got is seg
-                if plan.use_cache:
+                if plan.use_cache and self._cacheable_windows(windows):
                     self.scan_cache.put(self._cache_key(seg, plan),
                                         windows)
                 yield seg, windows, read_s
@@ -716,7 +741,8 @@ class ParquetReader:
             try:
                 async for es in es_iter:
                     dispatched.extend(await self._run_pool(
-                        plan.pool, self._dispatch_encoded_windows, es))
+                        plan.pool, self._dispatch_segment_table, es,
+                        plan))
             except Exception as exc:  # noqa: BLE001
                 # nothing has been yielded for this segment yet
                 # (windows buffer here), so a clean whole-segment
@@ -732,22 +758,61 @@ class ParquetReader:
                     plan.pool, self._dispatch_merged_windows, batch))
         return dispatched, time.perf_counter() - t0
 
-    def _dispatch_segment_table(self, table) -> list:
+    def _dispatch_segment_table(self, table, plan: "ScanPlan" = None
+                                ) -> list:
         """Pool-side encode+merge dispatch of one bulk segment's read
         result (pa.Table or sidecar.EncodedSegment) — the ONE body
         shared by the sequential pump and the pipeline's decode stage
-        so the two cannot drift."""
+        so the two cannot drift.
+
+        Device-decode-routed plans (plan.decode_spec set) short-circuit
+        here: the segment's ENCODED buffers upload raw and one fused
+        program does filter + merge-dedup + bucket-aggregate
+        (ops/device_decode.py) — the decode pool dispatch shrinks to a
+        memcpy-shaped pad + upload.  Per-segment ineligibility falls
+        back to the host path with its reason counted, resolving any
+        deferred leaf mask first."""
         if isinstance(table, sidecar.EncodedSegment):
-            return self._dispatch_encoded_windows(table)
+            es = table
+            if plan is not None and plan.decode_spec is not None:
+                disp = self._dispatch_device_decode(es, plan)
+                if disp is not None:
+                    return disp
+                es = sidecar.apply_leaves_host(es)
+            elif es.pending_leaves is not None:
+                es = sidecar.apply_leaves_host(es)
+            return self._dispatch_encoded_windows(es)
+        if plan is not None and plan.decode_spec is not None:
+            device_decode.note_fallback("parquet")
         batch = table.combine_chunks().to_batches()[0]
         return self._dispatch_merged_windows(batch)
+
+    def _dispatch_device_decode(self, es: "sidecar.EncodedSegment",
+                                plan: "ScanPlan") -> Optional[list]:
+        """Dispatch one EncodedSegment through the fused device-decode
+        program; None (with the reason counted) when this segment's
+        layout can't ride it — the caller falls back to host decode."""
+        spec = plan.decode_spec
+        leaves = (es.pending_leaves if es.pending_leaves is not None
+                  else [])
+        got = device_decode.prepare_dispatch(
+            es, spec, pk_names=self._pk_names_in(list(es.names)),
+            seq_name=SEQ_COLUMN_NAME, leaves=leaves,
+            max_bytes=self.config.scan.decode.max_upload_bytes,
+            width=self._window_grid_width(spec),
+            pad_capacity=encode.pad_capacity)
+        if isinstance(got, str):
+            device_decode.note_fallback(got)
+            return None
+        return [got]
 
     def _decode_segment_windows(self, table, plan: ScanPlan) -> list:
         """The pipeline's decode stage body, one pool dispatch per
         segment: encode + k-way merge + window planning + finalize
         fused — no intermediate hand-back to the event loop between
         them.  `table` is a pa.Table or sidecar.EncodedSegment."""
-        return self._finalize_windows(self._dispatch_segment_table(table))
+        return self._finalize_windows(
+            self._dispatch_segment_table(table, plan))
 
     async def _cached_windows_mesh(self, plan: ScanPlan, cached: dict,
                                    to_read: list):
@@ -1065,15 +1130,19 @@ class ParquetReader:
         the event loop and falls back to parquet — the cache's negative
         memos are loop-owned)."""
         t0 = time.perf_counter()
+        defer = plan.decode_spec is not None
         try:
-            es = sidecar.assemble_parts(parts, list(seg.columns),
-                                        plan.prune_leaves)
+            es = sidecar.assemble_parts(
+                parts, list(seg.columns),
+                None if defer else plan.prune_leaves)
         except Exception as exc:  # noqa: BLE001 — cache read only
             logger.warning("sidecar assembly raised for segment %s: %s",
                            seg.segment_start, exc)
             es = None
         if es is None:
             return None
+        if defer:
+            es.pending_leaves = list(plan.prune_leaves or [])
         read_s = time.perf_counter() - t0
         _STAGE_SECONDS["sidecar_read"].observe(read_s)
         _STAGE_ROWS["sidecar_read"].inc(es.n)
@@ -1150,9 +1219,16 @@ class ParquetReader:
             # returned a row subset tied to this plan's leaves
             if res[1] == f.meta.num_rows:
                 self.encoded_cache.put(f.id, res[0], res[1])
+        # device-decode plans DEFER the exact leaf mask: the fused
+        # dispatch evaluates the conjunction in encoded space on
+        # device, so the host never pays the mask + per-column
+        # compaction (ops/device_decode.py; a per-segment fallback
+        # resolves pending leaves host-side)
+        defer = plan.decode_spec is not None
         try:
             es = await runner(sidecar.assemble_parts, parts,
-                              list(seg.columns), leaves)
+                              list(seg.columns),
+                              None if defer else leaves)
         except Exception as exc:  # noqa: BLE001 — cache read only
             # a part that parses but is internally inconsistent can blow
             # up deep in eval/concat; the contract is fallback, not
@@ -1160,6 +1236,8 @@ class ParquetReader:
             logger.warning("sidecar assembly raised for segment %s: %s",
                            seg.segment_start, exc)
             es = None
+        if es is not None and defer:
+            es.pending_leaves = list(leaves or [])
         if es is None:
             # cross-SST assembly failed (e.g. an irreconcilable column
             # type across parts).  Do NOT memoize the member SSTs as
@@ -1229,11 +1307,17 @@ class ParquetReader:
                     s.load_window(wleaves) for s in sessions))
                 if any(p is None for p in parts):
                     raise Error("sidecar stream window failed")
+                # device-decode plans defer the exact window mask to
+                # the fused dispatch — the synthetic range leaves keep
+                # windows exactly disjoint there, same as the host mask
+                defer = plan.decode_spec is not None
                 es = await self._run_pool(
                     plan.pool, sidecar.assemble_parts, list(parts),
-                    list(seg.columns), wleaves)
+                    list(seg.columns), None if defer else wleaves)
                 if es is None:
                     raise Error("sidecar stream assembly failed")
+                if defer:
+                    es.pending_leaves = list(wleaves)
                 if es.n:
                     rows += es.n
                     nbytes += es.nbytes
@@ -1286,6 +1370,12 @@ class ParquetReader:
                 "depth": self.config.scan.pipeline.depth,
                 "inflight_bytes": self.config.scan.pipeline.inflight_bytes,
                 "high_water_bytes": self._pipeline_high_water,
+            },
+            "decode": {
+                "mode": self.config.scan.decode.mode,
+                "resolved": self._decode_mode(),
+                "max_upload_bytes":
+                    self.config.scan.decode.max_upload_bytes,
             },
             "stack_cache": {
                 "entries": len(self._stack_cache),
@@ -1694,12 +1784,31 @@ class ParquetReader:
     def _finalize_windows(dispatched: list) -> list:
         """Sync the dispatched merges' run counts (int() blocks until the
         device finishes) and wrap them as DeviceBatches.  Split from
-        dispatch so callers can overlap merge compute across segments."""
-        return [
-            encode.DeviceBatch(columns=columns, encodings=encodings,
-                               n_valid=int(num_runs), capacity=cap)
-            for columns, encodings, num_runs, cap in dispatched
-        ]
+        dispatch so callers can overlap merge compute across segments.
+        Device-decode entries (in-flight fused dispatches) finalize
+        into DeviceParts — finished per-segment aggregate partials that
+        ride the same windows list."""
+        out = []
+        for entry in dispatched:
+            if isinstance(entry, device_decode.DevicePart):
+                out.append(entry)
+            elif isinstance(entry, device_decode.DecodeDispatch):
+                out.append(entry.finalize())
+            else:
+                columns, encodings, num_runs, cap = entry
+                out.append(encode.DeviceBatch(
+                    columns=columns, encodings=encodings,
+                    n_valid=int(num_runs), capacity=cap))
+        return out
+
+    @staticmethod
+    def _cacheable_windows(windows: list) -> bool:
+        """Only host-decoded window lists may enter the scan cache:
+        DeviceParts are aggregate partials keyed to one spec — serving
+        them to a row scan or a different aggregate would be wrong, and
+        repeat aggregates are already served structurally by the parts
+        memo (storage/combine.py)."""
+        return all(isinstance(w, encode.DeviceBatch) for w in windows)
 
     def _window_to_arrow(self, out_batch: encode.DeviceBatch,
                          out_names: list[str],
@@ -1738,14 +1847,33 @@ class ParquetReader:
 
     def fused_aggregate_ok(self, plan: Optional[ScanPlan] = None) -> bool:
         """Whether the fused device-accumulated aggregate serves this
-        scan.  It requires single-device host_perm mode, and by default
-        engages only on ACCELERATOR backends: there, device->host is the
-        scarce resource (the per-flush partial downloads dominate) and
-        scatters are fast; on XLA-CPU the trade inverts — downloads are
-        free and scatter is the slow op, so the per-flush host f64 fold
-        wins.  HORAEDB_FUSED_AGG=1/0 forces it on/off (tests force it on
-        to cover the fused path on the CPU backend).  The mesh path
-        keeps per-round psum combines either way.
+        scan (see _fused_agg_ok_base for the structural gates).  An
+        explicit `[scan.decode] mode = "device"` outranks it for
+        decode-eligible plans: the fused accumulator still pays host
+        decode for every window, which is the wall the device-decode
+        dispatch removes — forcing fused (HORAEDB_FUSED_AGG=1) still
+        wins, so existing coverage keeps its path."""
+        if not self._fused_agg_ok_base(plan):
+            return False
+        import os
+
+        if os.environ.get("HORAEDB_FUSED_AGG", "") == "1":
+            return True
+        if (plan is not None and self._decode_mode() == "device"
+                and self._device_decode_plan_ok(plan, count=False)):
+            return False
+        return True
+
+    def _fused_agg_ok_base(self, plan: Optional[ScanPlan] = None) -> bool:
+        """The fused aggregate's own gates: single-device host_perm
+        mode, and by default ACCELERATOR backends only — there,
+        device->host is the scarce resource (the per-flush partial
+        downloads dominate) and scatters are fast; on XLA-CPU the trade
+        inverts — downloads are free and scatter is the slow op, so the
+        per-flush host f64 fold wins.  HORAEDB_FUSED_AGG=1/0 forces it
+        on/off (tests force it on to cover the fused path on the CPU
+        backend).  The mesh path keeps per-round psum combines either
+        way.
 
         When `plan` is given, queries whose estimated row volume exceeds
         the scan-cache budget fall back to the parts path: fused is
@@ -1769,6 +1897,68 @@ class ParquetReader:
         import jax
 
         return jax.default_backend() != "cpu"
+
+    def _decode_mode(self) -> str:
+        """Resolved [scan.decode] mode: HORAEDB_DEVICE_DECODE=1/0
+        forces device/host over the config (the bench/chaos override
+        convention of HORAEDB_FUSED_AGG and friends)."""
+        import os
+
+        forced = os.environ.get("HORAEDB_DEVICE_DECODE", "")
+        if forced == "1":
+            return "device"
+        if forced == "0":
+            return "host"
+        return self.config.scan.decode.mode
+
+    def _device_decode_plan_ok(self, plan: ScanPlan,
+                               count: bool = True) -> bool:
+        """Plan-level gate for the fused device-decode dispatch
+        (ops/device_decode.py) — the decode twin of fused_aggregate_ok.
+        Per-reason fallbacks are counted (scan_decode_fallback_total)
+        unless `count` is False (the fused gate probes without
+        recording, or structural misses would double-count).
+
+        "auto" engages on accelerator backends for plans the fused
+        aggregate declines on its own terms (the oversized-cold shape
+        whose windows can't pin in RAM anyway); "device" forces the
+        dispatch wherever structurally possible; "host" is the
+        bit-identity control.  Per-SEGMENT gates (encodings, dtype,
+        upload budget) live in _dispatch_device_decode."""
+        mode = self._decode_mode()
+        if mode == "host":
+            return False
+        if mode == "auto":
+            import jax
+
+            if jax.default_backend() == "cpu":
+                # host numpy decode measured faster than XLA-CPU device
+                # programs on this backend (the host_agg trade)
+                return False
+            if self._fused_agg_ok_base(plan):
+                return False  # fused keeps the warm/replay path
+        note = device_decode.note_fallback if count else (lambda _r: None)
+        if self.mesh is not None:
+            note("mesh")
+            return False
+        if plan.mode is not UpdateMode.OVERWRITE:
+            note("append_mode")
+            return False
+        if plan.predicate is not None and not plan.pushed_complete:
+            # value-column leaves interact with last-value dedup and
+            # Or/Not shapes have no pushed conjunction — host decode
+            # evaluates those post-merge.  Checked BEFORE the sidecar
+            # gate: an unpushable predicate also fails that one, and
+            # "predicate" is the reason an operator can act on
+            note("predicate")
+            return False
+        if not device_decode.leaf_shape_supported(plan.prune_leaves):
+            note("predicate")
+            return False
+        if not self._sidecar_plan_ok(plan):
+            note("no_sidecar")
+            return False
+        return True
 
     async def execute_aggregate_fused(self, plan: ScanPlan,
                                       spec: AggregateSpec,
@@ -2039,6 +2229,16 @@ class ParquetReader:
                "aggregate pushdown requires Overwrite mode")
         from collections import deque
 
+        # device-native decode ([scan.decode]): eligible plans thread
+        # the aggregate spec to the decode stage, which uploads each
+        # EncodedSegment's raw encoded buffers and fuses filter +
+        # merge-dedup + bucket-aggregate into ONE device dispatch —
+        # finished per-segment parts come back instead of host windows
+        # (ops/device_decode.py; host decode is the bit-identity
+        # control).  The copy keeps the caller's plan reusable.
+        if self._device_decode_plan_ok(plan):
+            plan = dc_replace(plan, decode_spec=spec)
+
         # delta summation: segments whose partials are memoized (same
         # SST set + compatible bucket grid) are served up front and
         # dropped from the scan plan entirely — a narrowed/refined
@@ -2149,6 +2349,18 @@ class ParquetReader:
                             # same semantics as the row path: post-dedup
                             # rows
                             _ROWS_SCANNED.inc(w.n_valid)
+                            if isinstance(w, device_decode.DevicePart):
+                                # already a finished aggregate partial;
+                                # rides the queue (prep=None) so a
+                                # segment's parts keep window order.
+                                # Provably-empty parts never enqueue —
+                                # a pending[] count that no flush entry
+                                # repays would park the segment (and
+                                # every later one) at the stream
+                                # head-of-line until end-of-scan
+                                if w.part is not None:
+                                    out.append((w, None))
+                                continue
                             prep = self._window_groups(w, spec, plan)
                             if prep is not None:
                                 out.append((w, prep))
@@ -2605,7 +2817,37 @@ class ParquetReader:
         in item order; every part shares the round's union group values
         (rows a window didn't touch have count 0 and fold away in the
         combiner).  Rounds are padded to the full batch width with empty
-        windows so one program shape serves every flush."""
+        windows so one program shape serves every flush.
+
+        Device-decode entries (prep None, window a DevicePart) pass
+        through in position — their grids were computed by the fused
+        dispatch — so a segment's parts fold in window order whichever
+        route each window took."""
+        has_device = any(prep is None for _s, _w, prep in items)
+        if has_device:
+            out: list = [None] * len(items)
+            host_pos: list[int] = []
+            host_items: list = []
+            for i, (s, w, prep) in enumerate(items):
+                if prep is None:
+                    if w.part is not None:
+                        out[i] = (s, w.part)
+                else:
+                    host_pos.append(i)
+                    host_items.append((s, w, prep))
+            if host_items:
+                for i, p in zip(host_pos, self._flush_host_round(
+                        host_items, spec, plan)):
+                    out[i] = p
+            return [p for p in out if p is not None]
+        return [p for p in self._flush_host_round(items, spec, plan)
+                if p is not None]
+
+    def _flush_host_round(self, items: list, spec: AggregateSpec,
+                          plan: ScanPlan) -> list:
+        """One round of HOST-decoded windows aggregated by the batched
+        kernel (or its numpy twin) — returns one entry per item, None
+        for windows that contribute nothing."""
         if self._host_agg_ok() and all(
                 isinstance(it[1].columns[spec.ts_col], np.ndarray)
                 for it in items):
@@ -2813,8 +3055,10 @@ def _host_window_partials(items: list, spec: AggregateSpec,
     scan-cached windows skip row aggregation entirely.  Grid
     conventions (combine identities, f32 cells, later-row last
     tie-break) match the device kernel, so combine_aggregate_parts
-    cannot tell the paths apart.  Returns [(seg_start, (values, lo,
-    grids))] like _flush_window_batch."""
+    cannot tell the paths apart.  Returns one entry per item —
+    (seg_start, (values, lo, grids)) or None for a window that
+    contributes nothing — aligned so _flush_window_batch can merge
+    routes by position."""
     t_dev = time.perf_counter()
     want = frozenset(spec.which) | (
         {"sum"} if "avg" in spec.which else set())
@@ -2846,6 +3090,7 @@ def _host_window_partials(items: list, spec: AggregateSpec,
                     int(a.nbytes) for a in full[1].values())
                 _memo_store(w, key, full, nbytes)
         if full is None:
+            parts.append(None)
             continue
         A0, grids_full = full
         W = grids_full["count"].shape[1]
@@ -2855,6 +3100,7 @@ def _host_window_partials(items: list, spec: AggregateSpec,
         lo = max(0, lo_q)
         w_eff = min(W - cut, spec.num_buckets - lo)
         if w_eff <= 0:
+            parts.append(None)
             continue
         sl = slice(cut, cut + w_eff)
         grids = {k: v[:, sl] for k, v in grids_full.items()

@@ -461,12 +461,21 @@ class EncodedSegment:
     """One segment's rows straight from sidecars — the parquet-free twin
     of the Arrow table `_read_segment_table` returns.  Columns are
     unpadded, filtered (prune leaves applied), concatenated in SST/run
-    order, ready for the merge's window prep."""
+    order, ready for the merge's window prep.
+
+    `pending_leaves` is set (a list, possibly empty) when the assemble
+    DEFERRED the exact leaf mask for the device-decode dispatch
+    (ops/device_decode.py): the fused program evaluates the conjunction
+    in encoded space on device, so the host never compacts rows.  None
+    means leaves were applied at assemble (the host-decode contract);
+    a host fallback for a deferred segment must apply_leaves_host
+    first."""
 
     columns: dict
     encodings: dict
     n: int
     names: list
+    pending_leaves: Optional[list] = None
 
     @property
     def num_rows(self) -> int:
@@ -475,6 +484,34 @@ class EncodedSegment:
     @property
     def nbytes(self) -> int:
         return sum(int(a.nbytes) for a in self.columns.values())
+
+
+def apply_leaves_host(es: EncodedSegment) -> EncodedSegment:
+    """Resolve a deferred leaf conjunction on host — the fallback when
+    a device-decode-routed segment turns out ineligible at dispatch
+    (unsupported encoding/dtype/budget): the exact mask+compaction
+    assemble_parts would have done, so the host window path receives
+    the filtered rows it expects.  No-op for segments with nothing
+    pending."""
+    from horaedb_tpu.ops import filter as filter_ops
+
+    leaves = es.pending_leaves
+    if not leaves:
+        if leaves is not None:
+            es.pending_leaves = None
+        return es
+    cols = es.columns
+    if es.n:
+        batch = encode.DeviceBatch(columns=cols, encodings=es.encodings,
+                                   n_valid=es.n, capacity=es.n)
+        mask = np.asarray(filter_ops.eval_predicate(
+            filter_ops.And(tuple(leaves)), batch))
+        if not mask.all():
+            idx = np.flatnonzero(mask)
+            cols = {nm: a[idx] for nm, a in cols.items()}
+    n = len(next(iter(cols.values()))) if cols else 0
+    return EncodedSegment(columns=cols, encodings=es.encodings, n=n,
+                          names=es.names, pending_leaves=None)
 
 
 def assemble_segment(bufs: list[bytes], columns: list,

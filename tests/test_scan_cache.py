@@ -488,7 +488,8 @@ def test_stats_cache_section(runtimes):
             stats = s.reader.cache_stats()
             assert set(stats) == {"scan_cache", "encoded_cache",
                                   "stack_cache", "pipeline",
-                                  "parts_memo"}
+                                  "parts_memo", "decode"}
+            assert stats["decode"]["mode"] == "auto"
             assert stats["pipeline"]["enabled"] is True
             assert stats["encoded_cache"]["entries"] == 1
             assert stats["encoded_cache"]["admissions"] == 1

@@ -47,6 +47,12 @@ Checks, all hard failures:
     through `loops.spawn(...)` so every one is registered, heartbeats,
     and appears in GET /debug/tasks (a loop born unwatched is a loop
     that hangs unseen; docs/observability.md, background plane)
+  - EncodedSegment decode discipline under horaedb_tpu/: host-decoding
+    a sidecar's encoded buffers (deserialize / assemble / concat /
+    decode_column ...) outside storage/sidecar.py, ops/ and the
+    reader's dispatch seam is an error — decode goes through the
+    reader so the fused device dispatch (ops/device_decode.py) can
+    serve eligible plans instead of silently re-growing host decode
   - combine grid discipline under horaedb_tpu/: allocating a dense
     `(groups, num_buckets)`-shaped array (np.zeros/full/empty/ones
     with a 2-tuple shape whose second element is named like a bucket
@@ -263,6 +269,43 @@ def _unwatched_loop_spawn(node: ast.Call) -> bool:
     return "loop" in callee.lower()
 
 
+# EncodedSegment decode discipline: the sidecar's encoded buffers are
+# host-decoded ONLY inside the dispatch seam — storage/sidecar.py (the
+# format), ops/ (the encode/decode primitives and the fused device
+# dispatch), storage/read.py (the reader's routing) and
+# storage/compaction.py (the write-side merge that builds sidecars).
+# A new call site elsewhere silently reintroduces host decode behind
+# the device-native path's back (ISSUE 12 / ROADMAP item 2): decode
+# goes through the reader, which knows whether the fused device
+# dispatch should serve the plan instead.
+_DECODE_SEAM_FILES = {"sidecar.py", "read.py", "compaction.py"}
+_DECODE_ENTRY_POINTS = {"deserialize", "assemble_parts",
+                        "assemble_segment", "concat_encoded",
+                        "merge_parts", "load_sst_encoded",
+                        "decode_column", "decode_to_arrow",
+                        "apply_leaves_host"}
+# names distinctive enough to flag even as bare calls (a bare
+# `deserialize(...)` could be anything; these cannot)
+_DECODE_DISTINCT = _DECODE_ENTRY_POINTS - {"deserialize", "merge_parts"}
+_DECODE_RECEIVER_TOKENS = ("sidecar", "encode")
+
+
+def _host_decode_outside_seam(node: ast.Call) -> bool:
+    """True for `sidecar.deserialize(...)` / `encode.decode_column(...)`
+    / bare `assemble_parts(...)`-shaped calls — EncodedSegment decode
+    primitives invoked outside the dispatch seam."""
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        if func.attr not in _DECODE_ENTRY_POINTS:
+            return False
+        return any(tok in part.lower()
+                   for part in _receiver_chain(func)
+                   for tok in _DECODE_RECEIVER_TOKENS)
+    if isinstance(func, ast.Name):
+        return func.id in _DECODE_DISTINCT
+    return False
+
+
 # metric-factory methods on a registry object; any such call under
 # horaedb_tpu/ must pass non-empty help text (positional or help_=)
 _METRIC_FACTORIES = {"counter", "gauge", "histogram"}
@@ -450,6 +493,19 @@ def lint_file(path: pathlib.Path) -> list[str]:
                     "output-grid cliff grows back one grid at a time; "
                     "go through the combine API (combine_parts / "
                     "combine_top_k / merge_downsample_results)")
+        elif (isinstance(node, ast.Call) and "horaedb_tpu" in path.parts
+                and "ops" not in path.parts
+                and path.name not in _DECODE_SEAM_FILES
+                and _host_decode_outside_seam(node)):
+            src = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+            if "noqa" not in src:
+                problems.append(
+                    f"{path}:{node.lineno}: EncodedSegment encoded "
+                    "buffers host-decoded outside the dispatch seam "
+                    "(storage/sidecar.py, ops/, the reader) — new call "
+                    "sites silently reintroduce the host decode the "
+                    "device-native path removed; route reads through "
+                    "the reader (ops/device_decode.py)")
         elif (isinstance(node, ast.Call) and "horaedb_tpu" in path.parts
                 and _metric_call_without_help(node)):
             src = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
