@@ -33,6 +33,9 @@ TENANT_SCHEDULES ?= 20
 DECODE_SEED ?= 1337
 DECODE_SCHEDULES ?= 20
 
+SCANAGENT_SEED ?= 1337
+SCANAGENT_SCHEDULES ?= 15
+
 chaos:
 	TORTURE_SEED=$(TORTURE_SEED) TORTURE_SCHEDULES=$(TORTURE_SCHEDULES) \
 	WAL_TORTURE_SEED=$(WAL_TORTURE_SEED) \
@@ -49,11 +52,14 @@ chaos:
 	TENANT_SCHEDULES=$(TENANT_SCHEDULES) \
 	DECODE_SEED=$(DECODE_SEED) \
 	DECODE_SCHEDULES=$(DECODE_SCHEDULES) \
+	SCANAGENT_SEED=$(SCANAGENT_SEED) \
+	SCANAGENT_SCHEDULES=$(SCANAGENT_SCHEDULES) \
 	python -m pytest tests/test_fault_injection.py tests/test_torture.py \
 	tests/test_objstore_middleware.py tests/test_wal.py \
 	tests/test_scan_cache.py tests/test_rollup.py \
 	tests/test_pipeline.py tests/test_combine.py \
-	tests/test_tenant.py tests/test_device_decode.py -q
+	tests/test_tenant.py tests/test_device_decode.py \
+	tests/test_scanagent.py -q
 
 # stdlib AST lint gate (the reference CI runs fmt+clippy -D warnings;
 # this image ships no ruff/flake8, so the gate is tools/lint.py)

@@ -22,15 +22,17 @@ backend — such numbers are NOT device numbers.
 
 Env knobs: BENCH_ROWS (default 10_000_000), BENCH_ITERS (default 20),
 BENCH_CONFIG (default 1 = end-to-end engine; 0 = device kernel
-microbench; 2-16 delegate to horaedb_tpu.bench.suite, 6 being the
+microbench; 2-17 delegate to horaedb_tpu.bench.suite, 6 being the
 manifest snapshot codec, 7 the mixed read/write churn workload,
 8 the durable-ingest WAL group-commit bench, 9 the tiered scan-cache
 cold ladder, 10 the query-tracing overhead A/B, 11 the
 standing-rollup dashboard mix vs the raw cold scan, 12 the
 background-plane overhead A/B, 13 the pipelined cold-scan ladder
 vs the [scan.pipeline] off control, 14 the sparse-combine/top-k/memo
-ladder, 15 the open-loop multi-tenant SLO harness, and 16 the
-device-native decode A/B vs the [scan.decode] host control).
+ladder, 15 the open-loop multi-tenant SLO harness, 16 the
+device-native decode A/B vs the [scan.decode] host control, and 17
+the near-data scan-agent dashboard mix — agent-served partials vs
+shipped segments over the seeded fault store).
 """
 
 import asyncio
@@ -535,7 +537,7 @@ def main() -> None:
     try:
         config = int(os.environ.get("BENCH_CONFIG", 1))
     except ValueError:
-        sys.exit(f"BENCH_CONFIG must be 0-16, got "
+        sys.exit(f"BENCH_CONFIG must be 0-17, got "
                  f"{os.environ.get('BENCH_CONFIG')!r}")
 
     ensure_responsive_backend()
@@ -551,7 +553,7 @@ def main() -> None:
         from horaedb_tpu.bench.suite import RUNNERS
 
         if config not in RUNNERS:
-            sys.exit(f"BENCH_CONFIG must be 0-16, got {config}")
+            sys.exit(f"BENCH_CONFIG must be 0-17, got {config}")
         result = RUNNERS[config](rows, iters)
     # a config's own backend/fallback labels win (config 6 is pure host
     # work and must never read as a device number)

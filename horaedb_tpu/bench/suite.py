@@ -2991,11 +2991,317 @@ def run_config16(rows: int, iters: int) -> dict:
     }
 
 
+def run_config17(rows: int, iters: int) -> dict:
+    """Near-data scan agents (ISSUE 13): the cold dashboard mix over a
+    seeded 25 ms-latency object store, agent-served partials vs the
+    direct scan.
+
+    Legs:
+      off          no router — every covered segment's parquet/sidecar
+                   bytes ship to the coordinator (the control)
+      agent        [scanagent] routes every segment to an agent
+                   colocated with the store (raw inner store: near the
+                   data there is no WAN hop) — the coordinator's
+                   data-plane bytes become O(groups x buckets x aggs)
+                   partials
+      agent_killed the agent dies mid-run — queries complete through
+                   the per-segment fallback (direct reads), accounted
+      disk         a LocalObjectStore-backed rung: the coordinator
+                   issues ZERO segment reads on the agent path (no
+                   segment is ever resident there), and the dead-agent
+                   fallback STREAMS whole SSTs chunk-wise
+                   (get_stream -> file-backed mmap) instead of
+                   buffering them in RSS
+
+    Done-bar: coordinator data-plane bytes (store bytes + received
+    partial bytes) reduced >= 5x on the agent leg, grids byte-identical
+    with the off leg (asserted in-bench)."""
+    import os
+    import shutil
+    import tempfile
+
+    import pyarrow as pa
+
+    from horaedb_tpu.metric_engine import MetricEngine
+    from horaedb_tpu.objstore import (
+        FaultInjectingStore,
+        LocalObjectStore,
+        MemoryObjectStore,
+        WrappedObjectStore,
+    )
+    from horaedb_tpu.scanagent import (
+        AgentService,
+        AgentSpec,
+        ScanAgentConfig,
+    )
+    from horaedb_tpu.scanagent import client as sa_client
+    from horaedb_tpu.storage import parquet_io
+    from horaedb_tpu.storage.config import StorageConfig, from_dict
+    from horaedb_tpu.storage.types import TimeRange
+
+    class DataByteCounter(WrappedObjectStore):
+        """Coordinator-side data-plane accounting: bytes and ops of
+        the DATA table's .sst/.enc reads (index/series/metrics lookups
+        are identical across legs and not segment shipping), buffered
+        AND streamed.  Hides local_path so the disk rung's fallback
+        reads go through the countable get/get_stream surface."""
+
+        def __init__(self, inner, prefix: str):
+            super().__init__(inner)
+            self.prefix = prefix
+            self.data_bytes = 0
+            self.data_gets = 0
+            self.stream_ops = 0
+
+        def _is_data(self, path) -> bool:
+            p = str(path)
+            return p.startswith(self.prefix) \
+                and p.endswith((".sst", ".enc"))
+
+        async def _call(self, op: str, *args):
+            out = await super()._call(op, *args)
+            if op in ("get", "get_range") and self._is_data(args[0]):
+                self.data_gets += 1
+                self.data_bytes += len(out)
+            return out
+
+        async def _stream(self, op: str, path: str, chunk_size: int):
+            counted = self._is_data(path)
+            if counted:
+                self.data_gets += 1
+                self.stream_ops += 1
+            async for chunk in self.inner.get_stream(path, chunk_size):
+                if counted:
+                    self.data_bytes += len(chunk)
+                yield chunk
+
+    lat_s = float(os.environ.get("BENCH_STORE_LATENCY_MS", "25")) / 1e3
+    hosts = 100
+    interval = 10_000
+    per_host = max(60, rows // hosts)
+    span = per_host * interval
+    segment_ms = 2 * 3600 * 1000
+    T0 = (1_700_000_000_000 // segment_ms) * segment_ms
+    rng = np.random.default_rng(17)
+    n = per_host * hosts
+    ts = T0 + np.repeat(
+        np.arange(per_host, dtype=np.int64) * interval, hosts)
+    host_id = np.tile(np.arange(hosts, dtype=np.int32), per_host)
+    vals = (rng.random(n) * 100).astype(np.float64)
+    names = pa.array([f"host_{i:03d}" for i in range(hosts)])
+    _check_i32_span(np.asarray([span]), "config17")
+    reps = max(2, iters // 3)
+
+    cfg = from_dict(StorageConfig, {
+        "scheduler": {"schedule_interval": "1h"},
+        "scan": {"cache_max_rows": n * 4},
+    })
+
+    async def ingest(e):
+        chunk = max(1, 1_000_000 // hosts) * hosts
+        for lo in range(0, n, chunk):
+            hi = min(n, lo + chunk)
+            await e.write_arrow("cpu", ["host"], pa.record_batch({
+                "host": pa.DictionaryArray.from_arrays(
+                    pa.array(host_id[lo:hi]), names),
+                "timestamp": pa.array(ts[lo:hi], type=pa.int64()),
+                "value": pa.array(vals[lo:hi], type=pa.float64()),
+            }))
+
+    zoom_ms = min(span, 6 * 3600 * 1000)
+
+    async def mix(e, rep: int) -> list:
+        """The cold dashboard mix: one full-span 1h overview + two
+        rotating zooms at 1m resolution.  Returns the grids for the
+        bit-identity cross-check."""
+        out = [await e.query_downsample(
+            "cpu", [], TimeRange.new(T0, T0 + span),
+            bucket_ms=3_600_000, aggs=("avg",))]
+        for z in range(2):
+            lo = T0 + ((rep * 2 + z) * zoom_ms) % max(1, span - zoom_ms + 1)
+            out.append(await e.query_downsample(
+                "cpu", [], TimeRange.new(lo, lo + zoom_ms),
+                bucket_ms=60_000, aggs=("avg", "max")))
+        return out
+
+    def grids_bytes(results: list) -> bytes:
+        buf = bytearray()
+        for r in results:
+            buf += np.asarray(r["tsids"], dtype=np.uint64).tobytes()
+            for k in sorted(r["aggs"]):
+                buf += np.asarray(r["aggs"][k]).tobytes()
+        return bytes(buf)
+
+    async def timed_mix(e, counter, reset, label: str) -> dict:
+        times = []
+        partials0 = sa_client._PARTIAL_BYTES.value
+        bytes0, gets0 = counter.data_bytes, counter.data_gets
+        grids = None
+        for rep in range(reps):
+            reset()
+            t0 = time.perf_counter()
+            got = await mix(e, rep)
+            times.append(time.perf_counter() - t0)
+            if grids is None:
+                grids = grids_bytes(got)
+        leg = {
+            "p50_ms": round(float(np.percentile(times, 50)) * 1e3, 3),
+            "store_data_bytes": counter.data_bytes - bytes0,
+            "store_data_gets": counter.data_gets - gets0,
+            "partial_bytes":
+                int(sa_client._PARTIAL_BYTES.value - partials0),
+        }
+        leg["coordinator_bytes"] = (leg["store_data_bytes"]
+                                    + leg["partial_bytes"])
+        _log(f"config17 {label}: p50 {leg['p50_ms']}ms, "
+             f"store {leg['store_data_bytes']}B "
+             f"({leg['store_data_gets']} gets) + partials "
+             f"{leg['partial_bytes']}B")
+        return {"leg": leg, "grids": grids}
+
+    async def go():
+        out: dict = {"store_latency_ms": lat_s * 1e3, "rows": n,
+                     "reps_per_leg": reps}
+        inner = MemoryObjectStore()
+        coord_store = DataByteCounter(FaultInjectingStore(
+            inner, seed=17, latency_range=(lat_s, lat_s)),
+            prefix="cfg17/data/")
+        # ingest once (direct engine, no router)
+        e = await MetricEngine.open("cfg17", coord_store,
+                                    segment_ms=segment_ms, config=cfg)
+        try:
+            await ingest(e)
+            data = e.tables["data"]
+            # ---- off: the direct-scan control ------------------------
+            off = await timed_mix(
+                e, coord_store, lambda: _clear_scan_tiers(data), "off")
+            out["off"] = off["leg"]
+        finally:
+            await e.close()
+
+        # ---- agent: near-data routing via [scanagent] ----------------
+        agent = AgentService(inner)  # colocated: raw store, no WAN hop
+        url = await agent.start()
+        sa_cfg = ScanAgentConfig(
+            mode="on", num_slots=1,
+            agents=(AgentSpec("shard0", url, (0,)),))
+        e = await MetricEngine.open("cfg17", coord_store,
+                                    segment_ms=segment_ms, config=cfg,
+                                    scanagent_config=sa_cfg)
+        try:
+            data = e.tables["data"]
+            served = await timed_mix(
+                e, coord_store, lambda: _clear_scan_tiers(data),
+                "agent")
+            out["agent"] = served["leg"]
+            assert served["grids"] == off["grids"], \
+                "agent-served grids differ from the direct scan"
+            out["bit_identical"] = True
+            reduction = (off["leg"]["coordinator_bytes"]
+                         / max(1, served["leg"]["coordinator_bytes"]))
+            out["bytes_reduction_x"] = round(reduction, 2)
+            out["bar_bytes_reduction"] = ">=5x"
+            out["bar_bytes_reduction_met"] = bool(reduction >= 5.0)
+
+            # ---- agent_killed: fallback correctness + accounting -----
+            fb0 = sa_client._FALLBACKS.total
+            await agent.close()
+            killed = await timed_mix(
+                e, coord_store, lambda: _clear_scan_tiers(data),
+                "agent_killed")
+            out["agent_killed"] = killed["leg"]
+            out["agent_killed"]["fallback_segments"] = \
+                int(sa_client._FALLBACKS.total - fb0)
+            assert killed["grids"] == off["grids"], \
+                "fallback grids differ from the direct scan"
+        finally:
+            await e.close()
+            await agent.close()
+
+        # ---- disk rung: nothing resident on the coordinator ----------
+        tmp = tempfile.mkdtemp(prefix="cfg17-disk-")
+        disk_agent = None
+        try:
+            local = LocalObjectStore(tmp)
+            disk_store = DataByteCounter(local, prefix="cfg17d/data/")
+            e = await MetricEngine.open("cfg17d", disk_store,
+                                        segment_ms=segment_ms,
+                                        config=cfg)
+            try:
+                await ingest(e)
+            finally:
+                await e.close()
+            disk_agent = AgentService(local)  # mmap-fast shard reads
+            url = await disk_agent.start()
+            sa_cfg = ScanAgentConfig(
+                mode="on", num_slots=1,
+                agents=(AgentSpec("shard0", url, (0,)),))
+            e = await MetricEngine.open("cfg17d", disk_store,
+                                        segment_ms=segment_ms,
+                                        config=cfg,
+                                        scanagent_config=sa_cfg)
+            try:
+                data = e.tables["data"]
+                disk = await timed_mix(
+                    e, disk_store, lambda: _clear_scan_tiers(data),
+                    "disk")
+                out["disk"] = disk["leg"]
+                # the near-data claim, literally: the coordinator read
+                # zero segment objects — nothing to hold resident
+                assert disk["leg"]["store_data_gets"] == 0, \
+                    "coordinator read segments on the disk agent rung"
+                out["disk"]["segments_resident_coordinator"] = 0
+
+                # dead-agent fallback on disk STREAMS whole SSTs
+                # (get_stream -> file-backed mmap, not a bytes buffer)
+                await disk_agent.close()
+                old_min = parquet_io.STREAM_FETCH_MIN_BYTES
+                parquet_io.STREAM_FETCH_MIN_BYTES = 1
+                try:
+                    _clear_scan_tiers(data)
+                    # sidecar fetches (.enc) still buffer — only SSTs
+                    # take the parquet path; force it by dropping
+                    # sidecar reads for this leg
+                    data.config.scan.use_sidecar = False
+                    t0 = time.perf_counter()
+                    await mix(e, 0)
+                    fb_ms = (time.perf_counter() - t0) * 1e3
+                finally:
+                    parquet_io.STREAM_FETCH_MIN_BYTES = old_min
+                    data.config.scan.use_sidecar = True
+                out["disk_fallback"] = {
+                    "p50_ms": round(fb_ms, 3),
+                    "streamed_sst_reads": disk_store.stream_ops,
+                }
+                assert disk_store.stream_ops > 0, \
+                    "dead-agent disk fallback did not stream SSTs"
+            finally:
+                await e.close()
+        finally:
+            if disk_agent is not None:
+                await disk_agent.close()
+            shutil.rmtree(tmp, ignore_errors=True)
+        return out
+
+    out = asyncio.run(go())
+    return {
+        "metric": (f"near-data scan agents: cold dashboard mix over a "
+                   f"seeded {out['store_latency_ms']:.0f}ms-latency "
+                   f"store, {n / 1e6:.1f}M rows, agent partials vs "
+                   f"shipped segments"),
+        "value": out["agent"]["p50_ms"],
+        "unit": "ms",
+        # done-bar: coordinator data-plane bytes, off / agent
+        "vs_baseline": out["bytes_reduction_x"],
+        **out,
+    }
+
+
 RUNNERS = {2: run_config2, 3: run_config3, 4: run_config4, 5: run_config5,
            6: run_config6, 7: run_config7, 8: run_config8, 9: run_config9,
            10: run_config10, 11: run_config11, 12: run_config12,
            13: run_config13, 14: run_config14, 15: run_config15,
-           16: run_config16}
+           16: run_config16, 17: run_config17}
 
 
 def main() -> None:

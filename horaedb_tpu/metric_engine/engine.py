@@ -493,7 +493,8 @@ class MetricEngine:
                    chunked_data: bool = False,
                    chunk_window_ms: int = 30 * 60 * 1000,
                    wal_config=None, rollup_config=None,
-                   meta_config=None) -> "MetricEngine":
+                   meta_config=None, scanagent_config=None
+                   ) -> "MetricEngine":
         import dataclasses
 
         if chunked_data:
@@ -601,9 +602,33 @@ class MetricEngine:
             except BaseException:
                 await self.close()
                 raise
+        if (scanagent_config is not None and scanagent_config.active
+                and not chunked_data):
+            # near-data scan routing ([scanagent]): the DATA table's
+            # aggregate scans — the cold dashboard path — consult the
+            # shard map and route covered segments to their store-shard
+            # agents (scanagent/client.py).  The index/series/tags
+            # tables stay direct: their scans are row-shaped and tiny.
+            from horaedb_tpu.scanagent import ScanAgentClient, ScanRouter
+
+            try:
+                self._scanagent_client = ScanAgentClient(scanagent_config)
+                data = tables["data"]
+                base = getattr(data, "inner", data)  # unwrap WAL front
+                base.reader.scan_router = ScanRouter(
+                    scanagent_config, self._scanagent_client,
+                    base.root_path, base.schema().user_schema,
+                    base.schema().num_primary_keys,
+                    base.segment_duration_ms)
+            except BaseException:
+                await self.close()
+                raise
         return self
 
     async def close(self) -> None:
+        if getattr(self, "_scanagent_client", None) is not None:
+            await self._scanagent_client.close()
+            self._scanagent_client = None
         if self.meta is not None:
             # the meta scraper writes through this engine: stop it
             # before anything under it goes away

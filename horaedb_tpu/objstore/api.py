@@ -19,6 +19,12 @@ class ObjectMeta:
     size: int
 
 
+# default chunk for streamed whole-object reads (get_stream): large
+# enough to amortize per-chunk overhead, small enough that a stream's
+# resident footprint stays two orders of magnitude under a big SST
+DEFAULT_STREAM_CHUNK = 8 << 20
+
+
 class ObjectStore(abc.ABC):
     """Async key→bytes store; paths are '/'-separated keys, not OS paths."""
 
@@ -52,6 +58,23 @@ class ObjectStore(abc.ABC):
     @abc.abstractmethod
     async def list(self, prefix: str) -> list[ObjectMeta]:
         """All objects whose path starts with `prefix`, sorted by path."""
+
+    async def get_stream(self, path: str,
+                         chunk_size: int = DEFAULT_STREAM_CHUNK):
+        """Read the whole object as an async iterator of byte chunks.
+
+        Streaming-capable backends bound peak RSS by `chunk_size` —
+        Local reads file chunks, S3 issues ranged GETs — so a whole-SST
+        fetch of a multi-GiB object never materializes it in the
+        caller's memory (the consumer decides where the bytes land:
+        a spooled temp file for parquet decode, a socket for a proxy).
+        This default falls back to ONE `get` (correct for the in-RAM
+        memory store, where the object IS a resident buffer already)
+        and re-chunks it, so every store satisfies the contract.
+        Raises NotFoundError like get()."""
+        data = await self.get(path)
+        for off in range(0, len(data), max(1, chunk_size)):
+            yield data[off:off + chunk_size]
 
     async def put_stream(self, path: str, chunks) -> int:
         """Atomically create/replace `path` from an async iterator of

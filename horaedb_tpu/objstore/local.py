@@ -13,7 +13,12 @@ import os
 import tempfile
 
 from horaedb_tpu.common.error import Error
-from horaedb_tpu.objstore.api import NotFoundError, ObjectMeta, ObjectStore
+from horaedb_tpu.objstore.api import (
+    DEFAULT_STREAM_CHUNK,
+    NotFoundError,
+    ObjectMeta,
+    ObjectStore,
+)
 
 
 class LocalObjectStore(ObjectStore):
@@ -86,6 +91,23 @@ class LocalObjectStore(ObjectStore):
                 raise NotFoundError(f"object not found: {path}") from None
 
         return await asyncio.to_thread(_get)
+
+    async def get_stream(self, path: str,
+                         chunk_size: int = DEFAULT_STREAM_CHUNK):
+        """File chunks: peak RSS is one chunk, whatever the object
+        size."""
+        try:
+            f = await asyncio.to_thread(open, self._fs_path(path), "rb")
+        except FileNotFoundError:
+            raise NotFoundError(f"object not found: {path}") from None
+        try:
+            while True:
+                chunk = await asyncio.to_thread(f.read, chunk_size)
+                if not chunk:
+                    return
+                yield chunk
+        finally:
+            await asyncio.to_thread(f.close)
 
     async def get_range(self, path: str, start: int, end: int) -> bytes:
         def _get_range() -> bytes:

@@ -35,11 +35,17 @@ from dataclasses import dataclass
 from typing import Optional
 
 from horaedb_tpu.common.error import Error
-from horaedb_tpu.objstore.api import NotFoundError, ObjectMeta, ObjectStore
+from horaedb_tpu.objstore.api import (
+    DEFAULT_STREAM_CHUNK,
+    NotFoundError,
+    ObjectMeta,
+    ObjectStore,
+)
 from horaedb_tpu.objstore.memory import MemoryObjectStore
 from horaedb_tpu.utils import registry, tracing
 
-OPS = ("put", "get", "get_range", "head", "delete", "list", "put_stream")
+OPS = ("put", "get", "get_range", "head", "delete", "list",
+       "put_stream", "get_stream")
 
 
 class WrappedObjectStore(ObjectStore):
@@ -79,6 +85,20 @@ class WrappedObjectStore(ObjectStore):
         # replays a stream, and no middleware may buffer it (the
         # backend's own put_stream owns its atomicity/cleanup story)
         return await self._call("put_stream", path, chunks)
+
+    def get_stream(self, path: str,
+                   chunk_size: int = DEFAULT_STREAM_CHUNK):
+        # streamed reads delegate through _stream (the async-generator
+        # twin of _call) so the INNER store's chunking survives
+        # wrapping; like put_stream, streams are one-shot — the retry
+        # layer never replays one (data-plane reads are single-shot by
+        # the engine's retry discipline anyway)
+        return self._stream("get_stream", path, chunk_size)
+
+    async def _stream(self, op: str, path: str, chunk_size: int):
+        del op  # interception point for subclasses
+        async for chunk in self.inner.get_stream(path, chunk_size):
+            yield chunk
 
     async def close(self) -> None:
         closer = getattr(self.inner, "close", None)
@@ -255,11 +275,13 @@ class _FaultRule:
     mode: str = "before"  # "before": op never ran; "after": ack lost
 
     def matches(self, op: str, path: str) -> bool:
-        # "put" rules cover put_stream too: both are object writes, and
-        # which one a code path uses is an implementation detail the
-        # fault script should not have to know
-        op_ok = self.op in ("*", op) or (self.op == "put"
-                                         and op == "put_stream")
+        # "put" rules cover put_stream too (and "get" covers
+        # get_stream): both are object writes/reads, and which variant
+        # a code path uses is an implementation detail the fault script
+        # should not have to know
+        op_ok = (self.op in ("*", op)
+                 or (self.op == "put" and op == "put_stream")
+                 or (self.op == "get" and op == "get_stream"))
         return op_ok and self.path_part in path
 
 
@@ -358,6 +380,25 @@ class FaultInjectingStore(WrappedObjectStore):
             raise InjectedFault(f"injected lost-ack {op} failure for {path}")
         return result
 
+    async def _stream(self, op: str, path: str, chunk_size: int):
+        """Streamed reads take the same injection points as get: the
+        fault/crash fires at stream START (a read that dies mid-stream
+        is indistinguishable from one that never started — callers see
+        an exception either way, and reads have no ack to lose)."""
+        if self.halted:
+            raise InjectedFault(f"store halted (crashed): {op} {path}")
+        self.ops_seen += 1
+        if self.latency_range[1] > 0:
+            await asyncio.sleep(self._rng.uniform(*self.latency_range))
+        if self.crash_at is not None and self.ops_seen >= self.crash_at:
+            self.crash()
+            raise InjectedCrash(f"crash before {op} {path}")
+        mode = self._scripted(op, path) or self._probabilistic(op)
+        if mode is not None:
+            raise InjectedFault(f"injected {op} failure for {path}")
+        async for chunk in self.inner.get_stream(path, chunk_size):
+            yield chunk
+
 
 # ---------------------------------------------------------------------------
 # InstrumentedStore
@@ -416,3 +457,27 @@ class InstrumentedStore(WrappedObjectStore):
                 if op in ("get", "get_range") and isinstance(
                         result, (bytes, bytearray)):
                     tracing.trace_add("objstore_get_bytes", len(result))
+
+    async def _stream(self, op: str, path: str, chunk_size: int):
+        """One get_stream op = one timed entry covering the full drain,
+        with get-style byte attribution summed over chunks."""
+        total, errors, seconds = self._ops["get_stream"]
+        total.inc()
+        t0 = time.perf_counter()
+        nbytes = 0
+        try:
+            async for chunk in self.inner.get_stream(path, chunk_size):
+                nbytes += len(chunk)
+                yield chunk
+        except NotFoundError:
+            raise
+        except BaseException:
+            errors.inc()
+            raise
+        finally:
+            dt = time.perf_counter() - t0
+            seconds.observe(dt)
+            if tracing.active_trace() is not None:
+                tracing.trace_add("objstore_get_stream_total")
+                tracing.trace_add("objstore_get_stream_ms", dt * 1e3)
+                tracing.trace_add("objstore_get_bytes", nbytes)

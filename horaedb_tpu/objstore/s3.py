@@ -43,7 +43,12 @@ import aiohttp
 import yarl
 
 from horaedb_tpu.common.error import Error
-from horaedb_tpu.objstore.api import NotFoundError, ObjectMeta, ObjectStore
+from horaedb_tpu.objstore.api import (
+    DEFAULT_STREAM_CHUNK,
+    NotFoundError,
+    ObjectMeta,
+    ObjectStore,
+)
 
 _EMPTY_SHA256 = hashlib.sha256(b"").hexdigest()
 _RETRYABLE_STATUSES = {429, 500, 502, 503, 504}
@@ -504,6 +509,20 @@ class S3ObjectStore(ObjectStore):
     async def get(self, path: str) -> bytes:
         _resp, body = await self._request("GET", path, collect=True)
         return body
+
+    async def get_stream(self, path: str,
+                         chunk_size: int = DEFAULT_STREAM_CHUNK):
+        """Chunked ranged GETs: one HEAD for the size, then sequential
+        Range reads — a whole-SST fetch holds one chunk resident
+        instead of the object.  (S3's own GET response could stream
+        too, but ranged reads keep each wire op bounded and retryable
+        by the backend's protocol-level retry loop.)"""
+        meta = await self.head(path)
+        off = 0
+        while off < meta.size:
+            end = min(meta.size, off + max(1, chunk_size))
+            yield await self.get_range(path, off, end)
+            off = end
 
     async def get_range(self, path: str, start: int, end: int) -> bytes:
         resp, data = await self._request(

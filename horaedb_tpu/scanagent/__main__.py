@@ -1,0 +1,48 @@
+"""Standalone near-data scan agent: serve aggregate partials for the
+SSTs under a local object-store directory.
+
+    python -m horaedb_tpu.scanagent --data-dir /data/shard0 --port 9201
+
+Coordinators auto-register tables over POST /v1/tables, so the agent
+needs no schema configuration of its own — point it at the shard's
+bytes and add it to the coordinator's [scanagent] map.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description="near-data scan agent")
+    parser.add_argument("--data-dir", required=True,
+                        help="local object-store root this agent serves")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=9201)
+    parser.add_argument("--max-partial-bytes", type=int,
+                        default=32 << 20)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    async def run() -> None:
+        from horaedb_tpu.objstore import LocalObjectStore
+        from horaedb_tpu.scanagent import AgentService, ScanAgentConfig
+
+        service = AgentService(
+            LocalObjectStore(args.data_dir),
+            config=ScanAgentConfig(
+                max_partial_bytes=args.max_partial_bytes))
+        url = await service.start(args.host, args.port)
+        logging.getLogger(__name__).info("scanagent serving at %s", url)
+        try:
+            await asyncio.Event().wait()
+        finally:
+            await service.close()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -21,6 +21,7 @@ from horaedb_tpu.common.tenant import TenantsConfig, tenants_from_dict
 from horaedb_tpu.cluster.breaker import BreakerConfig
 from horaedb_tpu.metric_engine.meta import MetaConfig
 from horaedb_tpu.rollup.config import RollupConfig, rollup_from_dict
+from horaedb_tpu.scanagent.config import ScanAgentConfig, scanagent_from_dict
 from horaedb_tpu.storage.config import StorageConfig, _check_scalar
 from horaedb_tpu.storage.config import from_dict as storage_from_dict
 from horaedb_tpu.wal.config import WalConfig
@@ -174,6 +175,9 @@ class ServerConfig:
     watchdog: WatchdogConfig = field(default_factory=WatchdogConfig)
     # self-monitoring meta-ingest (metric_engine/meta.py)
     meta: MetaConfig = field(default_factory=MetaConfig)
+    # near-data scan agents: shard map + routing policy (scanagent/);
+    # mode = "off" is the direct-scan bit-identity control
+    scanagent: ScanAgentConfig = field(default_factory=ScanAgentConfig)
     metric_engine: MetricEngineConfig = field(default_factory=MetricEngineConfig)
 
 
@@ -227,6 +231,9 @@ def _dc_from_dict(cls: type, data: dict[str, Any]) -> Any:
         elif key == "meta":
             ensure(isinstance(value, dict), f"{where} expects a config table")
             kwargs[key] = _dc_from_dict(MetaConfig, value)
+        elif key == "scanagent":
+            ensure(isinstance(value, dict), f"{where} expects a config table")
+            kwargs[key] = scanagent_from_dict(value)
         elif key == "metric_engine":
             ensure(isinstance(value, dict), f"{where} expects a config table")
             kwargs[key] = _dc_from_dict(MetricEngineConfig, value)

@@ -1388,7 +1388,7 @@ async def run_server(config: ServerConfig,
         chunked_data=config.metric_engine.chunked_data,
         chunk_window_ms=config.metric_engine.chunk_window.millis,
         wal_config=wal_config, rollup_config=config.rollup,
-        meta_config=config.meta)
+        meta_config=config.meta, scanagent_config=config.scanagent)
     state = ServerState(engine, config)
     if config.test.enable_write:
         state.start_generators()

@@ -590,10 +590,52 @@ def _read_pruned_source(source, columns, leaves, memory_map) -> pa.Table:
         pf.close()
 
 
+# whole-SST fetches at/above this size stream (ObjectStore.get_stream)
+# into an anonymous temp file and decode from a file-backed mmap —
+# peak anonymous RSS is one stream chunk, and the kernel page cache
+# owns (and can evict) the object bytes.  Below it, one get() into a
+# bytes buffer stays cheaper (no filesystem round trip).  The near-data
+# fallback path depends on this bound: a dead-agent fallback on a large
+# covered segment must not balloon the coordinator's RSS by the
+# segment it suddenly has to read itself (docs/robustness.md).
+STREAM_FETCH_MIN_BYTES = 64 << 20
+
+
+async def _fetch_mapped(store: ObjectStore, path: str, runtimes,
+                        pool: str) -> pa.Buffer:
+    """Stream an object into an unlinked temp file and return a
+    pa.Buffer over its read-only mmap — drop-in for the bytes that
+    store.get would have returned, without the resident copy."""
+    import mmap
+    import tempfile
+
+    f = tempfile.TemporaryFile(prefix="sst-stream-")
+    try:
+        stream = store.get_stream(path)
+        try:
+            async for chunk in stream:
+                # writes on the decode pool: the event loop never
+                # blocks on disk
+                await _run(runtimes, pool, f.write, chunk)
+        finally:
+            await stream.aclose()
+        f.flush()
+        size = f.tell()
+        if size == 0:
+            return pa.py_buffer(b"")
+        # the mapping (and the unlinked file behind it) lives exactly
+        # as long as the returned buffer
+        return pa.py_buffer(mmap.mmap(f.fileno(), size,
+                                      access=mmap.ACCESS_READ))
+    finally:
+        f.close()
+
+
 async def read_sst(store: ObjectStore, path: str,
                    columns: Optional[list[str]] = None,
                    filters=None, runtimes=None,
-                   pool: str = "sst", leaves: Optional[list] = None) -> pa.Table:
+                   pool: str = "sst", leaves: Optional[list] = None,
+                   size_hint: Optional[int] = None) -> pa.Table:
     """Read an SST, optionally a column subset and a pushed-down
     predicate (row-group pruning via parquet statistics + row filtering
     — the reference's ParquetExec pruning predicate, read.rs:442-465).
@@ -602,8 +644,10 @@ async def read_sst(store: ObjectStore, path: str,
     decode; `filters` (a pyarrow expression) is the fallback for
     predicate shapes the pruner refuses.  Both keep exactly the same
     rows.  Local stores expose a filesystem path for mmap'd reads; other
-    stores go through a bytes buffer.  Decode always runs on a worker
-    pool.
+    stores go through a bytes buffer — except objects whose `size_hint`
+    (the manifest's SST size) reaches STREAM_FETCH_MIN_BYTES, which
+    stream chunk-wise into a file-backed mmap instead of buffering the
+    whole object in RSS.  Decode always runs on a worker pool.
     """
     local_path = getattr(store, "local_path", None)
     if local_path is not None:
@@ -623,7 +667,10 @@ async def read_sst(store: ObjectStore, path: str,
             # the store contract's error so scan retries replan (the
             # non-local branch gets this from store.get)
             raise NotFoundError(f"object not found: {path}") from e
-    data = await store.get(path)  # fetched ONCE, shared by both paths
+    if size_hint is not None and size_hint >= STREAM_FETCH_MIN_BYTES:
+        data = await _fetch_mapped(store, path, runtimes, pool)
+    else:
+        data = await store.get(path)  # fetched ONCE, shared by both paths
     if leaves is not None:
         try:
             return await _run(runtimes, pool, _read_pruned_source,

@@ -412,6 +412,11 @@ class ParquetReader:
         # high-water of pipeline in-flight host bytes observed by this
         # reader's scans (pipeline.PipelineBudget; /stats "pipeline")
         self._pipeline_high_water = 0
+        # near-data routing ([scanagent]): a ScanRouter attached here
+        # sends covered segments' aggregate scans to their store-shard
+        # agents and folds the returned partials through the normal
+        # combine (scanagent/client.py); None = the direct-scan control
+        self.scan_router = None
         self.mesh = None
         self._mesh_agg_fns: dict = {}
         self._mesh_merge_fns: dict = {}
@@ -1394,7 +1399,11 @@ class ParquetReader:
             parquet_io.read_sst(self.store, sst_path(self.root_path, f.id),
                                 columns=seg.columns, filters=pushdown,
                                 runtimes=self.runtimes, pool=pool,
-                                leaves=leaves)
+                                leaves=leaves,
+                                # manifest size: big SSTs stream into a
+                                # file-backed mmap instead of buffering
+                                # whole in RSS (get_stream)
+                                size_hint=f.meta.size)
             for f in seg.ssts
         ))
         return pa.concat_tables(tables)
@@ -1845,6 +1854,16 @@ class ParquetReader:
         parts = [p for s in sorted(done) for p in done[s]]
         return self.finalize_aggregate(parts, spec)
 
+    def router_covers(self, plan: ScanPlan) -> bool:
+        """Whether the attached near-data router would serve any of
+        this plan's segments.  scan_aggregate consults it ahead of the
+        fused gate: the fused accumulator needs every segment's windows
+        HOST-resident — exactly the shipped-segment cost the agents
+        exist to avoid — so covered plans take the parts path."""
+        return (self.scan_router is not None
+                and plan.range is not None
+                and self.scan_router.covers_any(plan.segments))
+
     def fused_aggregate_ok(self, plan: Optional[ScanPlan] = None) -> bool:
         """Whether the fused device-accumulated aggregate serves this
         scan (see _fused_agg_ok_base for the structural gates).  An
@@ -2217,18 +2236,15 @@ class ParquetReader:
         skipped on a replan; a segment is yielded only once ALL its
         windows are aggregated).
 
-        Windows from different segments batch into rounds of
-        `scan.agg_batch_windows` (mesh size when meshed) and run as ONE
-        compiled program per round — the reference parallelizes segments
-        under UnionExec (storage.rs:342-368); here segments share the
-        batch/mesh leading axis.  Cross-segment batching is safe because
-        segments partition time and windows partition PKs: no two
-        windows share a (group, bucket, timestamp) cell, so the host
-        combine has no tie-break subtleties."""
+        Routing order: memo-served segments first (free), then — with a
+        ScanRouter attached ([scanagent]) — covered segments' partials
+        are fetched from their near-data agents CONCURRENTLY with the
+        local pipeline scanning the uncovered rest; agent failures fall
+        back per segment through the local pump (the declared fallback
+        seam).  Callers fold parts in sorted segment order, so yield
+        order is free whichever route served a segment."""
         ensure(plan.mode is UpdateMode.OVERWRITE,
                "aggregate pushdown requires Overwrite mode")
-        from collections import deque
-
         # device-native decode ([scan.decode]): eligible plans thread
         # the aggregate spec to the decode stage, which uploads each
         # EncodedSegment's raw encoded buffers and fuses filter +
@@ -2269,6 +2285,88 @@ class ParquetReader:
                 plan = dc_replace(plan, segments=remaining)
             if not remaining:
                 return
+
+        def memo_store(seg_start: int, parts: list) -> None:
+            if use_memo:
+                memo.store(seg_keys[seg_start], spec, memo_pred_key,
+                           parts)
+
+        router = self.scan_router
+        covered: list = []
+        uncovered = plan.segments
+        if (router is not None and router.active
+                and plan.range is not None):
+            covered, uncovered = router.split(plan.segments)
+        # every pump iteration below carries an explicit aclose on
+        # abandonment: delegation must not let the pump's in-flight
+        # fetch/decode/device tasks outlive a closed consumer into
+        # table teardown (PR 3/8 discipline — `async for` does NOT
+        # close its source, and a nested drain-generator would just
+        # move the leak one level up)
+        if not covered:
+            pump = self._aggregate_segments_pump(plan, spec, memo_store)
+            try:
+                async for out in pump:
+                    yield out
+            finally:
+                await pump.aclose()
+            return
+        # near-data routing: agent RPCs run as one background gather
+        # while the local pump scans the uncovered segments — the
+        # coordinator's store reads and the agents' shard scans
+        # overlap, and a slow agent costs its own segments only
+        agent_task = asyncio.create_task(
+            router.gather(plan, spec, covered))
+        try:
+            if uncovered:
+                pump = self._aggregate_segments_pump(
+                    dc_replace(plan, segments=list(uncovered)), spec,
+                    memo_store)
+                try:
+                    async for out in pump:
+                        yield out
+                finally:
+                    await pump.aclose()
+            served, failed = await agent_task
+            agent_task = None
+        finally:
+            if agent_task is not None:
+                # local-pump failure/cancellation: the gather must not
+                # outlive the scan into table teardown (PR 3/8
+                # discipline)
+                agent_task.cancel()
+                await asyncio.gather(agent_task, return_exceptions=True)
+        for seg_start, parts in served:
+            memo_store(seg_start, parts)
+            yield seg_start, parts
+        if failed:
+            # THE declared fallback seam: failed covered segments go
+            # through the exact local pump the unrouted scan uses —
+            # direct store reads happen here and nowhere else on the
+            # routed path (tools/lint.py enforces the nowhere-else)
+            pump = self._aggregate_segments_pump(
+                dc_replace(plan, segments=list(failed)), spec,
+                memo_store)
+            try:
+                async for out in pump:
+                    yield out
+            finally:
+                await pump.aclose()
+
+    async def _aggregate_segments_pump(self, plan: ScanPlan,
+                                       spec: AggregateSpec, memo_store):
+        """The local aggregate pipeline (store fetch -> decode ->
+        device rounds) over `plan.segments`.
+
+        Windows from different segments batch into rounds of
+        `scan.agg_batch_windows` (mesh size when meshed) and run as ONE
+        compiled program per round — the reference parallelizes segments
+        under UnionExec (storage.rs:342-368); here segments share the
+        batch/mesh leading axis.  Cross-segment batching is safe because
+        segments partition time and windows partition PKs: no two
+        windows share a (group, bucket, timestamp) cell, so the host
+        combine has no tie-break subtleties."""
+        from collections import deque
 
         batch_w = (self.mesh.devices.size if self.mesh is not None
                    else max(1, self.config.scan.agg_batch_windows))
@@ -2377,9 +2475,7 @@ class ParquetReader:
                     while arrived and pending[arrived[0]] == 0:
                         s0 = arrived.popleft()
                         seg_parts = parts.pop(s0)
-                        if use_memo:
-                            memo.store(seg_keys[s0], spec, memo_pred_key,
-                                       seg_parts)
+                        memo_store(s0, seg_parts)
                         yield s0, seg_parts
             finally:
                 await windows_iter.aclose()
@@ -2389,9 +2485,7 @@ class ParquetReader:
             while arrived:
                 s0 = arrived.popleft()
                 seg_parts = parts.pop(s0)
-                if use_memo:
-                    memo.store(seg_keys[s0], spec, memo_pred_key,
-                               seg_parts)
+                memo_store(s0, seg_parts)
                 yield s0, seg_parts
         finally:
             if flush_task is not None:

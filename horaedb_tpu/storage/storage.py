@@ -397,7 +397,8 @@ class CloudObjectStorage(TimeMergeStorage):
         already live on device, so it keeps the host-side slice."""
         if first_plan is None:
             first_plan = await self.build_scan_plan(req)
-        if self.reader.fused_aggregate_ok(first_plan):
+        if (self.reader.fused_aggregate_ok(first_plan)
+                and not self.reader.router_covers(first_plan)):
             from horaedb_tpu.storage.plan import apply_top_k
 
             counted: set = set()  # ops metrics survive restarts

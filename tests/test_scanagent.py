@@ -1,0 +1,1049 @@
+"""Near-data scan agents (ISSUE 13): agent-served aggregate partials
+byte-compared against the direct scan (`[scanagent] mode = "off"` —
+i.e. no router attached) across agg sets, filters, ranges, and top-k,
+under seeded chaos schedules that kill agents mid-gather, slow them,
+hand the router a stale shard map, and race mid-scan compactions; plus
+the protocol edges (oversized-partial 413, deadline-expired 504,
+tenant scan-byte quota 429, trace stitching), the wire round trip,
+`[scanagent]` config plumbing, the coordinator lint rules, and the
+`ObjectStore.get_stream` streamed-fallback satellite.
+
+The seeded chaos test rides `make chaos` with knobs SCANAGENT_SEED /
+SCANAGENT_SCHEDULES; the fast tier-1 variant runs a fixed small
+subset."""
+
+import asyncio
+import os
+import random
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from horaedb_tpu.common import ReadableDuration
+from horaedb_tpu.common import runtimes as runtimes_mod
+from horaedb_tpu.common.deadline import Deadline, DeadlineExceeded, \
+    deadline_scope
+from horaedb_tpu.common.error import Error
+from horaedb_tpu.common.tenant import (
+    QuotaExceeded,
+    TenantRegistry,
+    tenant_scope,
+    tenants_from_dict,
+)
+from horaedb_tpu.objstore import (
+    FaultInjectingStore,
+    InstrumentedStore,
+    LocalObjectStore,
+    MemoryObjectStore,
+)
+from horaedb_tpu.ops import filter as F
+from horaedb_tpu.ops.downsample import ALL_AGGS
+from horaedb_tpu.scanagent import (
+    AgentService,
+    AgentSpec,
+    ScanAgentClient,
+    ScanAgentConfig,
+    ScanRouter,
+    scanagent_from_dict,
+    wire,
+)
+from horaedb_tpu.scanagent import client as client_mod
+from horaedb_tpu.storage.config import (
+    StorageConfig,
+    ThreadsConfig,
+    from_dict,
+)
+from horaedb_tpu.storage.plan import TopKSpec
+from horaedb_tpu.storage.read import AggregateSpec, ScanRequest
+from horaedb_tpu.storage.storage import CloudObjectStorage, WriteRequest
+from horaedb_tpu.storage.types import TimeRange
+from horaedb_tpu.utils import tracing
+
+SEED = int(os.environ.get("SCANAGENT_SEED", "1337"), 0)
+SCHEDULES = int(os.environ.get("SCANAGENT_SCHEDULES", "15"), 0)
+
+SEGMENT_MS = 3_600_000
+SCHEMA = pa.schema([("k", pa.string()), ("ts", pa.int64()),
+                    ("v", pa.float64())])
+
+WHICH_SETS = (("avg",), ("min", "max"), ("count",), ("sum", "avg"),
+              ("avg", "max", "last"), ALL_AGGS)
+
+
+@pytest.fixture(scope="module")
+def runtimes():
+    rt = runtimes_mod.from_config(ThreadsConfig())
+    yield rt
+    rt.close()
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def batch(rows):
+    k, t, v = zip(*rows)
+    return pa.record_batch(
+        [pa.array(list(k)), pa.array(list(t), type=pa.int64()),
+         pa.array(list(v), type=pa.float64())], schema=SCHEMA)
+
+
+def wreq(rows):
+    lo = min(r[1] for r in rows)
+    hi = max(r[1] for r in rows) + 1
+    return WriteRequest(batch(rows), TimeRange.new(lo, hi))
+
+
+def storage_config(**scan):
+    cfg = from_dict(StorageConfig, {
+        "scheduler": {"schedule_interval": "1h", "input_sst_min_num": 2},
+        "scan": scan,
+    })
+    cfg.manifest.merge_interval = ReadableDuration.parse("1h")
+    cfg.scrub.interval = ReadableDuration.parse("1h")
+    return cfg
+
+
+async def open_storage(store, runtimes, **scan):
+    return await CloudObjectStorage.open(
+        "db", SEGMENT_MS, store, SCHEMA, 2,
+        storage_config(**scan), runtimes=runtimes)
+
+
+def agg_spec(lo: int, hi: int, bucket_ms: int = 60_000,
+             which=("avg", "max", "last")) -> AggregateSpec:
+    return AggregateSpec(group_col="k", ts_col="ts", value_col="v",
+                         range_start=lo, bucket_ms=bucket_ms,
+                         num_buckets=max(1, -(-(hi - lo) // bucket_ms)),
+                         which=which)
+
+
+async def write_segments(s, rng, segments=3, rows_per=150, keys=6):
+    for seg in range(segments):
+        rows = [(f"k{rng.randint(0, keys - 1)}",
+                 seg * SEGMENT_MS + rng.randrange(0, SEGMENT_MS - 1000,
+                                                  250),
+                 float(rng.randint(0, 10**6))) for _ in range(rows_per)]
+        await s.write(wreq(rows))
+
+
+def clear_caches(s, memo=True):
+    s.reader.scan_cache.clear()
+    s.reader.encoded_cache.clear()
+    if memo:
+        s.reader.parts_memo.clear()
+
+
+def _assert_same(a, b, ctx=""):
+    va, ga = a
+    vb, gb = b
+    assert np.array_equal(va, vb), f"{ctx}: group values differ"
+    assert set(ga) == set(gb), f"{ctx}: agg keys {set(ga)} != {set(gb)}"
+    for k in ga:
+        assert np.asarray(ga[k]).tobytes() == np.asarray(gb[k]).tobytes(), \
+            f"{ctx}: grid {k!r} differs"
+
+
+async def attach_agent(s, runtimes, agent_store=None, slots=(0,),
+                       num_slots=1, extra_agents=(), **cfg_kw):
+    """Start an AgentService (colocated with `s`'s store unless
+    `agent_store` overrides) and attach a router for it to `s`.
+    Returns (service, client, config)."""
+    service = AgentService(agent_store if agent_store is not None
+                           else s.store, runtimes=runtimes)
+    url = await service.start()
+    agents = (AgentSpec("a0", url, tuple(slots)),) + tuple(extra_agents)
+    cfg = ScanAgentConfig(mode="on", num_slots=num_slots, agents=agents,
+                          **cfg_kw)
+    client = ScanAgentClient(cfg)
+    s.reader.scan_router = ScanRouter(
+        cfg, client, s.root_path, s.schema().user_schema,
+        s.schema().num_primary_keys, s.segment_duration_ms)
+    return service, client, cfg
+
+
+def served_count() -> float:
+    return client_mod._REQUESTS.labels(agent="a0", outcome="ok").value
+
+
+def fallback_count(reason: str) -> float:
+    return client_mod._FALLBACKS.labels(reason=reason).value
+
+
+async def agent_off(s, req, spec, top_k=None):
+    """The control: detach the router, true-cold direct scan."""
+    router, s.reader.scan_router = s.reader.scan_router, None
+    try:
+        clear_caches(s)
+        return await s.scan_aggregate(req, spec, top_k=top_k)
+    finally:
+        s.reader.scan_router = router
+
+
+async def agent_on(s, req, spec, top_k=None):
+    clear_caches(s)
+    return await s.scan_aggregate(req, spec, top_k=top_k)
+
+
+# ---------------------------------------------------------------------------
+# bit-identity: agent-served vs direct
+# ---------------------------------------------------------------------------
+
+
+def test_agent_vs_off_bit_identity(runtimes):
+    """Overlapping writes (cross-SST duplicate PKs), every agg set,
+    filters incl. In/range, top-k: the agent must actually serve
+    segments (ok counter moves) and every grid must byte-match the
+    direct scan."""
+    async def go():
+        rng = random.Random(SEED)
+        s = await open_storage(MemoryObjectStore(), runtimes)
+        service, client, _cfg = None, None, None
+        try:
+            await write_segments(s, rng, segments=2, rows_per=200)
+            await s.write(wreq([("k0", 100, 7.0), ("k1", 350, 8.0)]))
+            await s.write(wreq([("k0", 100, 9.0), ("k2", 600, 1.0)]))
+            service, client, _cfg = await attach_agent(s, runtimes)
+            preds = (None, F.Eq("k", "k1"), F.In("k", ["k0", "k4"]),
+                     F.And((F.Ge("ts", 1000), F.Lt("ts", SEGMENT_MS))),
+                     F.Eq("k", "nope"))
+            for which in WHICH_SETS:
+                for pred in preds:
+                    spec = agg_spec(0, 2 * SEGMENT_MS, which=which)
+                    req = ScanRequest(
+                        range=TimeRange.new(0, 2 * SEGMENT_MS),
+                        predicate=pred)
+                    before = served_count()
+                    routed = await agent_on(s, req, spec)
+                    assert served_count() > before, \
+                        "agent route did not engage"
+                    control = await agent_off(s, req, spec)
+                    _assert_same(routed, control, f"{which} {pred}")
+            tk = TopKSpec(k=2, by="max")
+            spec = agg_spec(0, 2 * SEGMENT_MS, which=("max", "avg"))
+            req = ScanRequest(range=TimeRange.new(0, 2 * SEGMENT_MS))
+            routed = await agent_on(s, req, spec, top_k=tk)
+            control = await agent_off(s, req, spec, top_k=tk)
+            _assert_same(routed, control, "top-k")
+        finally:
+            if client is not None:
+                await client.close()
+            if service is not None:
+                await service.close()
+            await s.close()
+
+    run(go())
+
+
+def test_partial_coverage_routes_only_covered(runtimes):
+    """A shard map covering only slot 0 of 2: covered segments route,
+    uncovered scan directly, the combined grid still byte-matches."""
+    async def go():
+        rng = random.Random(SEED + 2)
+        s = await open_storage(MemoryObjectStore(), runtimes)
+        service = client = None
+        try:
+            await write_segments(s, rng, segments=4, rows_per=120)
+            service, client, _cfg = await attach_agent(
+                s, runtimes, slots=(0,), num_slots=2)
+            spec = agg_spec(0, 4 * SEGMENT_MS)
+            req = ScanRequest(range=TimeRange.new(0, 4 * SEGMENT_MS))
+            before = served_count()
+            routed = await agent_on(s, req, spec)
+            # 4 segments, alternating slots -> exactly 2 agent-served
+            assert served_count() - before == 2
+            control = await agent_off(s, req, spec)
+            _assert_same(routed, control, "partial coverage")
+        finally:
+            if client is not None:
+                await client.close()
+            if service is not None:
+                await service.close()
+            await s.close()
+
+    run(go())
+
+
+def test_memo_serves_repeat_routed_query(runtimes):
+    """Agent-served partials enter the PartsMemo like local ones: the
+    repeat query is memo-served with zero further agent RPCs."""
+    async def go():
+        rng = random.Random(SEED + 3)
+        s = await open_storage(MemoryObjectStore(), runtimes)
+        service = client = None
+        try:
+            await write_segments(s, rng, segments=2, rows_per=100)
+            service, client, _cfg = await attach_agent(s, runtimes)
+            spec = agg_spec(0, 2 * SEGMENT_MS)
+            req = ScanRequest(range=TimeRange.new(0, 2 * SEGMENT_MS))
+            first = await agent_on(s, req, spec)
+            mark = served_count()
+            again = await s.scan_aggregate(req, spec)  # caches intact
+            assert served_count() == mark, "repeat query hit the agent"
+            _assert_same(first, again, "memo repeat")
+        finally:
+            if client is not None:
+                await client.close()
+            if service is not None:
+                await service.close()
+            await s.close()
+
+    run(go())
+
+
+# ---------------------------------------------------------------------------
+# failure handling: kill / breaker / stale map / oversized / degraded
+# ---------------------------------------------------------------------------
+
+
+def test_agent_killed_mid_gather_falls_back(runtimes):
+    """kill -9 the agent while a routed gather is in flight: the query
+    completes via the direct-read fallback, byte-identical, and the
+    fallback is accounted."""
+    async def go():
+        rng = random.Random(SEED + 4)
+        s = await open_storage(MemoryObjectStore(), runtimes)
+        service = client = None
+        try:
+            await write_segments(s, rng, segments=3, rows_per=150)
+            # latency at the agent's shard keeps its scans in flight
+            # long enough that the close below is a genuine mid-gather
+            # kill, not a post-completion no-op
+            service, client, _cfg = await attach_agent(
+                s, runtimes,
+                agent_store=FaultInjectingStore(
+                    s.store, seed=SEED, latency_range=(0.05, 0.05)))
+            spec = agg_spec(0, 3 * SEGMENT_MS)
+            req = ScanRequest(range=TimeRange.new(0, 3 * SEGMENT_MS))
+            control = await agent_off(s, req, spec)
+            clear_caches(s)
+            before = fallback_count("error")
+            task = asyncio.ensure_future(s.scan_aggregate(req, spec))
+            # let the gather get its RPCs in flight, then kill
+            for _ in range(3):
+                await asyncio.sleep(0)
+            await service.close()
+            routed = await task
+            _assert_same(routed, control, "killed mid-gather")
+            assert fallback_count("error") > before \
+                or fallback_count("timeout") > before
+        finally:
+            if client is not None:
+                await client.close()
+            if service is not None:
+                await service.close()
+            await s.close()
+
+    run(go())
+
+
+def test_breaker_opens_on_dead_agent(runtimes):
+    """Repeated failures open the agent's circuit: later queries skip
+    the connect attempt (outcome breaker_open) and still serve
+    correct grids via fallback."""
+    async def go():
+        rng = random.Random(SEED + 5)
+        s = await open_storage(MemoryObjectStore(), runtimes)
+        service = client = None
+        try:
+            await write_segments(s, rng, segments=2, rows_per=80)
+            service, client, cfg = await attach_agent(
+                s, runtimes, breaker_failures=2)
+            spec = agg_spec(0, 2 * SEGMENT_MS)
+            req = ScanRequest(range=TimeRange.new(0, 2 * SEGMENT_MS))
+            control = await agent_off(s, req, spec)
+            await service.close()  # dead from the start
+            service = None
+            for _ in range(3):
+                routed = await agent_on(s, req, spec)
+                _assert_same(routed, control, "dead agent")
+            assert client.breakers["a0"].state != "closed"
+            assert fallback_count("breaker_open") > 0
+        finally:
+            if client is not None:
+                await client.close()
+            if service is not None:
+                await service.close()
+            await s.close()
+
+    run(go())
+
+
+def test_half_open_probe_survives_protocol_refusal(runtimes):
+    """Review regression: a half-open breaker's single probe ending in
+    a protocol ANSWER (413 oversized) must settle the breaker — the
+    old code leaked the probe slot and disabled the agent forever."""
+    async def go():
+        rng = random.Random(SEED + 12)
+        s = await open_storage(MemoryObjectStore(), runtimes)
+        service = client = None
+        try:
+            await write_segments(s, rng, segments=2, rows_per=80)
+            service, client, _cfg = await attach_agent(
+                s, runtimes, breaker_failures=2,
+                breaker_cooldown=ReadableDuration.parse("0s"))
+            spec = agg_spec(0, 2 * SEGMENT_MS)
+            req = ScanRequest(range=TimeRange.new(0, 2 * SEGMENT_MS))
+            control = await agent_off(s, req, spec)
+            port = int(service.url.rsplit(":", 1)[1])
+            await service.close()
+            routed = await agent_on(s, req, spec)  # opens the breaker
+            _assert_same(routed, control, "dead phase")
+            assert client.breakers["a0"].state != "closed"
+            # revive the agent at the SAME port, refusing every
+            # partial: the cooldown (0s) admits one probe, the 413 is
+            # an answer, and the breaker must CLOSE — not wedge with a
+            # leaked probe slot
+            service = AgentService(
+                s.store, config=ScanAgentConfig(max_partial_bytes=1),
+                runtimes=runtimes)
+            await service.start(port=port)
+            before = fallback_count("oversized")
+            routed = await agent_on(s, req, spec)
+            _assert_same(routed, control, "probe phase")
+            assert fallback_count("oversized") > before
+            assert client.breakers["a0"].state == "closed"
+            # and it KEEPS answering probes — no breaker_open wedge
+            mark = client_mod._REQUESTS.labels(
+                agent="a0", outcome="breaker_open").value
+            routed = await agent_on(s, req, spec)
+            _assert_same(routed, control, "post-probe phase")
+            assert client_mod._REQUESTS.labels(
+                agent="a0", outcome="breaker_open").value == mark
+        finally:
+            if client is not None:
+                await client.close()
+            if service is not None:
+                await service.close()
+            await s.close()
+
+    run(go())
+
+
+def test_stale_shard_map_falls_back(runtimes):
+    """The map says the agent owns the segments, but its shard store
+    has none of the bytes (stale map): the agent answers 409
+    stale_ssts and the coordinator serves the truth directly."""
+    async def go():
+        rng = random.Random(SEED + 6)
+        s = await open_storage(MemoryObjectStore(), runtimes)
+        service = client = None
+        try:
+            await write_segments(s, rng, segments=2, rows_per=80)
+            service, client, _cfg = await attach_agent(
+                s, runtimes, agent_store=MemoryObjectStore())
+            spec = agg_spec(0, 2 * SEGMENT_MS)
+            req = ScanRequest(range=TimeRange.new(0, 2 * SEGMENT_MS))
+            before = fallback_count("stale")
+            routed = await agent_on(s, req, spec)
+            control = await agent_off(s, req, spec)
+            _assert_same(routed, control, "stale map")
+            assert fallback_count("stale") > before
+        finally:
+            if client is not None:
+                await client.close()
+            if service is not None:
+                await service.close()
+            await s.close()
+
+    run(go())
+
+
+def test_oversized_partial_refused(runtimes):
+    """An agent refuses to serialize a partial beyond
+    max_partial_bytes (413): reason=oversized fallback, identical
+    grids."""
+    async def go():
+        rng = random.Random(SEED + 7)
+        s = await open_storage(MemoryObjectStore(), runtimes)
+        service = client = None
+        try:
+            await write_segments(s, rng, segments=2, rows_per=120)
+            service, client, _cfg = await attach_agent(
+                s, runtimes, max_partial_bytes=64)
+            service.config = ScanAgentConfig(max_partial_bytes=64)
+            spec = agg_spec(0, 2 * SEGMENT_MS)
+            req = ScanRequest(range=TimeRange.new(0, 2 * SEGMENT_MS))
+            before = fallback_count("oversized")
+            routed = await agent_on(s, req, spec)
+            control = await agent_off(s, req, spec)
+            _assert_same(routed, control, "oversized")
+            assert fallback_count("oversized") > before
+            # a refusal is not a failure: the breaker stays closed
+            assert client.breakers["a0"].state == "closed"
+        finally:
+            if client is not None:
+                await client.close()
+            if service is not None:
+                await service.close()
+            await s.close()
+
+    run(go())
+
+
+def test_degraded_gather_when_fallback_disabled(runtimes):
+    """[scanagent] fallback = false + a lost shard: covered segments
+    are DROPPED with degraded accounting instead of read directly
+    (the cluster tier's partial-results discipline)."""
+    async def go():
+        rng = random.Random(SEED + 8)
+        s = await open_storage(MemoryObjectStore(), runtimes)
+        service = client = None
+        try:
+            await write_segments(s, rng, segments=2, rows_per=80)
+            service, client, _cfg = await attach_agent(
+                s, runtimes, fallback=False)
+            await service.close()
+            service = None
+            spec = agg_spec(0, 2 * SEGMENT_MS)
+            req = ScanRequest(range=TimeRange.new(0, 2 * SEGMENT_MS))
+            before = client_mod._DEGRADED.value
+            values, _grids = await agent_on(s, req, spec)
+            assert len(values) == 0, "lost-shard segments must drop"
+            assert client_mod._DEGRADED.value - before == 2
+        finally:
+            if client is not None:
+                await client.close()
+            if service is not None:
+                await service.close()
+            await s.close()
+
+    run(go())
+
+
+# ---------------------------------------------------------------------------
+# protocol edges: deadline, tenant quota, trace stitching
+# ---------------------------------------------------------------------------
+
+
+def test_deadline_expired_at_agent_504(runtimes):
+    """An exhausted X-Deadline-Ms answers 504 at the agent (outcome
+    accounting included), and a coordinator whose deadline expires
+    mid-gather surfaces DeadlineExceeded — never a silent fallback
+    that burns more time."""
+    async def go():
+        import aiohttp
+
+        rng = random.Random(SEED + 9)
+        s = await open_storage(MemoryObjectStore(), runtimes)
+        service = client = None
+        try:
+            await write_segments(s, rng, segments=1, rows_per=60)
+            service, client, _cfg = await attach_agent(s, runtimes)
+            spec = agg_spec(0, SEGMENT_MS)
+            req = ScanRequest(range=TimeRange.new(0, SEGMENT_MS))
+            # warm registration so the direct POST below hits the scan
+            await agent_on(s, req, spec)
+
+            from horaedb_tpu.scanagent.agent import _SCANS
+            before = _SCANS.labels(outcome="deadline").value
+            body = wire.encode_scan_request(
+                s.root_path, 0, [], TimeRange.new(0, SEGMENT_MS),
+                None, spec)
+            async with aiohttp.ClientSession() as sess:
+                async with sess.post(
+                        service.url + "/v1/scan", json=body,
+                        headers={"X-Deadline-Ms": "0"},
+                        timeout=aiohttp.ClientTimeout(total=5)) as resp:
+                    assert resp.status == 504
+                    payload = await resp.json()
+                    assert payload["code"] == "deadline"
+            assert _SCANS.labels(outcome="deadline").value == before + 1
+
+            # coordinator-side: an expired ambient deadline aborts the
+            # routed scan with DeadlineExceeded (504 at the server)
+            clear_caches(s)
+            with deadline_scope(Deadline.after(0.0,
+                                               reason="test")):
+                with pytest.raises(DeadlineExceeded):
+                    await s.scan_aggregate(req, spec)
+        finally:
+            if client is not None:
+                await client.close()
+            if service is not None:
+                await service.close()
+            await s.close()
+
+    run(go())
+
+
+def test_tenant_quota_charged_at_agent(runtimes):
+    """The scan-byte quota is charged where the bytes are read — at
+    the agent — and the breach surfaces as the coordinator's
+    QuotaExceeded (the server's tenant-scoped 429), not a fallback."""
+    async def go():
+        rng = random.Random(SEED + 10)
+        s = await open_storage(MemoryObjectStore(), runtimes)
+        service = client = None
+        try:
+            await write_segments(s, rng, segments=2, rows_per=300)
+            agent_tenants = TenantRegistry(tenants_from_dict({
+                "enabled": True,
+                "tenant": {"t1": {"scan_bytes_per_s": "1KB",
+                                  "scan_burst_bytes": "1KB"}},
+            }))
+            service = AgentService(s.store, tenants=agent_tenants,
+                                   runtimes=runtimes)
+            url = await service.start()
+            cfg = ScanAgentConfig(
+                mode="on", agents=(AgentSpec("a0", url, (0,)),))
+            client = ScanAgentClient(cfg)
+            s.reader.scan_router = ScanRouter(
+                cfg, client, s.root_path, s.schema().user_schema,
+                s.schema().num_primary_keys, s.segment_duration_ms)
+            # coordinator-side tenant is UNLIMITED: the breach below
+            # can only have been charged at the agent
+            coord_tenants = TenantRegistry(tenants_from_dict({
+                "enabled": True, "tenant": {"t1": {}}}))
+            spec = agg_spec(0, 2 * SEGMENT_MS)
+            req = ScanRequest(range=TimeRange.new(0, 2 * SEGMENT_MS))
+            clear_caches(s)
+            with tenant_scope(coord_tenants.resolve("t1")):
+                with pytest.raises(QuotaExceeded) as exc:
+                    await s.scan_aggregate(req, spec)
+            assert exc.value.resource == "scan_bytes"
+            assert exc.value.tenant == "t1"
+            assert exc.value.retry_after_s > 0
+            from horaedb_tpu.scanagent.agent import _SCANS
+            assert _SCANS.labels(outcome="quota").value > 0
+        finally:
+            if client is not None:
+                await client.close()
+            if service is not None:
+                await service.close()
+            await s.close()
+
+    run(go())
+
+
+def test_trace_stitching_agent_under_routing_span(runtimes):
+    """The agent adopts the coordinator's trace id and exports its
+    spans; the coordinator reparents them under the scanagent_rpc
+    span — one stitched trace shows where the near-data work ran."""
+    async def go():
+        rng = random.Random(SEED + 11)
+        s = await open_storage(MemoryObjectStore(), runtimes)
+        service = client = None
+        try:
+            await write_segments(s, rng, segments=1, rows_per=60)
+            service, client, _cfg = await attach_agent(s, runtimes)
+            spec = agg_spec(0, SEGMENT_MS)
+            req = ScanRequest(range=TimeRange.new(0, SEGMENT_MS))
+            trace = tracing.recorder.start(
+                "/query", trace_id=tracing.new_trace_id(), forced=True)
+            assert trace is not None
+            with tracing.trace_scope(trace):
+                clear_caches(s)
+                await s.scan_aggregate(req, spec)
+            done = tracing.recorder.finish(trace)
+            spans = done["spans"]
+            rpc = [sp for sp in spans
+                   if sp["name"] == "scanagent_rpc"]
+            assert rpc, "no scanagent_rpc span recorded"
+            agent_roots = [sp for sp in spans
+                           if sp["name"] == "scanagent/scan"]
+            assert agent_roots, "agent spans were not stitched in"
+            rpc_ids = {sp["span_id"] for sp in rpc}
+            assert all(sp["parent_id"] in rpc_ids
+                       for sp in agent_roots), \
+                "agent spans not under the routing span"
+            # the received partial bytes are attributed to the trace
+            assert done["counters"].get("scanagent_partial_bytes", 0) > 0
+        finally:
+            if client is not None:
+                await client.close()
+            if service is not None:
+                await service.close()
+            await s.close()
+
+    run(go())
+
+
+# ---------------------------------------------------------------------------
+# wire format round trip
+# ---------------------------------------------------------------------------
+
+
+def test_wire_predicate_roundtrip():
+    preds = [
+        None,
+        F.Eq("k", "abc"),
+        F.Ne("v", 3.5),
+        F.In("tsid", np.asarray([1, 5, 2**63], dtype=np.uint64)),
+        F.In("k", ["a", "b"]),
+        F.And((F.Ge("ts", 100), F.Lt("ts", 10**13))),
+        F.Or((F.Eq("k", b"bin"), F.Not(F.Eq("k", "x")))),
+        F.TimeRangePred("ts", 0, 2**40),
+    ]
+    for p in preds:
+        back = wire.decode_predicate(wire.encode_predicate(p))
+        assert F.canonical_predicate_key(back) == \
+            F.canonical_predicate_key(p), p
+    # numpy In dtype survives exactly (encoded-space membership)
+    back = wire.decode_predicate(wire.encode_predicate(preds[3]))
+    assert isinstance(back.values, np.ndarray)
+    assert back.values.dtype == np.uint64
+
+
+def test_wire_parts_roundtrip_exact():
+    """Values AND dtypes must round-trip byte-exactly: the combine's
+    bit-identity depends on it."""
+    rng = np.random.default_rng(SEED)
+    cases = [
+        (np.asarray([1, 7, 9], dtype=np.uint64), 3),
+        (np.asarray([b"a", b"bb", b"ccc"], dtype=object), 0),
+        (np.asarray(["x", "yy"], dtype=object), 2),
+        (np.asarray([5, 6], dtype=np.int32), 1),
+    ]
+    parts = []
+    for values, lo in cases:
+        g = len(values)
+        grids = {
+            "count": rng.integers(0, 5, (g, 4)).astype(np.int32),
+            "sum": rng.random((g, 4)).astype(np.float32),
+            "avg": rng.random((g, 4)).astype(np.float64),
+            "last_ts": rng.integers(0, 10**9, (g, 4)),
+        }
+        parts.append((values, lo, grids))
+    back = wire.decode_parts(wire.encode_parts(parts))
+    assert len(back) == len(parts)
+    for (va, la, ga), (vb, lb, gb) in zip(parts, back):
+        assert la == lb
+        assert va.dtype == vb.dtype
+        assert list(va) == list(vb)
+        assert set(ga) == set(gb)
+        for k in ga:
+            assert ga[k].dtype == gb[k].dtype, k
+            assert ga[k].tobytes() == gb[k].tobytes(), k
+    # non-contiguous grid slices (the parts' real shape) serialize too
+    big = rng.random((4, 8)).astype(np.float32)
+    sliced = [(np.asarray([1, 2], dtype=np.int64), 0,
+               {"sum": big[:2, :5]})]
+    back = wire.decode_parts(wire.encode_parts(sliced))
+    assert back[0][2]["sum"].tobytes() == \
+        np.ascontiguousarray(big[:2, :5]).tobytes()
+    # malformed payloads are refused, not misparsed
+    with pytest.raises(Error):
+        wire.decode_parts(b"garbage")
+
+
+# ---------------------------------------------------------------------------
+# config plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_scanagent_config_from_dict():
+    cfg = scanagent_from_dict({
+        "mode": "on", "num_slots": 4, "timeout": "2s",
+        "max_partial_bytes": 1024, "fallback": False,
+        "breaker_failures": 5, "breaker_cooldown": "1s",
+        "agents": [{"name": "a0", "url": "http://h0:9201/",
+                    "slots": [0, 1]},
+                   {"name": "a1", "url": "http://h1:9201",
+                    "slots": [2]}],
+    })
+    assert cfg.active
+    assert cfg.timeout.seconds == 2.0
+    assert cfg.agents[0].url == "http://h0:9201"  # trailing / stripped
+    assert cfg.owner(0, SEGMENT_MS).name == "a0"
+    assert cfg.owner(2 * SEGMENT_MS, SEGMENT_MS).name == "a1"
+    assert cfg.owner(3 * SEGMENT_MS, SEGMENT_MS) is None  # slot 3
+    with pytest.raises(Error):
+        scanagent_from_dict({"mode": "sideways"})
+    with pytest.raises(Error):
+        scanagent_from_dict({"bogus_key": 1})
+    with pytest.raises(Error):
+        scanagent_from_dict({"num_slots": 2, "agents": [
+            {"name": "a", "url": "http://x", "slots": [7]}]})
+    with pytest.raises(Error):
+        scanagent_from_dict({"agents": [
+            {"name": "a", "url": "http://x", "slots": [0]},
+            {"name": "a", "url": "http://y", "slots": [0]}]})
+    # off (the default) never routes
+    assert not scanagent_from_dict({}).active
+
+
+def test_scanagent_server_toml(tmp_path):
+    from horaedb_tpu.server.config import load_config
+
+    toml = tmp_path / "server.toml"
+    toml.write_text("""
+port = 5001
+
+[scanagent]
+mode = "on"
+num_slots = 2
+timeout = "3s"
+
+[[scanagent.agents]]
+name = "shard0"
+url = "http://127.0.0.1:9201"
+slots = [0, 1]
+""")
+    cfg = load_config(str(toml))
+    assert cfg.scanagent.active
+    assert cfg.scanagent.agents[0].name == "shard0"
+    assert cfg.scanagent.timeout.seconds == 3.0
+
+
+# ---------------------------------------------------------------------------
+# lint rules
+# ---------------------------------------------------------------------------
+
+
+def _lint(tmp_path, rel, src):
+    import pathlib
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    try:
+        import lint
+    finally:
+        sys.path.pop(0)
+    p = tmp_path / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(src)
+    return lint.lint_file(pathlib.Path(p))
+
+
+def test_lint_scanagent_http_timeout_rule(tmp_path):
+    bad = ("async def f(client):\n"
+           "    await client.post('http://x/v1/scan', json={})\n")
+    good = ("async def f(client):\n"
+            "    await client.post('http://x/v1/scan', json={},\n"
+            "                      timeout=3)\n")
+    probs = _lint(tmp_path, "horaedb_tpu/scanagent/c.py", bad)
+    assert any("timeout" in p for p in probs), probs
+    probs = _lint(tmp_path, "horaedb_tpu/scanagent/c2.py", good)
+    assert not any("timeout" in p for p in probs), probs
+    # outside scanagent/ the broader client-token rule does not apply
+    probs = _lint(tmp_path, "horaedb_tpu/other/c.py", bad)
+    assert not any("timeout" in p for p in probs), probs
+
+
+def test_lint_scanagent_raw_store_read_rule(tmp_path):
+    bad = ("async def f(store):\n"
+           "    return await store.get('data/1.sst')\n")
+    probs = _lint(tmp_path, "horaedb_tpu/scanagent/client_x.py", bad)
+    assert any("fallback seam" in p for p in probs), probs
+    # the agent side IS the near-data reader: exempt
+    probs = _lint(tmp_path, "horaedb_tpu/scanagent/agent.py", bad)
+    assert not any("fallback seam" in p for p in probs), probs
+
+
+# ---------------------------------------------------------------------------
+# get_stream: chunked whole-object reads (the streamed fallback path)
+# ---------------------------------------------------------------------------
+
+
+async def _drain(stream):
+    chunks = []
+    async for c in stream:
+        chunks.append(c)
+    return chunks
+
+
+def test_get_stream_local_chunks(tmp_path):
+    async def go():
+        store = LocalObjectStore(str(tmp_path))
+        data = os.urandom(100_000)
+        await store.put("x/blob", data)
+        chunks = await _drain(store.get_stream("x/blob",
+                                               chunk_size=16 << 10))
+        assert len(chunks) == -(-len(data) // (16 << 10))
+        assert max(len(c) for c in chunks) <= 16 << 10
+        assert b"".join(chunks) == data
+        from horaedb_tpu.objstore import NotFoundError
+        with pytest.raises(NotFoundError):
+            await _drain(store.get_stream("missing"))
+
+    run(go())
+
+
+def test_get_stream_default_and_middleware():
+    async def go():
+        inner = MemoryObjectStore()
+        data = os.urandom(50_000)
+        await inner.put("a/b", data)
+        # default: one get, re-chunked
+        chunks = await _drain(inner.get_stream("a/b", chunk_size=7000))
+        assert b"".join(chunks) == data
+        assert max(len(c) for c in chunks) <= 7000
+        # fault injection: a "get" rule covers get_stream
+        faulty = FaultInjectingStore(inner)
+        faulty.fail_next("get", "a/b")
+        from horaedb_tpu.objstore.middleware import InjectedFault
+        with pytest.raises(InjectedFault):
+            await _drain(faulty.get_stream("a/b"))
+        assert b"".join(await _drain(faulty.get_stream("a/b"))) == data
+        # instrumentation: one op, bytes attributed
+        metered = InstrumentedStore(FaultInjectingStore(inner))
+        assert b"".join(await _drain(metered.get_stream("a/b"))) == data
+
+    run(go())
+
+
+def test_read_sst_streamed_fetch(tmp_path, monkeypatch, runtimes):
+    """read_sst over the stream threshold fetches via get_stream into
+    a file-backed mmap — table equal to the buffered read, and the
+    store sees a get_stream, not a get."""
+    from horaedb_tpu.storage import parquet_io
+
+    async def go():
+        rng = random.Random(SEED)
+        s = await open_storage(MemoryObjectStore(), runtimes)
+        try:
+            await write_segments(s, rng, segments=1, rows_per=500)
+            ssts = await s.manifest.all_ssts()
+            path = f"db/data/{ssts[0].id}.sst"
+            store = InstrumentedStore(s.store)
+            buffered = await parquet_io.read_sst(
+                store, path, runtimes=runtimes)
+            monkeypatch.setattr(parquet_io, "STREAM_FETCH_MIN_BYTES", 1)
+            before = store._ops["get_stream"][0].value
+            streamed = await parquet_io.read_sst(
+                store, path, runtimes=runtimes,
+                size_hint=ssts[0].meta.size)
+            assert store._ops["get_stream"][0].value == before + 1
+            assert streamed.equals(buffered)
+            # pruned-leaf reads stream too
+            streamed2 = await parquet_io.read_sst(
+                store, path, columns=["k", "ts", "v", "__seq__"],
+                runtimes=runtimes, size_hint=ssts[0].meta.size)
+            assert streamed2.num_rows == buffered.num_rows
+        finally:
+            await s.close()
+
+    run(go())
+
+
+# ---------------------------------------------------------------------------
+# seeded chaos: agent-served vs direct under churn
+# ---------------------------------------------------------------------------
+
+
+def _chaos_schedule(i: int, runtimes):
+    """One seeded schedule.  Scenario by schedule index: colocated
+    agent, slow agent (seeded store latency at the shard), stale shard
+    map (agent over an empty store), or half coverage; ops interleave
+    writes, compactions, cache evictions, mid-scan compaction races,
+    and one mid-gather agent kill — every query byte-compared against
+    the detached-router direct scan."""
+    async def go():
+        rng = random.Random(SEED + 1000 + i)
+        scenario = ("colocated", "slow", "stale",
+                    "half")[i % 4]
+        store = MemoryObjectStore()
+        s = await open_storage(store, runtimes)
+        agent_store = store
+        if scenario == "slow":
+            agent_store = FaultInjectingStore(
+                store, seed=SEED + i, latency_range=(0.001, 0.01))
+        elif scenario == "stale":
+            agent_store = MemoryObjectStore()
+        service = AgentService(agent_store, runtimes=runtimes)
+        url = await service.start()
+        num_slots = 2 if scenario == "half" else 1
+        cfg = ScanAgentConfig(
+            mode="on", num_slots=num_slots,
+            agents=(AgentSpec("a0", url, (0,)),),
+            timeout=ReadableDuration.parse("5s"))
+        client = ScanAgentClient(cfg)
+        s.reader.scan_router = ScanRouter(
+            cfg, client, s.root_path, s.schema().user_schema,
+            s.schema().num_primary_keys, s.segment_duration_ms)
+        killed = False
+
+        async def checked_query(racing=None):
+            lo = rng.randrange(0, 2 * SEGMENT_MS, 250)
+            hi = lo + rng.randrange(250, 3 * SEGMENT_MS, 250)
+            which = WHICH_SETS[rng.randrange(len(WHICH_SETS))]
+            bucket_ms = rng.choice([250, 60_000])
+            spec = agg_spec(lo, hi, bucket_ms=bucket_ms, which=which)
+            pred = rng.choice([None, F.Eq("k", f"k{rng.randint(0, 5)}"),
+                               F.In("k", ["k1", "k3", "k5"]),
+                               F.Ge("ts", SEGMENT_MS // 2)])
+            req = ScanRequest(range=TimeRange.new(lo, hi),
+                              predicate=pred)
+            tk = None
+            if rng.random() < 0.3:
+                by_pool = [a for a in which if a != "last_ts"] \
+                    + ["count"]
+                tk = TopKSpec(k=rng.randint(1, 4),
+                              by=rng.choice(by_pool),
+                              largest=rng.random() < 0.5)
+            clear_caches(s)
+            if racing is None:
+                routed = await s.scan_aggregate(req, spec, top_k=tk)
+            else:
+                routed, _ = await asyncio.gather(
+                    s.scan_aggregate(req, spec, top_k=tk), racing())
+            control = await agent_off(s, req, spec, top_k=tk)
+            _assert_same(routed, control,
+                         f"schedule {i} ({scenario}) lo={lo} hi={hi} "
+                         f"which={which} pred={pred} tk={tk}")
+
+        async def compact_once():
+            sched = s.compact_scheduler
+            task = await sched.picker.pick_candidate()
+            if task is not None:
+                await sched.executor.execute(task)
+
+        try:
+            await write_segments(s, rng, segments=3, rows_per=100)
+            for _op in range(7):
+                op = rng.choice(["write", "query", "query", "compact",
+                                 "evict", "race", "kill"])
+                if op == "write":
+                    seg = rng.randint(0, 2)
+                    rows = [(f"k{rng.randint(0, 5)}",
+                             seg * SEGMENT_MS + rng.randint(0, 999),
+                             float(rng.randint(0, 10**6)))
+                            for _ in range(rng.randint(1, 30))]
+                    await s.write(wreq(rows))
+                elif op == "compact":
+                    await compact_once()
+                elif op == "evict":
+                    clear_caches(s, memo=rng.random() < 0.5)
+                elif op == "race":
+                    await checked_query(racing=compact_once)
+                elif op == "kill" and not killed:
+                    # kill mid-gather: close while a query is in flight
+                    killed = True
+                    lo, hi = 0, 3 * SEGMENT_MS
+                    spec = agg_spec(lo, hi)
+                    req = ScanRequest(range=TimeRange.new(lo, hi))
+                    clear_caches(s)
+                    task = asyncio.ensure_future(
+                        s.scan_aggregate(req, spec))
+                    for _ in range(rng.randint(1, 4)):
+                        await asyncio.sleep(0)
+                    await service.close()
+                    routed = await task
+                    control = await agent_off(s, req, spec)
+                    _assert_same(routed, control,
+                                 f"schedule {i} kill mid-gather")
+                else:
+                    await checked_query()
+            await checked_query()
+        finally:
+            await client.close()
+            await service.close()
+            await s.close()
+
+    run(go())
+
+
+@pytest.mark.slow
+def test_seeded_scanagent_chaos(runtimes):
+    for i in range(SCHEDULES):
+        _chaos_schedule(i, runtimes)
+
+
+def test_seeded_scanagent_chaos_fast(runtimes):
+    """Tier-1 variant: one schedule per scenario (colocated, slow,
+    stale, half-covered)."""
+    for i in range(4):
+        _chaos_schedule(i, runtimes)

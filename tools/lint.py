@@ -53,6 +53,14 @@ Checks, all hard failures:
     reader's dispatch seam is an error — decode goes through the
     reader so the fused device dispatch (ops/device_decode.py) can
     serve eligible plans instead of silently re-growing host decode
+  - scanagent HTTP discipline under horaedb_tpu/scanagent/: every
+    http-ish client call (session/client/http receivers) must carry an
+    explicit timeout= (the PR-2 session rule, extended — a near-data
+    RPC without a bound reintroduces the 5-minute default on the
+    query path), and raw `store.get/get_range/get_stream` on the
+    COORDINATOR side (outside agent.py) is an error — covered-segment
+    fallbacks go through the reader's local pump, the one declared
+    fallback seam
   - combine grid discipline under horaedb_tpu/: allocating a dense
     `(groups, num_buckets)`-shaped array (np.zeros/full/empty/ones
     with a 2-tuple shape whose second element is named like a bucket
@@ -306,6 +314,46 @@ def _host_decode_outside_seam(node: ast.Call) -> bool:
     return False
 
 
+# scanagent HTTP discipline (extends the PR-2 session rule): under
+# horaedb_tpu/scanagent/ EVERY http-ish client call (receiver token
+# session/client/http, not just "session") must carry an explicit
+# timeout= — the agent protocol's whole point is bounded near-data
+# RPCs that honor the propagated deadline; one bare call reintroduces
+# aiohttp's 5-minute default on the query path
+_SCANAGENT_HTTP_TOKENS = ("session", "client", "http")
+
+
+def _scanagent_http_without_timeout(node: ast.Call) -> bool:
+    func = node.func
+    if not isinstance(func, ast.Attribute) \
+            or func.attr not in _SESSION_HTTP_VERBS:
+        return False
+    if not any(tok in part.lower() for part in _receiver_chain(func)
+               for tok in _SCANAGENT_HTTP_TOKENS):
+        return False
+    return not any(kw.arg == "timeout" for kw in node.keywords)
+
+
+# scanagent raw-read discipline: the COORDINATOR side of the near-data
+# plane never reads segment objects itself — covered segments are
+# served by agents, and failures fall back through the reader's local
+# pump (storage/read.py, the one declared fallback seam with streamed
+# reads, byte accounting, and tenant charging).  A raw `store.get(...)`
+# in scanagent/ outside agent.py (the near-data side, whose job IS
+# reading its shard) silently re-grows coordinator read amplification
+# behind the routing's back.
+_STORE_READ_METHODS = {"get", "get_range", "get_stream"}
+
+
+def _scanagent_raw_store_read(node: ast.Call) -> bool:
+    func = node.func
+    if not isinstance(func, ast.Attribute) \
+            or func.attr not in _STORE_READ_METHODS:
+        return False
+    return any("store" in part.lower()
+               for part in _receiver_chain(func))
+
+
 # metric-factory methods on a registry object; any such call under
 # horaedb_tpu/ must pass non-empty help text (positional or help_=)
 _METRIC_FACTORIES = {"counter", "gauge", "histogram"}
@@ -429,6 +477,28 @@ def lint_file(path: pathlib.Path) -> list[str]:
                         f"in {node.name}()")
         elif isinstance(node, ast.ExceptHandler) and node.type is None:
             problems.append(f"{path}:{node.lineno}: bare except")
+        elif (isinstance(node, ast.Call) and "scanagent" in path.parts
+                and "horaedb_tpu" in path.parts
+                and _scanagent_http_without_timeout(node)):
+            src = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+            if "noqa" not in src:
+                problems.append(
+                    f"{path}:{node.lineno}: scanagent HTTP call without "
+                    "an explicit timeout= — agent RPCs must be bounded "
+                    "by min([scanagent] timeout, deadline remaining) "
+                    "and carry X-Deadline-Ms (docs/robustness.md)")
+        elif (isinstance(node, ast.Call) and "scanagent" in path.parts
+                and "horaedb_tpu" in path.parts
+                and path.name != "agent.py"
+                and _scanagent_raw_store_read(node)):
+            src = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+            if "noqa" not in src:
+                problems.append(
+                    f"{path}:{node.lineno}: raw store read on the "
+                    "scanagent coordinator side — covered segments are "
+                    "agent-served; failures fall back through the "
+                    "reader's local pump (storage/read.py), the one "
+                    "declared fallback seam")
         elif (isinstance(node, ast.Call) and "horaedb_tpu" in path.parts
                 and _session_call_without_timeout(node)):
             src = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
