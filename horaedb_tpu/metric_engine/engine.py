@@ -31,6 +31,7 @@ from typing import Optional
 import pyarrow as pa
 
 from horaedb_tpu.common.error import Error, ensure
+from horaedb_tpu.common.memledger import ledger as memledger
 from horaedb_tpu.objstore import ObjectStore
 from horaedb_tpu.ops import And, Eq, In, TimeRangePred
 from horaedb_tpu.ops.downsample import ALL_AGGS
@@ -483,8 +484,17 @@ class MetricEngine:
                 tables["data"].reader.cache_budget_bytes,
                 hits=_CHUNK_CACHE_HITS, misses=_CHUNK_CACHE_MISSES,
                 evictions=_CHUNK_CACHE_EVICTIONS, trace_tier="chunk")
+            # memory plane: the chunked engine's decoded-sample LRU is
+            # a byte budget like any reader cache (common/memledger.py)
+            self._chunk_mem_account = memledger.register(
+                "chunk_cache:engine",
+                lambda e: e._chunk_cache.total_bytes, anchor=self,
+                kind="chunk_cache",
+                budget=tables["data"].reader.cache_budget_bytes,
+                owner="metric_engine")
         else:
             self._chunk_cache = None
+            self._chunk_mem_account = None
 
     @classmethod
     async def open(cls, root_path: str, store: ObjectStore,
@@ -639,6 +649,12 @@ class MetricEngine:
             self.rollups = None
         for t in self.tables.values():
             await t.close()
+        if self._chunk_cache is not None:
+            # clear-on-close: a closed engine's decoded chunks can
+            # never be read again, and the ledger account goes with it
+            self._chunk_cache.clear()
+            memledger.deregister(self._chunk_mem_account)
+            self._chunk_mem_account = None
         if getattr(self, "_runtimes", None) is not None:
             self._runtimes.close()
 

@@ -5,6 +5,7 @@ from __future__ import annotations
 
 import asyncio
 
+from horaedb_tpu.common.memledger import ledger as memledger
 from horaedb_tpu.objstore.api import NotFoundError, ObjectMeta, ObjectStore
 
 
@@ -12,10 +13,23 @@ class MemoryObjectStore(ObjectStore):
     def __init__(self) -> None:
         self._objects: dict[str, bytes] = {}
         self._lock = asyncio.Lock()
+        # memory plane (common/memledger.py): the resident
+        # parquet+sidecar copy is exactly what the 1B projection says
+        # breaks first (ROADMAP item 3) — it must be an ACCOUNT, not
+        # the unattributed residue.  O(1) running total; the account
+        # anchors weakly (an abandoned test store prunes on the next
+        # sweep — there is no close API to deregister from)
+        self._resident_bytes = 0
+        self._mem_account = memledger.register(
+            "objstore_memory", lambda s: s._resident_bytes,
+            anchor=self, kind="objstore_memory", owner="objstore")
 
     async def put(self, path: str, data: bytes) -> None:
         async with self._lock:
+            old = self._objects.get(path)
             self._objects[path] = bytes(data)
+            self._resident_bytes += len(data) - (
+                0 if old is None else len(old))
 
     async def get(self, path: str) -> bytes:
         async with self._lock:
@@ -40,6 +54,7 @@ class MemoryObjectStore(ObjectStore):
         async with self._lock:
             if path not in self._objects:
                 raise NotFoundError(f"object not found: {path}")
+            self._resident_bytes -= len(self._objects[path])
             del self._objects[path]
 
     async def list(self, prefix: str) -> list[ObjectMeta]:

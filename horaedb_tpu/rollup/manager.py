@@ -54,6 +54,7 @@ import pyarrow as pa
 
 from horaedb_tpu.common.error import ensure
 from horaedb_tpu.common.loops import loops
+from horaedb_tpu.common.memledger import ledger as memledger
 from horaedb_tpu.objstore import NotFoundError, ObjectStore
 from horaedb_tpu.ops import And, Eq, In, TimeRangePred
 from horaedb_tpu.ops.downsample import ALL_AGGS
@@ -246,6 +247,15 @@ class RollupManager:
             self._loop, name=f"rollup:{root_path}", kind="rollup",
             owner="rollup", period_s=config.roll_interval.seconds,
             stall_threshold_s=600.0, backlog=self._backlog)
+        # memory plane (common/memledger.py): the maintenance state —
+        # per-segment SST-id fingerprints + dirty/rolling/unrollable
+        # sets — grows with segment count and must be visible on the
+        # 1B ladder (the tier TABLES' caches register via their own
+        # readers)
+        self._mem_account = memledger.register(
+            f"rollup_state:{root_path}",
+            lambda m: m.state_bytes(), anchor=self,
+            kind="rollup_state", owner=root_path)
         if self.specs:
             # recovered/config-registered specs may have pending work
             # (their register()-time wake predates the event existing)
@@ -268,6 +278,22 @@ class RollupManager:
             self._task = None
         for t in self.tiers.values():
             await t.close()
+        memledger.deregister(getattr(self, "_mem_account", None))
+        self._mem_account = None
+
+    def state_bytes(self) -> int:
+        """Estimated host bytes of the in-memory maintenance state
+        (the ledger's pull gauge).  Fingerprints dominate: one int
+        list per rolled segment per spec.  An estimate — 28 B per
+        small int + 56 B list header — not sys.getsizeof recursion,
+        which would walk every element on every sampler round."""
+        total = 0
+        for spec in self.specs.values():
+            total += 64 * (len(spec.dirty) + len(spec.rolling)
+                           + len(spec.unrollable))
+            total += sum(56 + 28 * len(ids)
+                         for ids in spec.rolled.values())
+        return total
 
     async def _recover(self) -> None:
         """Load persisted specs; any rolled segment whose CURRENT SST

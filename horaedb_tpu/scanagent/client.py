@@ -61,6 +61,15 @@ _DEGRADED = registry.counter(
     "covered segments DROPPED because their shard was lost and "
     "[scanagent] fallback is disabled (degraded gather)")
 
+# memory plane (common/memledger.py): serialized partials buffered
+# between receive and decode.  Transient — a gather holds at most
+# max_inflight_per_agent responses per agent — but at 32 MB per
+# partial cap that is real RSS the coordinator must attribute
+from horaedb_tpu.common.memledger import ledger as _memledger  # noqa: E402
+
+_WIRE_ACCOUNT = _memledger.flow(
+    "scanagent_wire", kind="scanagent_wire", owner="scanagent/client")
+
 
 class AgentError(Error):
     """A per-segment agent failure the router may fall back on.
@@ -192,15 +201,33 @@ class ScanAgentClient:
                                     timeout=timeout,
                                     headers=headers) as resp:
                 if resp.status == 200:
-                    data = await resp.read()
-                    tracing.ingest_export(
-                        resp.headers.get(tracing.EXPORT_HEADER))
-                    _REQUESTS.labels(agent=agent.name,
-                                     outcome="ok").inc()
-                    _PARTIAL_BYTES.inc(len(data))
-                    tracing.trace_add("scanagent_partial_bytes",
-                                      len(data))
-                    return wire.decode_parts(data)
+                    # wire bytes are resident from the body read until
+                    # decode returns (the decoded parts re-own the
+                    # values as numpy).  Charged at Content-Length
+                    # BEFORE the read await — concurrent gathers'
+                    # in-flight bodies must overlap in the account,
+                    # which a charge around the synchronous decode
+                    # alone can never show — then trued up to the
+                    # actual size
+                    held = int(resp.headers.get("Content-Length") or 0)
+                    _WIRE_ACCOUNT.charge(held)
+                    try:
+                        data = await resp.read()
+                        if len(data) > held:
+                            _WIRE_ACCOUNT.charge(len(data) - held)
+                        elif held > len(data):
+                            _WIRE_ACCOUNT.credit(held - len(data))
+                        held = len(data)
+                        tracing.ingest_export(
+                            resp.headers.get(tracing.EXPORT_HEADER))
+                        _REQUESTS.labels(agent=agent.name,
+                                         outcome="ok").inc()
+                        _PARTIAL_BYTES.inc(len(data))
+                        tracing.trace_add("scanagent_partial_bytes",
+                                          len(data))
+                        return wire.decode_parts(data)
+                    finally:
+                        _WIRE_ACCOUNT.credit(held)
                 tracing.ingest_export(
                     resp.headers.get(tracing.EXPORT_HEADER))
                 err = await self._classify_error(agent, resp)

@@ -16,7 +16,7 @@ import typing
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
-from horaedb_tpu.common import Error, ReadableDuration, ensure
+from horaedb_tpu.common import Error, ReadableDuration, ReadableSize, ensure
 from horaedb_tpu.common.tenant import TenantsConfig, tenants_from_dict
 from horaedb_tpu.cluster.breaker import BreakerConfig
 from horaedb_tpu.metric_engine.meta import MetaConfig
@@ -109,6 +109,35 @@ class WatchdogConfig:
 
 
 @dataclass
+class MemoryConfig:
+    """[memory]: the process memory plane (common/memledger.py).
+    Every byte-holding component registers a ledger account; an RSS
+    sampler loop computes unattributed = RSS - Σ accounts and drives
+    soft/hard pressure watermarks.  `GET /debug/memory` serves the
+    account tree; memory_account_bytes{account=} / memory_rss_bytes /
+    memory_unattributed_bytes land on /metrics (and therefore in the
+    meta-ingest __meta table)."""
+
+    enabled: bool = True
+    # sampler period
+    interval: ReadableDuration = field(
+        default_factory=lambda: ReadableDuration.parse("5s"))
+    # pressure watermarks on RSS; false pins memory_pressure at 0
+    pressure: bool = True
+    # "0" auto-derives from the box's MemTotal (soft 70%, hard 85%).
+    # memory_pressure reads 0/1/2 and
+    # memory_pressure_transitions_total{level=} fires once per episode.
+    soft_limit: ReadableSize = field(
+        default_factory=lambda: ReadableSize(0))
+    hard_limit: ReadableSize = field(
+        default_factory=lambda: ReadableSize(0))
+    # de-escalation margin: pressure clears only once RSS drops below
+    # watermark * (1 - hysteresis), so breathing at the line is one
+    # episode, not a counter flood
+    hysteresis: float = 0.05
+
+
+@dataclass
 class TestConfig:
     """Write-load generator (ref: config.rs:48-57)."""
 
@@ -173,6 +202,9 @@ class ServerConfig:
     trace: TraceConfig = field(default_factory=TraceConfig)
     # background-loop watchdog (common/loops.py, GET /debug/tasks)
     watchdog: WatchdogConfig = field(default_factory=WatchdogConfig)
+    # memory plane: ledger sampler + pressure watermarks
+    # (common/memledger.py, GET /debug/memory)
+    memory: MemoryConfig = field(default_factory=MemoryConfig)
     # self-monitoring meta-ingest (metric_engine/meta.py)
     meta: MetaConfig = field(default_factory=MetaConfig)
     # near-data scan agents: shard map + routing policy (scanagent/);
@@ -202,6 +234,18 @@ def _dc_from_dict(cls: type, data: dict[str, Any]) -> Any:
                        f'{where} expects a duration string like "2h"')
                 value = ReadableDuration.parse(value)
             kwargs[key] = value
+        elif _hints(cls).get(key) is ReadableSize:
+            # sizes dispatch by declared type too: "512MiB" strings or
+            # bare byte integers
+            if not isinstance(value, ReadableSize):
+                ensure(isinstance(value, (str, int))
+                       and not isinstance(value, bool),
+                       f'{where} expects a size string like "512MiB" '
+                       'or a byte count')
+                value = (ReadableSize.parse(value)
+                         if isinstance(value, str)
+                         else ReadableSize(value))
+            kwargs[key] = value
         elif key == "test":
             ensure(isinstance(value, dict), f"{where} expects a config table")
             kwargs[key] = _dc_from_dict(TestConfig, value)
@@ -228,6 +272,9 @@ def _dc_from_dict(cls: type, data: dict[str, Any]) -> Any:
         elif key == "watchdog":
             ensure(isinstance(value, dict), f"{where} expects a config table")
             kwargs[key] = _dc_from_dict(WatchdogConfig, value)
+        elif key == "memory" and cls is ServerConfig:
+            ensure(isinstance(value, dict), f"{where} expects a config table")
+            kwargs[key] = _dc_from_dict(MemoryConfig, value)
         elif key == "meta":
             ensure(isinstance(value, dict), f"{where} expects a config table")
             kwargs[key] = _dc_from_dict(MetaConfig, value)
@@ -293,6 +340,13 @@ def load_config(path: Optional[str] = None) -> ServerConfig:
            "[watchdog] stall_factor must be >= 1")
     ensure(cfg.watchdog.interval.seconds > 0,
            "[watchdog] interval must be positive")
+    ensure(cfg.memory.interval.seconds > 0,
+           "[memory] interval must be positive")
+    ensure(0.0 <= cfg.memory.hysteresis <= 0.5,
+           "[memory] hysteresis must be in [0, 0.5]")
+    if cfg.memory.soft_limit.bytes and cfg.memory.hard_limit.bytes:
+        ensure(cfg.memory.soft_limit.bytes <= cfg.memory.hard_limit.bytes,
+               "[memory] soft_limit must not exceed hard_limit")
     if cfg.meta.enabled:
         ensure(cfg.meta.interval.seconds > 0,
                "[meta] interval must be positive")

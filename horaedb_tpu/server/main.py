@@ -41,6 +41,7 @@ from horaedb_tpu.common.deadline import (
     deadline_scope,
 )
 from horaedb_tpu.common.loops import loops
+from horaedb_tpu.common.memledger import ledger as memledger
 from horaedb_tpu.common.tenant import (
     QuotaExceeded,
     TenantRegistry,
@@ -73,7 +74,8 @@ _UNGOVERNED_ENDPOINTS = frozenset({
     "/", "/toggle", "/compact", "/metrics", "/stats",
     "/admin/scrub", "/admin/flush", "/admin/rollups",
     "/admin/tenants", "/admin/rebalance",
-    "/debug/traces", "/debug/traces/{trace_id}", "/debug/tasks"})
+    "/debug/traces", "/debug/traces/{trace_id}", "/debug/tasks",
+    "/debug/memory"})
 
 _SHED = registry.counter(
     "server_queries_shed_total",
@@ -424,6 +426,17 @@ class ServerState:
             interval_s=config.watchdog.interval.seconds,
             stall_factor=config.watchdog.stall_factor,
             min_stall_s=config.watchdog.min_stall.seconds)
+        # [memory] applies to the process-wide ledger: sampler cadence
+        # + pressure watermarks (0 auto-derives from MemTotal;
+        # pressure = false disables watermarks entirely)
+        memledger.configure(
+            enabled=config.memory.enabled,
+            interval_s=config.memory.interval.seconds,
+            soft_bytes=(config.memory.soft_limit.bytes
+                        if config.memory.pressure else -1),
+            hard_bytes=(config.memory.hard_limit.bytes
+                        if config.memory.pressure else -1),
+            hysteresis=config.memory.hysteresis)
         # a cluster-backed server applies its [breaker] section to the
         # engine's scatter-gather policy (the setter re-points breakers
         # of already-attached remote regions too)
@@ -906,6 +919,16 @@ def build_app(state: ServerState) -> web.Application:
             },
         })
 
+    @routes.get("/debug/memory")
+    async def debug_memory(_req: web.Request) -> web.Response:
+        """The memory ledger (common/memledger.py): the full account
+        tree (bytes/budget/utilization/high-water per kind, instance
+        detail), RSS, unattributed = RSS - Σ accounts (leaks positive,
+        double counting negative), pressure watermark state, and
+        per-device accelerator bytes where the backend reports them.
+        This is the byte-plane twin of /debug/tasks."""
+        return web.json_response(memledger.snapshot())
+
     @routes.get("/debug/traces/{trace_id}")
     async def debug_trace(req: web.Request) -> web.Response:
         """One trace as a JSON span tree: per-stage durations, cache
@@ -930,6 +953,8 @@ def build_app(state: ServerState) -> web.Application:
         # loops — degraded maintenance surfaces BEFORE query latency)
         out = await state.engine.stats()
         out["loops"] = loops.summary()
+        # the memory plane's compact rollup (full tree on /debug/memory)
+        out["memory"] = memledger.summary()
         if state.tenants is not None:
             out["tenants"] = _tenant_stats(state)
         return web.json_response(out)

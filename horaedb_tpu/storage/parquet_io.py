@@ -600,6 +600,18 @@ def _read_pruned_source(source, columns, leaves, memory_map) -> pa.Table:
 # segment it suddenly has to read itself (docs/robustness.md).
 STREAM_FETCH_MIN_BYTES = 64 << 20
 
+# memory plane (common/memledger.py): live streamed-SST mappings.
+# These bytes are page-cache-backed (the kernel can evict them under
+# pressure, unlike heap), but they still count against RSS while hot
+# and must be attributable — a dead-agent fallback streaming a dozen
+# 100 MB SSTs shows up HERE, not as a leak.  Charged at map time,
+# credited by a weakref finalizer when the last buffer reference
+# drops (the mapping's lifetime IS the buffer's).
+from horaedb_tpu.common.memledger import ledger as _memledger  # noqa: E402
+
+_STREAM_MMAP_ACCOUNT = _memledger.flow(
+    "streamed_mmap", kind="streamed_mmap", owner="storage/parquet_io")
+
 
 async def _fetch_mapped(store: ObjectStore, path: str, runtimes,
                         pool: str) -> pa.Buffer:
@@ -608,6 +620,7 @@ async def _fetch_mapped(store: ObjectStore, path: str, runtimes,
     store.get would have returned, without the resident copy."""
     import mmap
     import tempfile
+    import weakref
 
     f = tempfile.TemporaryFile(prefix="sst-stream-")
     try:
@@ -625,8 +638,10 @@ async def _fetch_mapped(store: ObjectStore, path: str, runtimes,
             return pa.py_buffer(b"")
         # the mapping (and the unlinked file behind it) lives exactly
         # as long as the returned buffer
-        return pa.py_buffer(mmap.mmap(f.fileno(), size,
-                                      access=mmap.ACCESS_READ))
+        mapped = mmap.mmap(f.fileno(), size, access=mmap.ACCESS_READ)
+        _STREAM_MMAP_ACCOUNT.charge(size)
+        weakref.finalize(mapped, _STREAM_MMAP_ACCOUNT.credit, size)
+        return pa.py_buffer(mapped)
     finally:
         f.close()
 

@@ -84,6 +84,16 @@ _INFLIGHT_BYTES = registry.gauge(
     "host bytes held in flight by scan pipelines (fetched parts + "
     "decoded windows not yet consumed)")
 
+# memory plane: pipeline in-flight bytes are transient (per-scan
+# budgets, exact-through-teardown) with no single resident owner, so
+# the process-level account reads the gauge the budgets already keep
+# exact — one source of truth, no double entry (common/memledger.py)
+from horaedb_tpu.common.memledger import ledger as _memledger  # noqa: E402
+
+_MEM_ACCOUNT = _memledger.register(
+    "pipeline_inflight", lambda: int(_INFLIGHT_BYTES.value),
+    kind="pipeline_inflight", owner="storage/pipeline")
+
 
 def stall_counts() -> dict:
     """Cumulative per-stage stall counts (bench/stats snapshots)."""

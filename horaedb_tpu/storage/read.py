@@ -41,6 +41,7 @@ import jax.numpy as jnp
 
 from horaedb_tpu.common.deadline import checkpoint as deadline_checkpoint
 from horaedb_tpu.common.error import Error, ensure
+from horaedb_tpu.common.memledger import ledger as memledger
 from horaedb_tpu.common.tenant import charge_scan_bytes
 from horaedb_tpu.objstore import NotFoundError, ObjectStore
 from horaedb_tpu.ops import downsample as downsample_ops
@@ -56,7 +57,7 @@ from horaedb_tpu.storage.types import (
 )
 from horaedb_tpu.ops import device_decode
 from horaedb_tpu.storage import combine as combine_mod, parquet_io, sidecar
-from horaedb_tpu.utils import registry, trace_add
+from horaedb_tpu.utils import active_trace, registry, trace_add
 
 logger = logging.getLogger(__name__)
 
@@ -424,6 +425,102 @@ class ParquetReader:
             from horaedb_tpu.parallel import segment_mesh
 
             self.mesh = segment_mesh(config.scan.mesh_devices)
+        # memory plane: every reader-owned byte budget registers a
+        # ledger account (common/memledger.py) tagged with its
+        # configured budget; close() deregisters so /debug/memory never
+        # serves phantom tables.  Anchored weakly on the reader — the
+        # ledger keeps nothing alive.  The pipeline module's process
+        # account (pipeline_inflight) must exist the moment a reader
+        # does, not at the first pipelined scan:
+        from horaedb_tpu.storage import pipeline as _pipeline  # noqa: F401
+        self._mem_accounts = [
+            # RESIDENT bytes, not the LRU's charged bytes: the LRU
+            # charges a worst-case per-window memo ALLOWANCE (budget
+            # semantics — resident can never exceed the budget), but
+            # the ledger must report what is actually allocated or
+            # unattributed goes negative by the unmaterialized slack
+            memledger.register(
+                f"scan_cache:{root_path}",
+                lambda r: r._scan_cache_resident_bytes(), anchor=self,
+                kind="scan_cache", budget=cache_bytes, owner=root_path),
+            # stacks are jnp arrays: host RAM on the CPU backend, HBM
+            # on accelerators — there they are NOT host RSS (they show
+            # under memory_device_bytes) and must not be subtracted
+            # from it, or unattributed goes negative by the stack size
+            memledger.register(
+                f"stack_cache:{root_path}",
+                lambda r: r._stack_cache_bytes, anchor=self,
+                kind="stack_cache", budget=self._stack_cache_max,
+                owner=root_path,
+                host=jax.default_backend() == "cpu"),
+            memledger.register(
+                f"encoded_cache:{root_path}",
+                lambda r: r.encoded_cache.total_bytes, anchor=self,
+                kind="encoded_cache",
+                budget=config.scan.cache.tier2_max_bytes,
+                owner=root_path),
+            memledger.register(
+                f"parts_memo:{root_path}",
+                lambda r: r.parts_memo.lru.total_bytes, anchor=self,
+                kind="parts_memo",
+                budget=config.scan.combine.memo_max_bytes,
+                owner=root_path),
+        ]
+
+    def close(self) -> None:
+        """Release every reader-owned cache tier and deregister ledger
+        accounts: a closed table holds ZERO attributable bytes (the
+        clear-on-close gauge discipline — scan_cache_bytes{tier=} and
+        the ledger's account gauges must read 0 afterwards)."""
+        self.drop_hbm_state()
+        self.scan_cache.clear()
+        self.encoded_cache.clear()
+        self.parts_memo.lru.clear()
+        self._scalar_cache.clear()
+        for acct in self._mem_accounts:
+            memledger.deregister(acct)
+        self._mem_accounts = []
+
+    def _scan_cache_resident_bytes(self) -> int:
+        """Actual bytes the tier-1 cache holds: column buffers at
+        their allocated (capacity-padded) widths plus MATERIALIZED
+        memo bytes — the ledger's pull gauge.  Differs from
+        scan_cache.total_bytes, which charges the worst-case memo
+        allowance up front (eviction must bound the budget; the
+        ledger must report residency).  Event-loop owned, like the
+        cache itself."""
+        total = 0
+        for windows in self.scan_cache.values():
+            for w in windows:
+                total += sum(int(c.dtype.itemsize) * w.capacity
+                             for c in w.columns.values())
+                total += int(w.memo_bytes)
+        return total
+
+    def _mem_delta_marks(self) -> Optional[list]:
+        """Per-trace memory attribution: snapshot this reader's cache
+        balances at scan start; _mem_delta_attribute() records the
+        deltas as mem_account_delta_<kind> trace counters — a cold
+        scan's trace shows WHICH tier its resident bytes landed in.
+        Reads the caches' CHARGED totals (integer reads — this runs
+        twice per traced query, so the sampler-only resident-bytes
+        walk has no place here).  None (no ambient trace / ledger
+        disabled) skips the bookwork."""
+        if not memledger.enabled or active_trace() is None:
+            return None
+        return [("scan_cache", self.scan_cache.total_bytes),
+                ("stack_cache", self._stack_cache_bytes),
+                ("encoded_cache", self.encoded_cache.total_bytes),
+                ("parts_memo", self.parts_memo.lru.total_bytes)]
+
+    def _mem_delta_attribute(self, marks: Optional[list]) -> None:
+        if not marks:
+            return
+        now = dict(self._mem_delta_marks() or ())
+        for kind, before in marks:
+            delta = now.get(kind, before) - before
+            if delta:
+                trace_add(f"mem_account_delta_{kind}", delta)
 
     # ---- plan construction -------------------------------------------------
 
@@ -459,6 +556,7 @@ class ParquetReader:
     # ---- execution ---------------------------------------------------------
 
     async def execute(self, plan: ScanPlan) -> AsyncIterator[pa.RecordBatch]:
+        marks = self._mem_delta_marks()
         seg_iter = self.execute_segments(plan)
         try:
             async for _seg_start, batch in seg_iter:
@@ -468,6 +566,7 @@ class ParquetReader:
             # an abandoned consumer must drain the pipeline NOW, not
             # at GC-time async-gen finalization
             await seg_iter.aclose()
+            self._mem_delta_attribute(marks)
 
     async def execute_segments(self, plan: ScanPlan):
         """Like execute(), but yields (segment_start, batch_or_None) —
@@ -1843,16 +1942,23 @@ class ParquetReader:
         (group_values, finalized grids) combined across all segments and
         windows.  group_values are decoded host values (e.g. tsids) in
         sorted order; each grid is (len(group_values), num_buckets)."""
-        if self.fused_aggregate_ok(plan):
-            return await self.execute_aggregate_fused(plan, spec)
-        # collected per segment and folded in segment order: memo-served
-        # segments may yield out of plan order, and the combine fold
-        # order is part of the bit-identity contract
-        done: dict[int, list] = {}
-        async for seg_start, seg_parts in self.aggregate_segments(plan, spec):
-            done[seg_start] = seg_parts
-        parts = [p for s in sorted(done) for p in done[s]]
-        return self.finalize_aggregate(parts, spec)
+        marks = self._mem_delta_marks()
+        try:
+            if self.fused_aggregate_ok(plan):
+                return await self.execute_aggregate_fused(plan, spec)
+            # collected per segment and folded in segment order:
+            # memo-served segments may yield out of plan order, and the
+            # combine fold order is part of the bit-identity contract
+            done: dict[int, list] = {}
+            async for seg_start, seg_parts in self.aggregate_segments(
+                    plan, spec):
+                done[seg_start] = seg_parts
+            parts = [p for s in sorted(done) for p in done[s]]
+            return self.finalize_aggregate(parts, spec)
+        finally:
+            # cold scans move megabytes into the cache tiers; the trace
+            # shows which account they landed in
+            self._mem_delta_attribute(marks)
 
     def router_covers(self, plan: ScanPlan) -> bool:
         """Whether the attached near-data router would serve any of

@@ -178,9 +178,11 @@ class CloudObjectStorage(TimeMergeStorage):
             await self.compact_scheduler.stop()
         if self.manifest is not None:
             await self.manifest.close()
-        # release tier-2 residency (and its process-wide byte gauge):
-        # a closed table's entries can never be read again
-        self.reader.encoded_cache.clear()
+        # release EVERY reader-owned cache tier (and the process-wide
+        # byte gauges + ledger accounts behind them): a closed table's
+        # entries can never be read again, and /debug/memory must not
+        # serve phantom tables
+        self.reader.close()
         if self._own_runtimes:
             self.runtimes.close()
 
@@ -397,44 +399,55 @@ class CloudObjectStorage(TimeMergeStorage):
         already live on device, so it keeps the host-side slice."""
         if first_plan is None:
             first_plan = await self.build_scan_plan(req)
-        if (self.reader.fused_aggregate_ok(first_plan)
-                and not self.reader.router_covers(first_plan)):
-            from horaedb_tpu.storage.plan import apply_top_k
+        # per-trace memory attribution (common/memledger.py): a cold
+        # aggregate moves megabytes into the cache tiers — the trace
+        # records which account they landed in
+        mem_marks = self.reader._mem_delta_marks()
+        try:
+            if (self.reader.fused_aggregate_ok(first_plan)
+                    and not self.reader.router_covers(first_plan)):
+                from horaedb_tpu.storage.plan import apply_top_k
 
-            counted: set = set()  # ops metrics survive restarts
-            plan = first_plan
+                counted: set = set()  # ops metrics survive restarts
+                plan = first_plan
+                for attempt in range(self._SCAN_RETRIES + 1):
+                    try:
+                        values, grids = \
+                            await self.reader.execute_aggregate_fused(
+                                plan, spec, counted=counted)
+                        if top_k is not None:
+                            values, grids = apply_top_k(values, grids,
+                                                        top_k)
+                        return values, grids
+                    except NotFoundError:
+                        if attempt == self._SCAN_RETRIES:
+                            raise
+                        logger.info("fused aggregate raced a compaction; "
+                                    "restarting")
+                        plan = await self.build_scan_plan(req)
+            done: dict[int, list] = {}
             for attempt in range(self._SCAN_RETRIES + 1):
+                # attempt 0 reuses the plan built for the fused gate —
+                # one manifest lookup per query, not two
+                plan = first_plan if attempt == 0 \
+                    else await self.build_scan_plan(req)
+                plan.segments = [s for s in plan.segments
+                                 if s.segment_start not in done]
                 try:
-                    values, grids = await self.reader.execute_aggregate_fused(
-                        plan, spec, counted=counted)
-                    if top_k is not None:
-                        values, grids = apply_top_k(values, grids, top_k)
-                    return values, grids
+                    async for seg_start, parts in \
+                            self.reader.aggregate_segments(plan, spec):
+                        done[seg_start] = parts
+                    break
                 except NotFoundError:
                     if attempt == self._SCAN_RETRIES:
                         raise
-                    logger.info("fused aggregate raced a compaction; "
-                                "restarting")
-                    plan = await self.build_scan_plan(req)
-        done: dict[int, list] = {}
-        for attempt in range(self._SCAN_RETRIES + 1):
-            # attempt 0 reuses the plan built for the fused gate — one
-            # manifest lookup per query, not two
-            plan = first_plan if attempt == 0 \
-                else await self.build_scan_plan(req)
-            plan.segments = [s for s in plan.segments
-                             if s.segment_start not in done]
-            try:
-                async for seg_start, parts in self.reader.aggregate_segments(
-                        plan, spec):
-                    done[seg_start] = parts
-                break
-            except NotFoundError:
-                if attempt == self._SCAN_RETRIES:
-                    raise
-                logger.info("aggregate scan raced a compaction; replanning")
-        all_parts = [p for seg in sorted(done) for p in done[seg]]
-        return self.reader.finalize_aggregate(all_parts, spec, top_k=top_k)
+                    logger.info("aggregate scan raced a compaction; "
+                                "replanning")
+            all_parts = [p for seg in sorted(done) for p in done[seg]]
+            return self.reader.finalize_aggregate(all_parts, spec,
+                                                  top_k=top_k)
+        finally:
+            self.reader._mem_delta_attribute(mem_marks)
 
     async def build_scan_plan(self, req: ScanRequest,
                               keep_builtin: bool = False) -> ScanPlan:

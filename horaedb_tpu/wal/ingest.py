@@ -39,6 +39,7 @@ import logging
 
 from horaedb_tpu.common.error import ensure
 from horaedb_tpu.common.loops import loops
+from horaedb_tpu.common.memledger import ledger as memledger
 from horaedb_tpu.common.tenant import current_tenant
 from horaedb_tpu.storage.config import UpdateMode
 from horaedb_tpu.storage.read import (
@@ -106,6 +107,8 @@ class IngestStorage(TimeMergeStorage):
         # flush-commit hook: called with the segment start after an SST
         # + manifest commit lands (the rollup manager's delta feed)
         self.on_flush = None
+        # ledger accounts (memtable bytes + WAL backlog), set by open()
+        self._mem_accounts: list = []
 
     def __getattr__(self, name):
         inner = self.__dict__.get("inner")
@@ -155,7 +158,32 @@ class IngestStorage(TimeMergeStorage):
             period_s=config.flush_interval.seconds,
             stall_threshold_s=300.0,
             backlog=self._flusher_backlog)
+        # memory plane (common/memledger.py): acked-but-unflushed rows
+        # live twice — arrow batches in memtables AND framed bytes in
+        # un-truncated WAL segments.  Both register; the memtable
+        # budget is the flush threshold (utilization > 1 = the flusher
+        # is behind), the WAL backlog is unbudgeted by design (it
+        # truncates after flush).  close() deregisters.
+        self._mem_accounts = [
+            memledger.register(
+                f"memtable:{wal_dir}", lambda s: s.memtable_bytes_now(),
+                anchor=self, kind="memtable",
+                budget=config.flush_bytes, owner=wal_dir),
+            memledger.register(
+                f"wal_backlog:{wal_dir}",
+                lambda s: s.wal.backlog_bytes, anchor=self,
+                kind="wal_backlog", owner=wal_dir),
+        ]
         return self
+
+    def memtable_bytes_now(self) -> int:
+        """Arrow bytes across live AND flush-in-flight memtables (the
+        ledger's pull gauge; flush-in-flight rows are still resident
+        until their SST commits)."""
+        total = sum(mt.bytes for mt in self._memtables.values())
+        for mts in self._flushing.values():
+            total += sum(mt.bytes for mt in mts)
+        return total
 
     def _flusher_backlog(self) -> dict:
         """/debug/tasks backlog hint: what the flusher is behind on."""
@@ -183,6 +211,9 @@ class IngestStorage(TimeMergeStorage):
         for mt in self._memtables.values():
             mt.account_drop()
         self._memtables = {}
+        for acct in self._mem_accounts:
+            memledger.deregister(acct)
+        self._mem_accounts = []
         await self.inner.close()
 
     async def abort(self) -> None:

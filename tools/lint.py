@@ -61,6 +61,15 @@ Checks, all hard failures:
     COORDINATOR side (outside agent.py) is an error — covered-segment
     fallbacks go through the reader's local pump, the one declared
     fallback seam
+  - memory-ledger budget discipline under horaedb_tpu/: every byte
+    budget a config dataclass exposes (a field named `*_bytes`) must
+    correspond to a memory-ledger account registered at open
+    (common/memledger.py) — mapped in _BUDGET_FIELD_ACCOUNTS to the
+    account kind its owner registers, or listed in
+    _BUDGET_FIELD_EXEMPT with the reason it holds no resident bytes.
+    A budget nobody ledgers is RSS nobody can attribute, which is how
+    the 1B-row ladder's "169 GiB projected" stays hand math
+    (docs/observability.md, memory plane)
   - combine grid discipline under horaedb_tpu/: allocating a dense
     `(groups, num_buckets)`-shaped array (np.zeros/full/empty/ones
     with a 2-tuple shape whose second element is named like a bucket
@@ -716,13 +725,129 @@ def _lint_server_routes(path: pathlib.Path, tree: ast.AST,
     return problems
 
 
+# ---- memory-ledger budget discipline (cross-file) -------------------------
+# Config byte-budget field -> the ledger account kind its owning
+# component registers at open.  New `*_bytes` config fields must be
+# added here (and their owner must register the account) or to the
+# exempt set below with the reason they hold no resident bytes.
+_BUDGET_FIELD_ACCOUNTS = {
+    "cache_max_bytes": "scan_cache",        # HBM windows + stacks (read.py)
+    "tier2_max_bytes": "encoded_cache",     # host-RAM encoded parts
+    "memo_max_bytes": "parts_memo",         # aggregate-partial memo
+    "inflight_bytes": "pipeline_inflight",  # pipeline in-flight budget
+    "flush_bytes": "memtable",              # memtable flush threshold
+}
+_BUDGET_FIELD_EXEMPT = {
+    # [scan.decode] per-dispatch upload admission gate: the upload
+    # lives on DEVICE for one dispatch (memory_device_bytes covers it)
+    "max_upload_bytes",
+    # [scanagent] response-size refusal cap: an agent never buffers
+    # past it, and the coordinator's received partials are charged to
+    # the scanagent_wire flow account
+    "max_partial_bytes",
+    # [tenants] token-bucket burst capacities: RATE limits (bytes per
+    # second), not resident bytes
+    "scan_burst_bytes", "wal_burst_bytes",
+    # [scan] whole-segment-vs-streamed routing threshold; the streamed
+    # bytes themselves are charged to the streamed_mmap flow account
+    "stream_read_min_bytes",
+    # [wal] segment ROTATION size and group-commit coalescing bound:
+    # sizing knobs for on-disk files / a transient commit queue — the
+    # resident WAL bytes are the wal_backlog account
+    "segment_bytes", "max_group_bytes",
+    # ops.encode.DeviceBatch per-window memo state counter, not a
+    # config budget: charged inside the scan_cache account's
+    # windows_nbytes memo allowance
+    "memo_bytes",
+}
+
+
+def _is_dataclass_def(node: ast.ClassDef) -> bool:
+    for dec in node.decorator_list:
+        name = dec.func if isinstance(dec, ast.Call) else dec
+        if isinstance(name, ast.Attribute) and name.attr == "dataclass":
+            return True
+        if isinstance(name, ast.Name) and name.id == "dataclass":
+            return True
+    return False
+
+
+def lint_budget_accounts(files: list[pathlib.Path]) -> list[str]:
+    """Cross-file pass: collect every config dataclass field named
+    `*_bytes` under horaedb_tpu/ and every ledger registration's
+    account kind, then require each budget field to be mapped to a
+    registered kind (or explicitly exempted).
+
+    Budget fields and their registrations live in DIFFERENT files, so
+    a subset invocation (`python tools/lint.py horaedb_tpu/storage/
+    config.py`) must still see the whole package's registrations or
+    every budget field in the subset false-positives — the scan set is
+    the given files UNION the repo's horaedb_tpu/ tree."""
+    budget_fields: list[tuple[str, int, str]] = []  # (file, line, field)
+    registered_kinds: set[str] = set()
+    scan = {p.resolve() for p in files if "horaedb_tpu" in str(p)}
+    pkg = pathlib.Path(__file__).resolve().parent.parent / "horaedb_tpu"
+    if pkg.is_dir():
+        scan |= {p.resolve() for p in iter_files([str(pkg)])}
+    for path in sorted(scan):
+        try:
+            tree = ast.parse(path.read_text(), filename=str(path))
+        except SyntaxError:
+            continue  # lint_file already reported it
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef) and _is_dataclass_def(node):
+                for stmt in node.body:
+                    if (isinstance(stmt, ast.AnnAssign)
+                            and isinstance(stmt.target, ast.Name)
+                            and stmt.target.id.endswith("_bytes")):
+                        budget_fields.append(
+                            (str(path), stmt.lineno, stmt.target.id))
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("register", "flow")
+                    and any(n in ("memledger", "ledger", "_memledger")
+                            for n in _receiver_chain(node.func))):
+                kind = None
+                for kw in node.keywords:
+                    if (kw.arg == "kind"
+                            and isinstance(kw.value, ast.Constant)):
+                        kind = kw.value.value
+                if (kind is None and node.args
+                        and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)):
+                    kind = node.args[0].value.split(":", 1)[0]
+                if kind:
+                    registered_kinds.add(kind)
+    problems = []
+    for fname, lineno, field in budget_fields:
+        if field in _BUDGET_FIELD_EXEMPT:
+            continue
+        kind = _BUDGET_FIELD_ACCOUNTS.get(field)
+        if kind is None:
+            problems.append(
+                f"{fname}:{lineno}: byte-budget config field "
+                f"{field!r} has no memory-ledger account mapping — add "
+                "a ledger.register(...) at the owning component's open "
+                "and map it in tools/lint.py _BUDGET_FIELD_ACCOUNTS "
+                "(or exempt it with a reason)")
+        elif kind not in registered_kinds:
+            problems.append(
+                f"{fname}:{lineno}: budget field {field!r} maps to "
+                f"ledger account kind {kind!r} but no "
+                f"ledger.register/flow call registers that kind under "
+                "horaedb_tpu/")
+    return problems
+
+
 def main() -> int:
     paths = sys.argv[1:] or DEFAULT_PATHS
     all_problems: list[str] = []
     n = 0
-    for f in iter_files(paths):
+    files = list(iter_files(paths))
+    for f in files:
         n += 1
         all_problems.extend(lint_file(f))
+    all_problems.extend(lint_budget_accounts(files))
     for p in all_problems:
         print(p)
     print(f"lint: {n} files, {len(all_problems)} problems",
