@@ -36,6 +36,9 @@ DECODE_SCHEDULES ?= 20
 SCANAGENT_SEED ?= 1337
 SCANAGENT_SCHEDULES ?= 15
 
+MESH_SEED ?= 1337
+MESH_SCHEDULES ?= 12
+
 chaos:
 	TORTURE_SEED=$(TORTURE_SEED) TORTURE_SCHEDULES=$(TORTURE_SCHEDULES) \
 	WAL_TORTURE_SEED=$(WAL_TORTURE_SEED) \
@@ -54,12 +57,14 @@ chaos:
 	DECODE_SCHEDULES=$(DECODE_SCHEDULES) \
 	SCANAGENT_SEED=$(SCANAGENT_SEED) \
 	SCANAGENT_SCHEDULES=$(SCANAGENT_SCHEDULES) \
+	MESH_SEED=$(MESH_SEED) \
+	MESH_SCHEDULES=$(MESH_SCHEDULES) \
 	python -m pytest tests/test_fault_injection.py tests/test_torture.py \
 	tests/test_objstore_middleware.py tests/test_wal.py \
 	tests/test_scan_cache.py tests/test_rollup.py \
 	tests/test_pipeline.py tests/test_combine.py \
 	tests/test_tenant.py tests/test_device_decode.py \
-	tests/test_scanagent.py -q
+	tests/test_scanagent.py tests/test_mesh_scan.py -q
 
 # stdlib AST lint gate (the reference CI runs fmt+clippy -D warnings;
 # this image ships no ruff/flake8, so the gate is tools/lint.py)
@@ -78,6 +83,15 @@ trace-demo:
 # timeout status instead of silence (ROADMAP item 3 recording gap)
 multichip:
 	python tools/multichip_run.py --devices 8 --timeout 600
+
+# the mesh-scan A/B under the same always-record discipline: runs
+# BENCH_CONFIG=19 (mesh-on vs single-chip control, in-bench
+# bit-identity + top-k egress assertions) on the 8-virtual-device CPU
+# mesh and ALWAYS writes bench_results/multichip_rNN.json; on a TPU
+# host the same command re-grades with real chips (tpu_verified
+# discipline)
+multichip-mesh:
+	python tools/multichip_run.py --mode mesh --devices 8 --timeout 900
 
 # the driver-facing deliverables, end to end: lint + full suite + the
 # fixed-seed chaos gate + the multi-chip dryrun on the virtual CPU mesh

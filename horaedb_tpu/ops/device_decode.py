@@ -98,6 +98,25 @@ _FALLBACKS = registry.counter(
 _FALLBACK_CHILDREN = {r: _FALLBACKS.labels(reason=r)
                       for r in FALLBACK_REASONS}
 
+# compaction-aware sort-free routing (ROADMAP item 2c): per-segment
+# routed-vs-sorted evidence for the fused dispatch's O(n log n) device
+# sort — the steady-state post-compaction scan should read ~all
+# "compacted"
+_SORT_SKIPPED = {
+    route: registry.counter(
+        "scan_decode_sort_skipped_total",
+        "fused decode dispatches that skipped the device lax.sort: "
+        "compacted = single-run segment, (pk, seq)-sorted by "
+        "construction (no host check either); checked = the one-pass "
+        "host sortedness check proved the concatenated runs sorted"
+    ).labels(route=route)
+    for route in ("compacted", "checked")
+}
+_SORT_RAN = registry.counter(
+    "scan_decode_sorted_total",
+    "fused decode dispatches that paid the device lax.sort "
+    "(multi-run interleaved segments)")
+
 
 def note_fallback(reason: str) -> None:
     child = _FALLBACK_CHILDREN.get(reason)
@@ -569,12 +588,23 @@ def prepare_dispatch(es, spec, pk_names: list, seq_name: str,
     if cap * 4 * len(upload_names) > max_bytes:
         return "budget"
 
-    # one vectorized compare pass decides whether the device program
-    # needs its sort at all — the steady-state cold scan (one compacted
-    # SST per segment) skips it, so decode stays a pad + upload +
-    # elementwise program there
-    presorted = _lex_sorted_np(
-        [es.columns[nm] for nm in pk_names] + [es.columns[seq_name]])
+    # compaction-aware sort-free routing: a single-run segment (the
+    # post-compaction steady state) is (pk, seq)-sorted by
+    # construction — both write paths sort before the SST put and
+    # compaction emits merge-sorted — so it routes sort-free without
+    # even the one-pass host check; multi-run segments pay the check,
+    # and only segments it cannot prove sorted pay the device
+    # lax.sort.  Routed-vs-sorted is counted per segment.
+    if es.source_runs == 1:
+        presorted = True
+        _SORT_SKIPPED["compacted"].inc()
+    else:
+        presorted = _lex_sorted_np(
+            [es.columns[nm] for nm in pk_names] + [es.columns[seq_name]])
+        if presorted:
+            _SORT_SKIPPED["checked"].inc()
+        else:
+            _SORT_RAN.inc()
     local_ok = ts_enc.kind == "offset"
     lo = max(0, shift // spec.bucket_ms) if local_ok else 0
     use_width = width if local_ok else spec.num_buckets

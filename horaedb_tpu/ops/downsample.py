@@ -136,6 +136,33 @@ def window_local_partials(ts, gid_local, vals, remap, shift, lo,
                              num_buckets=num_buckets, which=which)
 
 
+def combine_partial_pair(cur: dict, prev: dict) -> dict:
+    """Pairwise combine of two partial-grid dicts over the SAME local
+    bucket span — the associative op of the mesh scan's segmented time
+    -axis reduction (parallel/scan.py mesh_run_partials).  `prev` is
+    the EARLIER prefix; ties on last_ts keep `cur` (later window wins,
+    mirroring the host fold's `>=` take in storage/combine.py).
+
+    Exactness: count adds are exact integer-valued f32 while a cell's
+    combined count stays < 2^24 (the dispatcher bounds time_axis x
+    capacity); min/max/last are selection ops; sum is exact only for
+    cells with a single contributing window — the dispatcher's overlap
+    gate keeps multi-contributor sums off the mesh."""
+    out = {"count": cur["count"] + prev["count"]}
+    if "sum" in cur:
+        out["sum"] = cur["sum"] + prev["sum"]
+    if "min" in cur:
+        out["min"] = jnp.minimum(cur["min"], prev["min"])
+    if "max" in cur:
+        out["max"] = jnp.maximum(cur["max"], prev["max"])
+    if "last" in cur:
+        take_cur = cur["last_ts"] >= prev["last_ts"]
+        out["last"] = jnp.where(take_cur, cur["last"], prev["last"])
+        out["last_ts"] = jnp.where(take_cur, cur["last_ts"],
+                                   prev["last_ts"])
+    return out
+
+
 def finalize_aggregate(partial: dict, which: tuple = ALL_AGGS) -> dict:
     """Turn combined partial grids into user-facing aggregates.
     Empty cells: count 0, sum 0, min +inf, max -inf, avg/last NaN.

@@ -15,9 +15,12 @@ over ICI — never row data.
 # `from horaedb_tpu.parallel import multihost` stays backend-free.
 _EXPORTS = {
     "segment_mesh": "horaedb_tpu.parallel.mesh",
+    "scan_mesh": "horaedb_tpu.parallel.mesh",
+    "default_scan_shape": "horaedb_tpu.parallel.mesh",
     "sharded_downsample_query": "horaedb_tpu.parallel.scan",
     "sharded_merge_dedup": "horaedb_tpu.parallel.scan",
     "sharded_remap_partials": "horaedb_tpu.parallel.scan",
+    "mesh_run_partials": "horaedb_tpu.parallel.scan",
     "multihost": "horaedb_tpu.parallel.multihost",
 }
 

@@ -20,23 +20,53 @@ the reference's cross-partition SortPreservingMergeExec, except only
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
 try:
     from jax import shard_map
 except ImportError:  # older jax: pre-promotion experimental namespace
+    import inspect
+
     from jax.experimental.shard_map import shard_map as _shard_map_compat
 
+    _COMPAT_PARAMS = inspect.signature(_shard_map_compat).parameters
+    _COMPAT_VAR_KW = any(
+        p.kind is inspect.Parameter.VAR_KEYWORD
+        for p in _COMPAT_PARAMS.values())
+
     def shard_map(f, *, check_vma=True, **kw):
-        # the experimental API spells replication checking `check_rep`
-        return _shard_map_compat(f, check_rep=check_vma, **kw)
+        """Compat shim for pre-promotion jax: the experimental API
+        spells replication checking `check_rep`.  Every other kwarg
+        forwards verbatim, and one this jax's shard_map does not accept
+        raises HERE with the offending names — silently dropping it
+        would mask future jax API drift behind subtly-wrong programs."""
+        if "check_rep" in _COMPAT_PARAMS or _COMPAT_VAR_KW:
+            kw.setdefault("check_rep", check_vma)
+        elif "check_vma" in _COMPAT_PARAMS:
+            kw.setdefault("check_vma", check_vma)
+        else:
+            raise TypeError(
+                "jax.experimental.shard_map.shard_map accepts neither "
+                "check_rep nor check_vma; update the compat shim in "
+                "horaedb_tpu/parallel/scan.py for this jax version")
+        if not _COMPAT_VAR_KW:
+            unknown = sorted(k for k in kw if k not in _COMPAT_PARAMS)
+            if unknown:
+                raise TypeError(
+                    f"shard_map compat shim: kwargs {unknown} are not "
+                    "accepted by this jax version's experimental "
+                    "shard_map — fix the call site or the shim, do not "
+                    "drop them")
+        return _shard_map_compat(f, **kw)
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from horaedb_tpu.common.error import Error
 from horaedb_tpu.ops import downsample, merge as merge_ops
 from horaedb_tpu.ops.topk import top_k_groups
-from horaedb_tpu.parallel.mesh import SEGMENT_AXIS
+from horaedb_tpu.parallel.mesh import SEGMENT_AXIS, SERIES_AXIS, TIME_AXIS
 
 
 def _check_block_is_one(block) -> None:
@@ -203,3 +233,200 @@ def sharded_merge_dedup(mesh, *, num_pks: int):
 def shard_leading_axis(mesh, arr):
     """Place an (n_devices, ...) host array sharded over the segment axis."""
     return jax.device_put(arr, NamedSharding(mesh, P(SEGMENT_AXIS)))
+
+
+# ---------------------------------------------------------------------------
+# the 2-D (time, series) scan mesh ([scan.mesh]; docs/parallel.md)
+# ---------------------------------------------------------------------------
+
+
+def shard_time_axis(mesh, arr):
+    """Place a (time, ...) host array sharded over the scan mesh's time
+    axis, replicated over series.  Series shards re-aggregate every row
+    for their own group block — the series axis divides resident grid
+    STATE and combine egress, not row work (the output-parallel layout;
+    docs/parallel.md)."""
+    return jax.device_put(arr, NamedSharding(mesh, P(TIME_AXIS)))
+
+
+def mesh_run_partials(mesh, *, num_groups: int, num_buckets: int,
+                      which: tuple):
+    """The 2-D mesh scan program: per-window partial grids sharded
+    (time = one merge window per slot, series = group blocks) and a
+    SEGMENTED reduction over the time axis — same-segment slots combine
+    into per-run grids via a log2(time) ppermute tree, different
+    segments never mix (parts stay per-segment, the PartsMemo / replan
+    contract).
+
+    fn(ts, gid, vals, remap, shift, lo, seg_ids, total, bucket_ms):
+      ts/gid/vals: (time, capacity) sharded on the time axis;
+      remap: (time, num_groups) int32 — window-local code -> round row;
+      shift/lo: (time,) int32 per-window epoch offset / first bucket;
+      seg_ids: (time,) int32 — slots of one segment share an id and
+        are CONSECUTIVE (plan-order slot admission); padding slots
+        carry unique negative ids so they never combine;
+      total: replicated scalar global bucket count; bucket_ms: (1,).
+
+    Output: dict of (time, num_groups, num_buckets) grids sharded
+    (time, series); slot t holds the combined grids of its segment's
+    slots up to t (inclusive segmented scan), so a run's TAIL slot
+    holds the whole run — the host downloads tails only.
+
+    Exactness contract (the mesh-off byte-identity proof, chaos
+    -asserted): each window's partials are computed by the SAME
+    full-width scatter program as the single-device path and only then
+    block-sliced per series shard; the time-axis combine is exact for
+    count (integer f32 adds, dispatcher-bounded < 2^24), min/max/last
+    (selection ops, later-slot tie-break = the host fold's `>=` take),
+    and for sum exactly when no cell has two contributing windows —
+    the dispatcher's overlap gate routes anything else off the mesh
+    (read.py _flush_mesh_round)."""
+    time_n = int(mesh.shape[TIME_AXIS])
+    series_n = int(mesh.shape[SERIES_AXIS])
+    if num_groups % series_n:
+        raise Error(
+            f"mesh group space {num_groups} not divisible by the "
+            f"series axis ({series_n}) — pad g to a multiple")
+    gb = num_groups // series_n
+
+    def shard_fn(ts, gid, vals, remap, shift, lo, seg_ids, total,
+                 bucket_ms):
+        _check_block_is_one(ts)
+        p = downsample.window_local_partials(
+            ts[0], gid[0], vals[0], remap[0], shift[0], lo[0], total,
+            bucket_ms[0], num_groups=num_groups,
+            num_buckets=num_buckets, which=which)
+        # full-width compute, series-block slice AFTER: the scatter
+        # program (and therefore every cell's f32 accumulation order)
+        # is the single-device kernel's; only the RESIDENT state and
+        # the collective payload shrink to the (gb, width) block
+        j = jax.lax.axis_index(SERIES_AXIS)
+        p = {k: jax.lax.dynamic_slice_in_dim(v, j * gb, gb, axis=0)
+             for k, v in p.items()}
+        sid = seg_ids  # (1,) block: ppermute needs an array operand
+        state = p
+        step = 1
+        while step < time_n:
+            perm = [(i, i + step) for i in range(time_n - step)]
+
+            def recv(a, _perm=perm):
+                return jax.lax.ppermute(a, TIME_AXIS, _perm)
+
+            prev = {k: recv(v) for k, v in state.items()}
+            prev_sid = recv(sid)
+            prev_live = recv(jnp.ones_like(sid))
+            # combine ONLY when the left neighbour's prefix belongs to
+            # this slot's segment (ppermute hands zeros to slots with
+            # no left neighbour — prev_live masks them out)
+            ok = (prev_live[0] > 0) & (prev_sid[0] == sid[0])
+            combined = downsample.combine_partial_pair(state, prev)
+            state = {k: jnp.where(ok, combined[k], state[k])
+                     for k in state}
+            step *= 2
+        return {k: v[None] for k, v in state.items()}
+
+    mapped = shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P(TIME_AXIS, None), P(TIME_AXIS, None),
+                  P(TIME_AXIS, None), P(TIME_AXIS, None),
+                  P(TIME_AXIS), P(TIME_AXIS), P(TIME_AXIS), P(), P()),
+        out_specs=P(TIME_AXIS, SERIES_AXIS),
+        check_vma=False,
+    )
+    return jax.jit(mapped)
+
+
+# ---- device-resident top-k score state -------------------------------------
+#
+# The egress-bounded top-k path (read._aggregate_topk_mesh): the round
+# outputs above stay on the mesh; only a per-group score vector and the
+# k winners' grid rows ever download.  Rankings by min/max/last are
+# SELECTION ops, so accumulating their cells across rounds on device is
+# exact — count/sum/avg rankings are additive and take the full-parts
+# path instead (reason-counted).  These helpers are plain jitted jnp on
+# the sharded round outputs; XLA's sharding propagation keeps the state
+# series-partitioned (the round program owns the explicit collectives).
+#
+# Prefix slots (non-tails of the segmented scan) feed the state too:
+# for selection ops a prefix's cells are a subset of its run's, so the
+# duplicate combine is a no-op — no tail masking needed on device.
+
+_TS_MIN = jnp.int32(-(2**31))
+
+
+def mesh_score_init(num_groups: int, padded_buckets: int, by: str):
+    """Identity-filled score state.  `padded_buckets` leaves one round
+    -width of slack past the query's grid so per-slot dynamic slices
+    never clamp (out-of-range buckets are empty cells by construction
+    — window_local_partials drops rows past `total`)."""
+    shape = (num_groups, padded_buckets)
+    fill = {"min": jnp.finfo(jnp.float32).max,
+            "max": -jnp.finfo(jnp.float32).max,
+            "last": 0.0}[by]
+    state = {"by": jnp.full(shape, jnp.float32(fill)),
+             "has": jnp.zeros(shape, dtype=bool)}
+    if by == "last":
+        state["ts"] = jnp.full(shape, _TS_MIN)
+    return state
+
+
+@functools.partial(jax.jit, static_argnames=("by",), donate_argnums=(0,))
+def mesh_score_update(state: dict, by_grid, count_grid, last_ts, lo,
+                      bucket_ms, *, by: str):
+    """Fold one round's (time, groups, width) outputs into the score
+    state, slot by slot in time order (the host fold's later-wins tie
+    -break for `last`).  `last_ts` is None unless by == "last"; `lo`
+    is the per-slot (time,) first-bucket offset."""
+    width = by_grid.shape[2]
+
+    def body(t, st):
+        has_t = count_grid[t] > 0
+        cur_by = jax.lax.dynamic_slice(
+            st["by"], (0, lo[t]), (st["by"].shape[0], width))
+        cur_has = jax.lax.dynamic_slice(
+            st["has"], (0, lo[t]), (st["has"].shape[0], width))
+        if by == "min":
+            new_by = jnp.minimum(cur_by, by_grid[t])
+        elif by == "max":
+            new_by = jnp.maximum(cur_by, by_grid[t])
+        else:  # last: select by global (range-relative) timestamp
+            cur_ts = jax.lax.dynamic_slice(
+                st["ts"], (0, lo[t]), (st["ts"].shape[0], width))
+            cand_ts = jnp.where(has_t,
+                                last_ts[t] + lo[t] * bucket_ms, _TS_MIN)
+            take = cand_ts >= cur_ts
+            new_by = jnp.where(take, by_grid[t], cur_by)
+            st = dict(st)
+            st["ts"] = jax.lax.dynamic_update_slice(
+                st["ts"], jnp.where(take, cand_ts, cur_ts), (0, lo[t]))
+        out = dict(st)
+        out["by"] = jax.lax.dynamic_update_slice(st["by"], new_by,
+                                                 (0, lo[t]))
+        out["has"] = jax.lax.dynamic_update_slice(
+            st["has"], cur_has | has_t, (0, lo[t]))
+        return out
+
+    return jax.lax.fori_loop(0, by_grid.shape[0], body, state)
+
+
+@functools.partial(jax.jit, static_argnames=("largest", "num_buckets"))
+def mesh_score_finalize(state: dict, *, largest: bool, num_buckets: int):
+    """(scores, has_any) per group — the ONLY full-group bytes the
+    top-k path downloads.  Score formula mirrors combine_top_k's: the
+    best count>0 cell of the ranking grid (NaN cells propagate, as in
+    the host's np.max)."""
+    by_grid = state["by"][:, :num_buckets]
+    has = state["has"][:, :num_buckets]
+    if largest:
+        scores = jnp.where(has, by_grid, -jnp.inf).max(axis=1)
+    else:
+        scores = jnp.where(has, by_grid, jnp.inf).min(axis=1)
+    return scores, has.any(axis=1)
+
+
+@jax.jit
+def mesh_take_rows(grids: dict, idx):
+    """Winner-row gather on device: (time, groups, width) round outputs
+    sliced to the k winners' rows BEFORE download — the O(k x buckets
+    x aggs) per-chip combine egress."""
+    return {k: jnp.take(v, idx, axis=1) for k, v in grids.items()}

@@ -190,10 +190,17 @@ def combine_aggregate_parts(parts: list[tuple[np.ndarray, int, dict]],
         with np.errstate(invalid="ignore", divide="ignore"):
             out["avg"] = np.where(empty, np.nan,
                                   acc["sum"] / np.maximum(acc["count"], 1))
+    # count-0 cells read the documented +/-inf identities REGARDLESS
+    # of part coverage: a part whose span merely touched the cell left
+    # the device kernel's F32_MAX fill behind, which made empty-cell
+    # bytes depend on round/part composition (host windows vs device
+    # decode vs mesh runs carry different group unions).  The fused
+    # path always masked (_fused_finalize_jit); the parts path now
+    # matches it — and the module contract above.
     if "min" in acc:
-        out["min"] = acc["min"]
+        out["min"] = np.where(empty, np.inf, acc["min"])
     if "max" in acc:
-        out["max"] = acc["max"]
+        out["max"] = np.where(empty, -np.inf, acc["max"])
     if "last" in acc:
         out["last"] = np.where(empty, np.nan, acc["last"])
         # exposed (as float, NaN for empty) so cross-region merges can
@@ -254,7 +261,7 @@ def _finalize_in_place(acc: dict, requested: set, want: set) -> dict:
     to sum / max(count, 1) there) and NaNs the rest."""
     out = {"count": acc["count"]}
     empty = None
-    if ("avg" in want or "last" in acc):
+    if "avg" in want or "last" in acc or "min" in acc or "max" in acc:
         empty = acc["count"] == 0
     if "sum" in acc and "sum" in requested:
         out["sum"] = acc["sum"]
@@ -263,10 +270,17 @@ def _finalize_in_place(acc: dict, requested: set, want: set) -> dict:
         np.divide(acc["sum"], acc["count"], out=avg, where=~empty)
         avg[empty] = np.nan
         out["avg"] = avg
+    # count-0 min/max cells read the +/-inf identities regardless of
+    # part coverage (see combine_aggregate_parts — the dense control
+    # applies the same mask, so the two stay byte-identical)
     if "min" in acc:
-        out["min"] = acc["min"]
+        mv = acc["min"]
+        mv[empty] = np.inf
+        out["min"] = mv
     if "max" in acc:
-        out["max"] = acc["max"]
+        xv = acc["max"]
+        xv[empty] = -np.inf
+        out["max"] = xv
     if "last" in acc:
         last = acc["last"]
         last[empty] = np.nan
@@ -436,6 +450,20 @@ def _group_span(parts: list, fspan: Optional[tuple[int, int]],
     return lo, max(his) - lo
 
 
+def rank_top_k(kept_rows: list, scores, tk) -> list:
+    """THE top-k ranking: stable argsort over the kept groups' scores
+    in ascending group-row order (post-drop sorted order — the dense
+    path's tie-break), best first, sliced to k.  Shared by
+    combine_top_k and the mesh's device-scored path (read.py
+    _aggregate_topk_mesh) so the two selections cannot drift."""
+    score_arr = np.asarray(scores, dtype=np.float64)
+    if tk.largest:
+        order = np.argsort(-score_arr, kind="stable")
+    else:
+        order = np.argsort(score_arr, kind="stable")
+    return [kept_rows[i] for i in order[:tk.k]]
+
+
 def _score_buf(bufs: dict, by: str, span_w: int,
                count: np.ndarray) -> np.ndarray:
     """Per-cell ranking values over a group's span, matching the dense
@@ -503,12 +531,7 @@ def combine_top_k(parts: list, num_buckets: int, which: tuple,
             s = float(np.min(np.where(has, by_vals, np.inf)))
         kept_rows.append(r)
         scores.append(s)
-    score_arr = np.asarray(scores, dtype=np.float64)
-    if tk.largest:
-        order = np.argsort(-score_arr, kind="stable")
-    else:
-        order = np.argsort(score_arr, kind="stable")
-    winners = [kept_rows[i] for i in order[:tk.k]]
+    winners = rank_top_k(kept_rows, scores, tk)
 
     # materialize ONLY the winners, best first.  An all-empty-group
     # result still goes through the identity/finalize pair so dtypes

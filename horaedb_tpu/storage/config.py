@@ -234,6 +234,32 @@ class ScanPipelineConfig:
 
 
 @dataclass
+class ScanMeshConfig:
+    """In-region 2-D device mesh for the aggregate scan ([scan.mesh];
+    parallel/mesh.py, docs/parallel.md): plan segments shard along the
+    `time` axis (one merge window per slot, plan-order admission),
+    group/tsid blocks along the `series` axis, with an on-mesh
+    segmented-reduction combine so a segment-run's windows fold on the
+    mesh and only per-run (and, for top-k, per-winner) grids leave a
+    chip.  `enabled = false` (default) reproduces the single-chip path
+    exactly — THE bit-identity control the seeded chaos suite compares
+    against (tests/test_mesh_scan.py)."""
+
+    enabled: bool = False
+    # axis sizes; 0 = auto (all local devices, factored by
+    # parallel.mesh.default_scan_shape).  `series` must be a power of
+    # two — it must divide the padded group space.
+    time: int = 0
+    series: int = 0
+    # per-device admission gate for one round's transient partial grid
+    # (g_pad x width x aggs x 4B): rounds that would exceed it fall
+    # back to the single-chip kernel (reason="grid_budget").  Pure
+    # admission bound, no resident bytes — the sliced per-shard state
+    # is 1/series of it and freed when the round's parts download.
+    max_grid_bytes: int = 256 << 20
+
+
+@dataclass
 class ScanConfig:
     """Device scan execution knobs (no reference analogue — the TPU
     build's HBM-budget control, SURVEY.md hard part #5)."""
@@ -303,6 +329,9 @@ class ScanConfig:
     # filter + bucket-aggregate into one device dispatch for eligible
     # aggregate scans; "host" reproduces the pre-change path exactly
     decode: ScanDecodeConfig = field(default_factory=ScanDecodeConfig)
+    # 2-D (time x series) mesh scan knobs ([scan.mesh]); mutually
+    # exclusive with the legacy 1-D mesh_devices knob above
+    mesh: ScanMeshConfig = field(default_factory=ScanMeshConfig)
 
 
 @dataclass
@@ -344,6 +373,7 @@ _NESTED = {
     "combine": ScanCombineConfig,
     "pipeline": ScanPipelineConfig,
     "decode": ScanDecodeConfig,
+    "mesh": ScanMeshConfig,
     "threads": ThreadsConfig,
     "retry": RetryConfig,
     "scrub": ScrubConfig,

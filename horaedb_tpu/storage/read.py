@@ -75,7 +75,7 @@ _ROWS_SCANNED = registry.counter(
 # trace via tracing.trace_add (docs/observability.md).
 _PLAN_STAGES = ("parquet_read", "sidecar_read", "encode_merge",
                 "stack_build", "device_decode", "device_aggregate",
-                "combine")
+                "mesh_aggregate", "combine")
 _STAGE_SECONDS = {
     s: registry.histogram("scan_stage_seconds",
                           "wall seconds per merge-scan plan stage"
@@ -121,6 +121,67 @@ _INCR_REMERGE = registry.counter(
     "scan_incremental_remerge_total",
     "segments re-merged from tier-2-resident parts with only the "
     "missing SSTs fetched (the post-flush path)")
+
+# ---- [scan.mesh] telemetry (docs/parallel.md) ------------------------------
+_MESH_ROUNDS = registry.counter(
+    "scan_mesh_rounds_total",
+    "window rounds dispatched onto the 2-D scan mesh")
+_MESH_PARTS = registry.counter(
+    "scan_mesh_parts_total",
+    "per-segment run parts produced by the on-mesh segmented combine")
+_MESH_PART_CELLS = registry.counter(
+    "scan_mesh_part_cells_total",
+    "aggregate grid cells downloaded from the mesh (run parts + top-k "
+    "winner slices) — the per-chip combine egress the top-k pushdown "
+    "bounds at O(k x buckets x aggs) per run")
+_MESH_SCORE_CELLS = registry.counter(
+    "scan_mesh_score_cells_total",
+    "per-group score/has cells downloaded by the top-k mesh path "
+    "(O(groups), never O(groups x buckets))")
+_MESH_TOPK = registry.counter(
+    "scan_mesh_topk_total",
+    "top-k queries served by the device-scored, winner-sliced mesh "
+    "path")
+# every way a round/plan declines the mesh, so an operator can tell a
+# misconfigured mesh from unsupported data (mirrors
+# scan_decode_fallback_total's discipline)
+MESH_FALLBACK_REASONS = (
+    "merge_impl",    # non-host_perm merge layouts keep the legacy path
+    "sum_overlap",   # a run's windows share a (group, bucket) sum cell
+    "count_bound",   # time_axis x capacity would overflow f32 counts
+    "grid_budget",   # round's transient grid exceeds max_grid_bytes
+    "lo_range",      # a window's bucket offset exceeds the query grid
+    "run_misaligned",  # a run's windows disagree on their first bucket
+    "mesh_error",    # a round dispatch raised (lost shard / XLA error)
+    "topk_by",       # ranking agg not selection-exact (count/sum/avg)
+    "topk_router",   # near-data agents cover segments: no global score
+    "topk_decode",   # device-decode parts can't join device scoring
+    "topk_budget",   # two-phase window pinning exceeds the cache budget
+)
+_MESH_FALLBACKS = registry.counter(
+    "scan_mesh_fallback_total",
+    "mesh scans that left their preferred route, by reason: topk_* "
+    "reasons downgrade the egress-bounded winner-sliced top-k to "
+    "FULL-WIDTH MESH parts (still on the mesh); every other reason "
+    "re-runs that round on the single-chip kernel — the declared "
+    "failure seams (docs/parallel.md)")
+_MESH_FALLBACK_CHILDREN = {r: _MESH_FALLBACKS.labels(reason=r)
+                           for r in MESH_FALLBACK_REASONS}
+_MESH_AXIS_DEVICES = {
+    a: registry.gauge(
+        "scan_mesh_axis_devices",
+        "devices per scan-mesh axis (0 = mesh off)").labels(axis=a)
+    for a in ("time", "series")
+}
+
+
+def note_mesh_fallback(reason: str) -> None:
+    child = _MESH_FALLBACK_CHILDREN.get(reason)
+    if child is None:  # unknown reasons still count, labeled verbatim
+        child = _MESH_FALLBACKS.labels(reason=reason)
+        _MESH_FALLBACK_CHILDREN[reason] = child
+    child.inc()
+    trace_add(f"mesh_fallback_{reason}", 1)
 
 
 def _stack_counters(key: tuple):
@@ -190,6 +251,16 @@ _REPLAY_SLOTS = 8
 
 # [scan.decode] modes (validated at reader open; docs/example.toml)
 DECODE_MODES = ("auto", "device", "host")
+
+
+class _MeshFallback(Exception):
+    """A mesh round declined dispatch for a counted reason — the
+    caller re-runs it on the single-chip kernel (the declared mesh
+    failure seam, docs/parallel.md)."""
+
+    def __init__(self, reason: str):
+        super().__init__(reason)
+        self.reason = reason
 
 
 # guards every window's memo put: memo stores run on worker-pool
@@ -324,6 +395,12 @@ class ScanPlan:
     # one jitted program, emitting finished per-segment parts instead
     # of host windows.  None = host decode (row scans, the control)
     decode_spec: Optional["AggregateSpec"] = None
+    # set when aggregate_segments routes this plan onto the 2-D scan
+    # mesh ([scan.mesh]): window rounds aggregate with the device
+    # kernel even where the numpy twin would normally win (CPU
+    # backend), so mesh rounds and their per-round fallbacks share one
+    # rounding schedule and grids stay byte-identical within a query
+    force_xla_agg: bool = False
 
 
 class ParquetReader:
@@ -425,6 +502,26 @@ class ParquetReader:
             from horaedb_tpu.parallel import segment_mesh
 
             self.mesh = segment_mesh(config.scan.mesh_devices)
+        # the 2-D (time, series) scan mesh ([scan.mesh]): segments
+        # shard along `time` (plan-order slot admission), group blocks
+        # along `series`, segmented-reduction combine on the mesh —
+        # off reproduces the single-chip path exactly (the chaos
+        # suite's bit-identity control)
+        self.scan_mesh = None
+        self._mesh_run_fns: dict = {}
+        if config.scan.mesh.enabled:
+            ensure(config.scan.mesh_devices == 0,
+                   "[scan] mesh_devices and [scan.mesh] are mutually "
+                   "exclusive — the 2-D mesh supersedes the legacy "
+                   "1-D segment mesh")
+            from horaedb_tpu.parallel import scan_mesh as build_scan_mesh
+
+            self.scan_mesh = build_scan_mesh(config.scan.mesh.time,
+                                             config.scan.mesh.series)
+            _MESH_AXIS_DEVICES["time"].set(
+                int(self.scan_mesh.shape["time"]))
+            _MESH_AXIS_DEVICES["series"].set(
+                int(self.scan_mesh.shape["series"]))
         # memory plane: every reader-owned byte budget registers a
         # ledger account (common/memledger.py) tagged with its
         # configured budget; close() deregisters so /debug/memory never
@@ -477,6 +574,12 @@ class ParquetReader:
         self.encoded_cache.clear()
         self.parts_memo.lru.clear()
         self._scalar_cache.clear()
+        if self.scan_mesh is not None:
+            # clear-on-close gauge discipline: a closed table must not
+            # report a phantom mesh (last-writer semantics: the gauges
+            # are process-global, like every axis-shaped gauge here)
+            _MESH_AXIS_DEVICES["time"].set(0)
+            _MESH_AXIS_DEVICES["series"].set(0)
         for acct in self._mem_accounts:
             memledger.deregister(acct)
         self._mem_accounts = []
@@ -1481,6 +1584,7 @@ class ParquetReader:
                 "max_upload_bytes":
                     self.config.scan.decode.max_upload_bytes,
             },
+            "mesh": self.mesh_stats(),
             "stack_cache": {
                 "entries": len(self._stack_cache),
                 "bytes": self._stack_cache_bytes,
@@ -1488,6 +1592,30 @@ class ParquetReader:
                 "hits": self._stack_cache_hits,
                 "misses": self._stack_cache_misses,
             },
+        }
+
+    def mesh_stats(self) -> dict:
+        """The /stats mesh section: axis shape, round/part volume, the
+        egress counter the top-k bound is asserted against, and every
+        counted fallback reason (docs/parallel.md)."""
+        from horaedb_tpu.storage import pipeline as pipeline_mod
+
+        shape = None
+        if self.scan_mesh is not None:
+            shape = {"time": int(self.scan_mesh.shape["time"]),
+                     "series": int(self.scan_mesh.shape["series"])}
+        return {
+            "enabled": self.scan_mesh is not None,
+            "shape": shape,
+            "rounds": int(_MESH_ROUNDS.value),
+            "parts": int(_MESH_PARTS.value),
+            "part_cells": int(_MESH_PART_CELLS.value),
+            "score_cells": int(_MESH_SCORE_CELLS.value),
+            "topk_served": int(_MESH_TOPK.value),
+            "fallbacks": {r: int(c.value)
+                          for r, c in _MESH_FALLBACK_CHILDREN.items()
+                          if c.value},
+            "stalls": pipeline_mod.mesh_stall_counts(),
         }
 
     async def _read_segment_table(self, seg: SegmentPlan,
@@ -2007,6 +2135,10 @@ class ParquetReader:
         host RAM for the query's duration — the budget is the bound."""
         if self.mesh is not None or merge_ops.merge_impl() != "host_perm":
             return False
+        if self.scan_mesh is not None:
+            # [scan.mesh] supersedes the fused single-chip accumulator:
+            # the mesh's parts path is the one that scales across chips
+            return False
         import os
 
         forced = os.environ.get("HORAEDB_FUSED_AGG", "")
@@ -2062,6 +2194,12 @@ class ParquetReader:
                 return False
             if self._fused_agg_ok_base(plan):
                 return False  # fused keeps the warm/replay path
+            if self.scan_mesh is not None:
+                # auto defers to the mesh rounds (which aggregate on
+                # device anyway); mode="device" still forces the fused
+                # dispatch — its DeviceParts pass through the mesh pump
+                note("mesh")
+                return False
         note = device_decode.note_fallback if count else (lambda _r: None)
         if self.mesh is not None:
             note("mesh")
@@ -2336,7 +2474,8 @@ class ParquetReader:
                                         lt + spec.range_start, np.nan)
         return grids
 
-    async def aggregate_segments(self, plan: ScanPlan, spec: AggregateSpec):
+    async def aggregate_segments(self, plan: ScanPlan, spec: AggregateSpec,
+                                 top_k=None):
         """Per segment, yield (segment_start, partial parts) — the
         retryable unit for scan_aggregate (segments already yielded are
         skipped on a replan; a segment is yielded only once ALL its
@@ -2348,7 +2487,16 @@ class ParquetReader:
         local pipeline scanning the uncovered rest; agent failures fall
         back per segment through the local pump (the declared fallback
         seam).  Callers fold parts in sorted segment order, so yield
-        order is free whichever route served a segment."""
+        order is free whichever route served a segment.
+
+        [scan.mesh] plans route their local scans through the 2-D mesh
+        pump instead of the single-chip pump (same yield contract; per
+        -round fallback through the single-chip kernel is the mesh's
+        declared failure seam).  `top_k` additionally enables the
+        device-scored winner-sliced mesh path, which bypasses the memo
+        (its parts are winner slices — memoizing them would poison
+        full-grid queries) and yields only after all compute, so a
+        compaction race replans from zero, never double-counts."""
         ensure(plan.mode is UpdateMode.OVERWRITE,
                "aggregate pushdown requires Overwrite mode")
         # device-native decode ([scan.decode]): eligible plans thread
@@ -2360,6 +2508,21 @@ class ParquetReader:
         # control).  The copy keeps the caller's plan reusable.
         if self._device_decode_plan_ok(plan):
             plan = dc_replace(plan, decode_spec=spec)
+
+        use_mesh = self._mesh_plan_ok(plan)
+        if use_mesh:
+            # mesh rounds and their single-chip fallbacks must share
+            # one rounding schedule (see ScanPlan.force_xla_agg)
+            plan = dc_replace(plan, force_xla_agg=True)
+            if top_k is not None and self._mesh_topk_ok(plan, spec,
+                                                        top_k):
+                pump = self._aggregate_topk_mesh(plan, spec, top_k)
+                try:
+                    async for out in pump:
+                        yield out
+                finally:
+                    await pump.aclose()
+                return
 
         # delta summation: segments whose partials are memoized (same
         # SST set + compatible bucket grid) are served up front and
@@ -2403,6 +2566,10 @@ class ParquetReader:
         if (router is not None and router.active
                 and plan.range is not None):
             covered, uncovered = router.split(plan.segments)
+        # local scans route through the mesh pump when [scan.mesh] is
+        # on (same yield contract, per-round single-chip fallback)
+        pump_fn = (self._aggregate_segments_mesh if use_mesh
+                   else self._aggregate_segments_pump)
         # every pump iteration below carries an explicit aclose on
         # abandonment: delegation must not let the pump's in-flight
         # fetch/decode/device tasks outlive a closed consumer into
@@ -2410,7 +2577,7 @@ class ParquetReader:
         # close its source, and a nested drain-generator would just
         # move the leak one level up)
         if not covered:
-            pump = self._aggregate_segments_pump(plan, spec, memo_store)
+            pump = pump_fn(plan, spec, memo_store)
             try:
                 async for out in pump:
                     yield out
@@ -2425,7 +2592,7 @@ class ParquetReader:
             router.gather(plan, spec, covered))
         try:
             if uncovered:
-                pump = self._aggregate_segments_pump(
+                pump = pump_fn(
                     dc_replace(plan, segments=list(uncovered)), spec,
                     memo_store)
                 try:
@@ -2450,7 +2617,7 @@ class ParquetReader:
             # through the exact local pump the unrouted scan uses —
             # direct store reads happen here and nowhere else on the
             # routed path (tools/lint.py enforces the nowhere-else)
-            pump = self._aggregate_segments_pump(
+            pump = pump_fn(
                 dc_replace(plan, segments=list(failed)), spec,
                 memo_store)
             try:
@@ -2600,6 +2767,587 @@ class ParquetReader:
                 # it never races table teardown
                 flush_task.cancel()
                 await asyncio.gather(flush_task, return_exceptions=True)
+
+    # ---- the 2-D scan mesh ([scan.mesh]; docs/parallel.md) -----------------
+
+    def _mesh_plan_ok(self, plan: ScanPlan) -> bool:
+        """Plan-level [scan.mesh] routing gate; per-round gates (sum
+        overlap, count bound, grid budget) live in _run_mesh_round and
+        fall back per round.  Counted reasons mirror the device-decode
+        discipline (scan_mesh_fallback_total{reason=})."""
+        if self.scan_mesh is None:
+            return False
+        if plan.mode is not UpdateMode.OVERWRITE:
+            return False
+        if merge_ops.merge_impl() != "host_perm":
+            # device_sort windows live sharded on the legacy segment
+            # mesh; the 2-D scan consumes host-merged windows
+            note_mesh_fallback("merge_impl")
+            return False
+        return True
+
+    def _mesh_topk_ok(self, plan: ScanPlan, spec: AggregateSpec,
+                      tk) -> bool:
+        """Whether a top-k query can take the device-scored, winner
+        -sliced mesh path (egress bounded at O(k x buckets x aggs) per
+        run).  Rankings must be selection-exact on device (min/max/
+        last); additive rankings (count/sum/avg) and mixed-provenance
+        scans (near-data partials, device-decode parts) keep the full
+        -parts path, which is still mesh-combined — just not egress
+        -bounded."""
+        if tk.by not in ("min", "max", "last") or tk.by not in set(
+                spec.which):
+            note_mesh_fallback("topk_by")
+            return False
+        if plan.decode_spec is not None:
+            note_mesh_fallback("topk_decode")
+            return False
+        router = self.scan_router
+        if (router is not None and router.active
+                and plan.range is not None
+                and router.split(plan.segments)[0]):
+            # agent-served segments never reach the device score state,
+            # so a global ranking over it would miss their groups
+            note_mesh_fallback("topk_router")
+            return False
+        est_rows = sum(f.meta.num_rows
+                       for seg in plan.segments for f in seg.ssts)
+        if est_rows * _CACHE_BYTES_PER_ROW > self.cache_budget_bytes:
+            # two-phase: every window pins in host RAM until winners
+            # are known (the fused path's budget discipline)
+            note_mesh_fallback("topk_budget")
+            return False
+        return True
+
+    def _mesh_runs(self, items: list) -> list[list]:
+        """Consecutive same-segment slot runs of one round, as
+        [seg_start, first_slot, last_slot] triples — the segmented
+        reduction's run layout (plan-order slot admission keeps a
+        segment's windows adjacent)."""
+        runs: list[list] = []
+        for i, (s, _w, _prep) in enumerate(items):
+            if runs and runs[-1][0] == s:
+                runs[-1][2] = i
+            else:
+                runs.append([s, i, i])
+        return runs
+
+    def _mesh_round_gates(self, items: list, runs: list,
+                          spec: AggregateSpec, g_pad: int,
+                          width: int, cap: int,
+                          local_ok: bool) -> None:
+        """Per-round exactness/budget gates; raises _MeshFallback with
+        the counted reason.  Only multi-slot runs combine on the mesh,
+        so the exactness gates apply to those alone."""
+        T = int(self.scan_mesh.shape["time"])
+        want = combine_mod.expand_which(spec.which)
+        multi = any(b > a for _s, a, b in runs)
+        if multi and local_ok:
+            # the cell-wise run combine is only bucket-aligned when
+            # every slot of a run shares the same first bucket `lo`.
+            # Bulk/sidecar-streamed windows share their segment's
+            # epoch, but the parquet-streamed fallback encodes each
+            # chunk with its OWN epoch — those runs combine per window
+            # on the single-chip kernel instead (a silent mesh combine
+            # would shift rows by whole buckets AND clip rows past the
+            # common window span; caught by the streamed chaos
+            # schedules, regression-tested in test_mesh_scan)
+            for _s, a, b in runs:
+                lo0 = max(0, items[a][2][2] // spec.bucket_ms)
+                for i in range(a + 1, b + 1):
+                    if max(0, items[i][2][2] // spec.bucket_ms) != lo0:
+                        raise _MeshFallback("run_misaligned")
+        if multi and T * cap >= (1 << 24):
+            # f32 integer adds stay exact below 2^24; a run's combined
+            # per-cell count is bounded by slots x capacity
+            raise _MeshFallback("count_bound")
+        if multi and "sum" in want:
+            # any shared group between two windows of one run would
+            # f32-add sum cells the host folds in f64.  When the group
+            # column is the LEADING primary key, window group ranges
+            # are ordered, so only adjacent boundary values can repeat
+            # (transitively: a group shared by non-adjacent windows
+            # pinches every window between to that one group, which
+            # the adjacent checks catch).  Any other group column can
+            # recur in non-adjacent windows — check EVERY pair (runs
+            # are at most time-axis slots wide, so this stays tiny).
+            lead_pk = (self.schema.primary_key_names[0] == spec.group_col
+                       if self.schema.primary_key_names else False)
+            for _s, a, b in runs:
+                if lead_pk:
+                    for i in range(a, b):
+                        va, vb = items[i][2][0], items[i + 1][2][0]
+                        if len(va) > 0 and len(vb) > 0 and va[-1] == vb[0]:
+                            raise _MeshFallback("sum_overlap")
+                else:
+                    for i in range(a, b):
+                        for j in range(i + 1, b + 1):
+                            if np.intersect1d(items[i][2][0],
+                                              items[j][2][0]).size:
+                                raise _MeshFallback("sum_overlap")
+        naggs = len(want) + (1 if "last" in want else 0)
+        if g_pad * width * 4 * naggs > self.config.scan.mesh.max_grid_bytes:
+            raise _MeshFallback("grid_budget")
+
+    def _run_mesh_round(self, items: list, spec: AggregateSpec,
+                        plan: ScanPlan, group_space=None,
+                        download: bool = True, round_salt: int = 0):
+        """Dispatch one round of host windows onto the 2-D scan mesh:
+        per-slot window partials (series-sharded group blocks) plus the
+        on-mesh segmented time-axis combine, one compiled program
+        (parallel.scan.mesh_run_partials).
+
+        download=True (the streaming pump): downloads each run TAIL's
+        combined grids and returns [(seg_start, part, repay)] entries
+        shaped exactly like _flush_host_round's emission — parts enter
+        the same combine/memo machinery.  download=False (the top-k
+        score/winner passes): returns the device outputs + run layout,
+        nothing leaves the mesh here."""
+        from horaedb_tpu.parallel.scan import (
+            mesh_run_partials,
+            shard_time_axis,
+        )
+
+        mesh = self.scan_mesh
+        T = int(mesh.shape["time"])
+        series = int(mesh.shape["series"])
+        ensure(len(items) <= T, "mesh round exceeds the time axis")
+        runs = self._mesh_runs(items)
+        cap = max(it[1].capacity for it in items)
+        if group_space is None:
+            group_space = np.unique(
+                np.concatenate([it[2][0] for it in items]))
+        g = len(group_space)
+        g_pad = max(8, series, 1 << (g - 1).bit_length())
+        local_ok = all(
+            it[1].encodings[spec.ts_col].kind == "offset" for it in items)
+        width = self._window_grid_width(spec) if local_ok \
+            else spec.num_buckets
+        self._mesh_round_gates(items, runs, spec, g_pad, width, cap,
+                               local_ok)
+        space_fp = (g, hash(group_space.tobytes()))
+        # round_salt disambiguates consecutive rounds of one segment
+        # that share (seg0, T, cap, ...) — without it round 2's small
+        # stacks overwrite round 1's and every replay/warm repeat
+        # misses (the fused path's chunk-offset lesson, read above)
+        stack_key = self._round_stack_key(items[0][0], spec, plan, T,
+                                          cap, g_pad, width, space_fp
+                                          ) + (round_salt,)
+        put = functools.partial(shard_time_axis, mesh)
+        ts_s, gid_s, val_s, remap_d, shift_d, lo_dev, lo = \
+            self._build_round_stacks(items, spec, plan, T, cap, g_pad,
+                                     width, group_space, local_ok,
+                                     stack_key=stack_key, put=put,
+                                     key_salt=("mesh2",))
+        if any(int(lo[b]) >= spec.num_buckets for _s, _a, b in runs):
+            raise _MeshFallback("lo_range")
+        fn_key = (g_pad, width, spec.which)
+        fn = self._mesh_run_fns.get(fn_key)
+        if fn is None:
+            fn = mesh_run_partials(mesh, num_groups=g_pad,
+                                   num_buckets=width, which=spec.which)
+            self._mesh_run_fns[fn_key] = fn
+        # plan-order slot admission per mesh column: slot i is item i;
+        # padding slots get unique negative ids so they never combine
+        seg_ids = -(np.arange(T, dtype=np.int32) + 1)
+        for ridx, (_s, a, b) in enumerate(runs):
+            seg_ids[a:b + 1] = ridx
+        t0 = time.perf_counter()
+        out = fn(ts_s, gid_s, val_s, remap_d, shift_d, lo_dev,
+                 shard_time_axis(mesh, seg_ids),
+                 self._dev_scalar(spec.num_buckets),
+                 self._dev_scalar(spec.bucket_ms, "arr1"))
+        _MESH_ROUNDS.inc()
+        if len(items) < T:
+            from horaedb_tpu.storage import pipeline as pipeline_mod
+
+            pipeline_mod.note_mesh_stall("time")
+        if g <= (series - 1) * (g_pad // series):
+            from horaedb_tpu.storage import pipeline as pipeline_mod
+
+            pipeline_mod.note_mesh_stall("series")
+        if not download:
+            _STAGE_SECONDS["mesh_aggregate"].observe(
+                time.perf_counter() - t0)
+            return {"out": out, "runs": runs, "lo": lo,
+                    "lo_dev": lo_dev, "g": g, "width": width}
+        entries: list = []
+        cells = 0
+        for s, a, b in runs:
+            lo_run, grids = self._slice_mesh_part(out, b, g, int(lo[b]),
+                                                  width, spec)
+            cells += sum(int(v.shape[0] * v.shape[1])
+                         for v in grids.values())
+            entries.append((s, (group_space, lo_run, grids), b - a + 1))
+        _STAGE_SECONDS["mesh_aggregate"].observe(time.perf_counter() - t0)
+        _MESH_PARTS.inc(len(entries))
+        _MESH_PART_CELLS.inc(cells)
+        return entries
+
+    @staticmethod
+    def _slice_mesh_part(out: dict, tail_slot: int, g: int, lo_run: int,
+                         width: int, spec: AggregateSpec):
+        """THE mesh part emission, shared by the streaming download and
+        the top-k winner pass so the two cannot drift: slice tail slot
+        `tail_slot`'s combined grids to the real group count (g < 0 =
+        keep all rows, the winner-sliced shape) and the query-clipped
+        width, then rebase window-local last_ts to range_start-relative
+        int64 — byte-for-byte the emission _flush_host_round's per
+        -window parts use.  The slices COPY so the (T, g_pad, width)
+        download is not pinned by the part (the PartsMemo views
+        discipline)."""
+        w_eff = min(width, spec.num_buckets - lo_run)
+        rows = slice(None) if g < 0 else slice(0, g)
+        grids = {k: np.ascontiguousarray(
+            np.asarray(v[tail_slot])[rows, :w_eff])
+            for k, v in out.items()}
+        if "last_ts" in grids:
+            lt = grids["last_ts"].astype(np.int64)
+            grids["last_ts"] = np.where(
+                grids["count"] > 0, lt + lo_run * spec.bucket_ms, lt)
+        return lo_run, grids
+
+    def _flush_mesh_round(self, items: list, spec: AggregateSpec,
+                          plan: ScanPlan, round_salt: int = 0) -> list:
+        """Pool-side mesh round flush: DevicePart entries (finished
+        fused-decode partials) pass through in position; host windows
+        dispatch onto the mesh, falling back PER ROUND to the single
+        -chip kernel (_flush_host_round — the declared failure seam)
+        on ineligibility or a failed dispatch (lost shard, XLA error).
+        Returns [(seg_start, part_or_None, repaid_windows)]."""
+        out: list = []
+        host_items: list = []
+        for s, w, prep in items:
+            if prep is None:
+                out.append((s, w.part, 1))
+            else:
+                host_items.append((s, w, prep))
+        if not host_items:
+            return out
+        try:
+            out.extend(self._run_mesh_round(host_items, spec, plan,
+                                            round_salt=round_salt))
+            return out
+        except _MeshFallback as f:
+            note_mesh_fallback(f.reason)
+        except Exception as exc:  # noqa: BLE001 — counted, single-chip
+            # fallback below reproduces the result (chaos-asserted)
+            note_mesh_fallback("mesh_error")
+            logger.warning(
+                "mesh round failed (%s); re-running the round on the "
+                "single-chip kernel", exc)
+        # single-chip rounds are capped at agg_batch_windows; a mesh
+        # chunk can be wider (time axis > agg_batch_windows), so split
+        # it — per-window grids are round-composition-independent, so
+        # the parts are identical either way
+        hb = max(1, self.config.scan.agg_batch_windows)
+        flushed = []
+        for i in range(0, len(host_items), hb):
+            flushed.extend(self._flush_host_round(
+                host_items[i:i + hb], spec, plan))
+        out.extend(
+            (host_items[i][0], p[1] if p is not None else None, 1)
+            for i, p in enumerate(flushed))
+        return out
+
+    async def _aggregate_segments_mesh(self, plan: ScanPlan,
+                                       spec: AggregateSpec, memo_store):
+        """The mesh twin of _aggregate_segments_pump: the pipeline's
+        fetch/decode stages feed this device stage, which admits
+        windows to mesh time slots strictly in plan order and flushes
+        rounds of time-axis width.  Per-segment run parts come back
+        through the same yield/memo contract, so replans, the
+        PartsMemo, and the sorted-segment fold are untouched."""
+        from collections import deque
+
+        from horaedb_tpu.storage import pipeline as pipeline_mod
+
+        batch_w = int(self.scan_mesh.shape["time"])
+        queue: list[tuple[int, encode.DeviceBatch, tuple]] = []
+        parts: dict[int, list] = {}
+        pending: dict[int, int] = {}
+        arrived: "deque[int]" = deque()
+
+        def pipelined() -> bool:
+            return plan.pipeline_active
+        flush_task: Optional[asyncio.Task] = None
+        flush_ordinal = 0
+
+        def _apply(flushed) -> None:
+            for seg_start, part, repay in flushed:
+                if part is not None:
+                    parts[seg_start].append(part)
+                pending[seg_start] -= repay
+
+        async def settle_flush() -> None:
+            nonlocal flush_task
+            if flush_task is None:
+                return
+            t, flush_task = flush_task, None
+            _apply(await t)
+
+        async def flush_round(chunk: list, salt: int) -> list:
+            t0 = time.perf_counter()
+            out = await self._run_pool(
+                plan.pool, self._flush_mesh_round, chunk, spec, plan,
+                salt)
+            pipeline_mod.observe_stage(
+                "device", time.perf_counter() - t0,
+                rows=sum(w.n_valid for _s, w, _p in chunk))
+            return out
+
+        async def flush(k: int) -> None:
+            nonlocal flush_task, flush_ordinal
+            chunk = queue[:k]
+            del queue[:k]
+            salt = flush_ordinal
+            flush_ordinal += 1
+            if not pipelined():
+                _apply(await self._run_pool(
+                    plan.pool, self._flush_mesh_round, chunk, spec,
+                    plan, salt))
+                return
+            # stage-boundary checkpoint: no new mesh round for an
+            # expired query (the in-flight one drains via settle)
+            deadline_checkpoint()
+            await settle_flush()
+            flush_task = asyncio.create_task(flush_round(chunk, salt))
+
+        windows_iter = self._cached_windows(plan)
+        try:
+            try:
+                async for seg, windows, read_s in windows_iter:
+                    t0 = time.perf_counter()
+                    s = seg.segment_start
+                    arrived.append(s)
+                    parts[s] = []
+                    pending[s] = 0
+
+                    def prep_windows(ws=windows):
+                        out = []
+                        for w in ws:
+                            _ROWS_SCANNED.inc(w.n_valid)
+                            if isinstance(w, device_decode.DevicePart):
+                                if w.part is not None:
+                                    out.append((w, None))
+                                continue
+                            prep = self._window_groups(w, spec, plan)
+                            if prep is not None:
+                                out.append((w, prep))
+                        return out
+
+                    for w, prep in await self._run_pool(plan.pool,
+                                                        prep_windows):
+                        queue.append((s, w, prep))
+                        pending[s] += 1
+                    while len(queue) >= batch_w:
+                        await flush(batch_w)
+                    _SCAN_LATENCY.observe(read_s
+                                          + (time.perf_counter() - t0))
+                    while arrived and pending[arrived[0]] == 0:
+                        s0 = arrived.popleft()
+                        seg_parts = parts.pop(s0)
+                        memo_store(s0, seg_parts)
+                        yield s0, seg_parts
+            finally:
+                await windows_iter.aclose()
+            if queue:
+                await flush(len(queue))
+            await settle_flush()
+            while arrived:
+                s0 = arrived.popleft()
+                seg_parts = parts.pop(s0)
+                memo_store(s0, seg_parts)
+                yield s0, seg_parts
+        finally:
+            if flush_task is not None:
+                # cancelled/failed scan: drain the in-flight mesh
+                # round so it never races table teardown (zero leaked
+                # tasks — the deadline-mid-mesh chaos schedule asserts
+                # it)
+                flush_task.cancel()
+                await asyncio.gather(flush_task, return_exceptions=True)
+
+    async def _aggregate_topk_mesh(self, plan: ScanPlan,
+                                   spec: AggregateSpec, tk):
+        """Egress-bounded top-k on the scan mesh, two passes over the
+        collected windows (two-phase like the fused path — the budget
+        gate in _mesh_topk_ok bounds the pinned rows):
+
+          score   every round's segmented-combined grids fold into a
+                  device-resident (groups, buckets) score state —
+                  selection ops, exact — and only a per-group
+                  (score, has) vector downloads: O(groups) bytes;
+          winners rank on host with combine.rank_top_k (the same
+                  stable tie-break combine_top_k uses), then re-run
+                  the rounds (stacks are LRU-cached) and download ONLY
+                  the k winners' grid rows per run: O(k x buckets x
+                  aggs) per part, independent of cardinality
+                  (scan_mesh_part_cells_total asserts it).
+
+        Yields (seg_start, winner-sliced parts); finalize_aggregate's
+        combine_top_k then reproduces the full ranking byte-for-byte
+        restricted to the winner set.  Any round-level ineligibility
+        (sum overlap, budget, mesh error) downgrades the WHOLE query
+        to full-width mesh parts — correct, just not egress-bounded."""
+        from horaedb_tpu.parallel import scan as pscan
+
+        T = int(self.scan_mesh.shape["time"])
+        items: list = []
+        windows_iter = self._cached_windows(plan)
+        try:
+            async for seg, windows, read_s in windows_iter:
+                s = seg.segment_start
+
+                def prep_windows(ws=windows, s=s):
+                    out = []
+                    for w in ws:
+                        _ROWS_SCANNED.inc(w.n_valid)
+                        prep = self._window_groups(w, spec, plan)
+                        if prep is not None:
+                            out.append((s, w, prep))
+                    return out
+
+                items.extend(await self._run_pool(plan.pool,
+                                                  prep_windows))
+                _SCAN_LATENCY.observe(read_s)
+        finally:
+            await windows_iter.aclose()
+        if not items:
+            return
+        # canonical fold order: sorted segment, window order within —
+        # the order finalize folds parts in, so pass-2 part emission
+        # matches the control's arithmetic order exactly
+        items.sort(key=lambda it: it[0])
+        all_values = np.unique(np.concatenate([it[2][0]
+                                               for it in items]))
+        g = len(all_values)
+        series = int(self.scan_mesh.shape["series"])
+        g_pad = max(8, series, 1 << (g - 1).bit_length())
+        local_ok = all(it[1].encodings[spec.ts_col].kind == "offset"
+                       for it in items)
+        width = self._window_grid_width(spec) if local_ok \
+            else spec.num_buckets
+        chunks = [items[i:i + T] for i in range(0, len(items), T)]
+        bucket_dev = self._dev_scalar(spec.bucket_ms)
+        state = pscan.mesh_score_init(g_pad, spec.num_buckets + width,
+                                      tk.by)
+        downgrade = None
+        try:
+            for ci, chunk in enumerate(chunks):
+                deadline_checkpoint()
+
+                def score_round(chunk=chunk, state=state, ci=ci):
+                    got = self._run_mesh_round(chunk, spec, plan,
+                                               group_space=all_values,
+                                               download=False,
+                                               round_salt=ci)
+                    last_ts = (got["out"].get("last_ts")
+                               if tk.by == "last" else None)
+                    return pscan.mesh_score_update(
+                        state, got["out"][tk.by], got["out"]["count"],
+                        last_ts, got["lo_dev"], bucket_dev, by=tk.by)
+
+                state = await self._run_pool(plan.pool, score_round)
+        except _MeshFallback as f:
+            downgrade = f.reason
+        except NotFoundError:
+            raise  # compaction race: the caller replans
+        except Exception as exc:  # noqa: BLE001 — counted downgrade
+            downgrade = "mesh_error"
+            logger.warning("mesh top-k scoring failed (%s); serving "
+                           "full-width parts", exc)
+        if downgrade is not None:
+            note_mesh_fallback(downgrade)
+            # full-width mesh parts through the normal chunk flush —
+            # still byte-identical, just not egress-bounded (finalize's
+            # host combine_top_k ranks them)
+            async for out in self._yield_chunks_as_parts(chunks, spec,
+                                                         plan):
+                yield out
+            return
+
+        def finish_scores():
+            scores_d, has_d = pscan.mesh_score_finalize(
+                state, largest=tk.largest, num_buckets=spec.num_buckets)
+            return (np.asarray(scores_d)[:g].astype(np.float64),
+                    np.asarray(has_d)[:g])
+
+        scores, has_any = await self._run_pool(plan.pool, finish_scores)
+        _MESH_SCORE_CELLS.inc(2 * g)
+        kept = np.flatnonzero(has_any)
+        winners = combine_mod.rank_top_k(
+            [int(r) for r in kept], scores[kept], tk)
+        if not winners:
+            return
+        w_rows = np.asarray(sorted(winners), dtype=np.int32)
+        winner_values = all_values[w_rows]
+        seg_parts: dict[int, list] = {}
+        cells = 0
+        try:
+            for ci, chunk in enumerate(chunks):
+                deadline_checkpoint()
+
+                def winner_round(chunk=chunk, ci=ci):
+                    got = self._run_mesh_round(chunk, spec, plan,
+                                               group_space=all_values,
+                                               download=False,
+                                               round_salt=ci)
+                    sliced = pscan.mesh_take_rows(got["out"],
+                                                  jnp.asarray(w_rows))
+                    out = []
+                    for s, _a, b in got["runs"]:
+                        # the round's OWN grid width: a chunk whose ts
+                        # encodings forced full-range grids is wider
+                        # than the offset-encoded default
+                        lo_run, grids = self._slice_mesh_part(
+                            sliced, b, -1, int(got["lo"][b]),
+                            got["width"], spec)
+                        out.append((s, (winner_values, lo_run, grids)))
+                    return out
+
+                for s, part in await self._run_pool(plan.pool,
+                                                    winner_round):
+                    seg_parts.setdefault(s, []).append(part)
+                    cells += sum(int(v.shape[0] * v.shape[1])
+                                 for v in part[2].values())
+        except NotFoundError:
+            raise  # compaction race: the caller replans
+        except Exception as exc:  # noqa: BLE001 — counted downgrade;
+            # nothing has been yielded (all-or-nothing), so the full
+            # -width path below replaces the winner slices wholesale
+            note_mesh_fallback("mesh_error"
+                               if not isinstance(exc, _MeshFallback)
+                               else exc.reason)
+            logger.warning("mesh top-k winner pass failed (%s); "
+                           "serving full-width parts", exc)
+            async for out in self._yield_chunks_as_parts(chunks, spec,
+                                                         plan):
+                yield out
+            return
+        _MESH_PART_CELLS.inc(cells)
+        _MESH_TOPK.inc()
+        for s in sorted(seg_parts):
+            yield s, seg_parts[s]
+
+    async def _yield_chunks_as_parts(self, chunks: list,
+                                     spec: AggregateSpec,
+                                     plan: ScanPlan):
+        """Downgrade path for the top-k mesh route: flush the already
+        -collected window chunks through the normal mesh round (its
+        own per-round fallback included) and yield per-segment full
+        parts — finalize's host combine_top_k ranks them instead."""
+        seg_parts: dict[int, list] = {}
+        for ci, chunk in enumerate(chunks):
+            deadline_checkpoint()
+            flushed = await self._run_pool(
+                plan.pool, self._flush_mesh_round, chunk, spec, plan,
+                ci)
+            for s, part, _repay in flushed:
+                if part is not None:
+                    seg_parts.setdefault(s, []).append(part)
+        for s in sorted(seg_parts):
+            yield s, seg_parts[s]
 
     def finalize_aggregate(self, parts: list, spec: AggregateSpec,
                            top_k=None):
@@ -2890,7 +3638,8 @@ class ParquetReader:
                             plan: ScanPlan, batch_w: int, cap: int,
                             g_pad: int, width: int,
                             group_space: np.ndarray, local_ok: bool,
-                            stack_key: Optional[tuple] = None):
+                            stack_key: Optional[tuple] = None,
+                            put=None, key_salt: tuple = ()):
         """Stack one round of windows for the aggregation program,
         tunnel-aware:
 
@@ -2918,19 +3667,27 @@ class ParquetReader:
 
         Returns (ts_s, gid_s, val_s, remap_d, shift_d, lo_d, lo_host).
         """
-        if self.mesh is not None:
-            from horaedb_tpu.parallel.scan import shard_leading_axis
+        # an explicit `put` (the 2-D mesh rounds pass shard_time_axis)
+        # keys its entries with `key_salt` so sharded and single-device
+        # stacks of one composition never alias in the LRU
+        sharded = put is not None
+        if put is None:
+            if self.mesh is not None:
+                from horaedb_tpu.parallel.scan import shard_leading_axis
 
-            put = functools.partial(shard_leading_axis, self.mesh)
-        else:
-            put = jax.device_put
+                put = functools.partial(shard_leading_axis, self.mesh)
+                sharded = True
+            else:
+                put = jax.device_put
         if stack_key is None:
             space_fp = (len(group_space), hash(group_space.tobytes()))
             stack_key = self._round_stack_key(items[0][0], spec, plan,
                                               batch_w, cap, g_pad, width,
                                               space_fp)
+        stack_key = stack_key + key_salt
         windows_now = tuple(it[1] for it in items)
-        col_key = self._col_stack_key(windows_now, spec, plan, batch_w, cap)
+        col_key = self._col_stack_key(windows_now, spec, plan, batch_w,
+                                      cap) + key_salt
         cols = self._stack_cache_get(col_key, windows_now)
         small = self._stack_cache_get(stack_key, windows_now)
         if cols is not None and small is not None:
@@ -2941,7 +3698,7 @@ class ParquetReader:
             isinstance(it[1].columns[spec.ts_col], np.ndarray)
             and isinstance(it[2][1], np.ndarray) for it in items)
         if cols is None:
-            if host_rows and not self._devcol_stack_ok():
+            if host_rows and (sharded or not self._devcol_stack_ok()):
                 ts_m = np.zeros((batch_w, cap), dtype=np.int32)
                 gid_m = np.full((batch_w, cap), -1, dtype=np.int32)
                 val_m = np.zeros((batch_w, cap), dtype=np.float32)
@@ -2984,7 +3741,7 @@ class ParquetReader:
                 ts_s = jnp.stack(ts_rows)
                 gid_s = jnp.stack(gid_rows)
                 val_s = jnp.stack(val_rows)
-                if self.mesh is not None:
+                if sharded:
                     ts_s, gid_s, val_s = put(ts_s), put(gid_s), put(val_s)
             cols = (ts_s, gid_s, val_s)
             built_bytes += sum(int(a.nbytes) for a in cols)
@@ -3048,7 +3805,7 @@ class ParquetReader:
         """One round of HOST-decoded windows aggregated by the batched
         kernel (or its numpy twin) — returns one entry per item, None
         for windows that contribute nothing."""
-        if self._host_agg_ok() and all(
+        if (not plan.force_xla_agg) and self._host_agg_ok() and all(
                 isinstance(it[1].columns[spec.ts_col], np.ndarray)
                 for it in items):
             # XLA-CPU's segmented scatters run ~20x slower than numpy's

@@ -476,6 +476,13 @@ class EncodedSegment:
     n: int
     names: list
     pending_leaves: Optional[list] = None
+    # how many sorted SST runs were concatenated (None = unknown).  A
+    # single-run segment — the post-compaction steady state — is
+    # (pk, seq)-sorted BY CONSTRUCTION (both write paths sort before
+    # the SST put; compaction emits merge-sorted), so the fused decode
+    # routes it sort-free without even the one-pass host check
+    # (ops/device_decode.py, scan_decode_sort_skipped_total)
+    source_runs: Optional[int] = None
 
     @property
     def num_rows(self) -> int:
@@ -511,7 +518,8 @@ def apply_leaves_host(es: EncodedSegment) -> EncodedSegment:
             cols = {nm: a[idx] for nm, a in cols.items()}
     n = len(next(iter(cols.values()))) if cols else 0
     return EncodedSegment(columns=cols, encodings=es.encodings, n=n,
-                          names=es.names, pending_leaves=None)
+                          names=es.names, pending_leaves=None,
+                          source_runs=es.source_runs)
 
 
 def assemble_segment(bufs: list[bytes], columns: list,
@@ -557,7 +565,8 @@ def assemble_parts(parts: list, columns: list,
         return None
     out_cols, out_encs, n_total = cc
     return EncodedSegment(columns=out_cols, encodings=out_encs,
-                          n=n_total, names=list(columns))
+                          n=n_total, names=list(columns),
+                          source_runs=len(parts))
 
 
 # ---------------------------------------------------------------------------

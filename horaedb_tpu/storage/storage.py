@@ -435,7 +435,8 @@ class CloudObjectStorage(TimeMergeStorage):
                                  if s.segment_start not in done]
                 try:
                     async for seg_start, parts in \
-                            self.reader.aggregate_segments(plan, spec):
+                            self.reader.aggregate_segments(
+                                plan, spec, top_k=top_k):
                         done[seg_start] = parts
                     break
                 except NotFoundError:

@@ -237,6 +237,50 @@ def test_streamed_segments_device_decode(runtimes):
     run(go())
 
 
+def test_sort_free_routing_counted(runtimes):
+    """Compaction-aware sort-free routing (ISSUE 15 satellite):
+    single-SST segments route past the device lax.sort AND the host
+    sortedness check ((pk, seq)-sorted by construction), multi-SST
+    segments that check sorted skip the sort too, and interleaved ones
+    pay it — each per segment on scan_decode_sort_*_total."""
+
+    def counts():
+        return (device_decode._SORT_SKIPPED["compacted"].value,
+                device_decode._SORT_SKIPPED["checked"].value,
+                device_decode._SORT_RAN.value)
+
+    async def go():
+        rng = random.Random(SEED + 3)
+        s = await open_storage(MemoryObjectStore(), runtimes,
+                               decode={"mode": "device"})
+        try:
+            with _ForceXlaAgg():
+                # segment 0: one SST -> compacted route, no check
+                await write_segments(s, rng, segments=1, rows_per=120)
+                spec = agg_spec(0, SEGMENT_MS, which=("avg",))
+                req = ScanRequest(range=TimeRange.new(0, SEGMENT_MS))
+                c0 = counts()
+                clear_caches(s)
+                await s.scan_aggregate(req, spec)
+                c1 = counts()
+                assert c1[0] == c0[0] + 1 and c1[2] == c0[2]
+                # overlapping second SST with interleaving PK ranges:
+                # the concat is unsorted -> the device sort runs
+                await s.write(wreq([("k0", 10, 1.0), ("k5", 20, 2.0)]))
+                clear_caches(s)
+                await s.scan_aggregate(req, spec)
+                c2 = counts()
+                assert c2[2] == c1[2] + 1, (c1, c2)
+                # disjoint-PK second write CAN still concat sorted —
+                # whichever way it lands, routed-vs-sorted must sum to
+                # one more segment dispatch
+                assert sum(c2) == sum(c1) + 1
+        finally:
+            await s.close()
+
+    run(go())
+
+
 # ---------------------------------------------------------------------------
 # fallback reasons
 # ---------------------------------------------------------------------------

@@ -972,3 +972,70 @@ class TestEngineMeshAggregation:
                 np.asarray(single["aggs"]["count"]))
 
         asyncio.run(go())
+
+
+class TestMeshRunPartials:
+    """Program-level contract of the 2-D scan mesh's segmented
+    reduction (parallel.scan.mesh_run_partials): each time slot's
+    output equals its segment-run prefix combined with the pairwise
+    op, byte-exactly — the engine-level bit-identity claim rests on
+    this (tests/test_mesh_scan.py covers the end-to-end half)."""
+
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_segmented_combine_byte_exact(self, seed):
+        from horaedb_tpu.ops.downsample import (
+            ALL_AGGS,
+            window_local_partials,
+        )
+        from horaedb_tpu.parallel.mesh import scan_mesh
+        from horaedb_tpu.parallel.scan import (
+            mesh_run_partials,
+            shard_time_axis,
+        )
+
+        mesh2 = scan_mesh(4, 2)
+        T, CAPW, GW, W = 4, 64, 8, 16
+        rng = np.random.default_rng(seed)
+        ts = rng.integers(0, W * 100, (T, CAPW)).astype(np.int32)
+        gid = rng.integers(-1, GW, (T, CAPW)).astype(np.int32)
+        vals = (rng.random((T, CAPW)) * 50).astype(np.float32)
+        remap = np.tile(np.arange(GW, dtype=np.int32), (T, 1))
+        zeros = np.zeros(T, dtype=np.int32)
+        seg_ids = np.array([0, 0, 1, 2], dtype=np.int32)
+        fn = mesh_run_partials(mesh2, num_groups=GW, num_buckets=W,
+                               which=ALL_AGGS)
+        out = fn(shard_time_axis(mesh2, ts), shard_time_axis(mesh2, gid),
+                 shard_time_axis(mesh2, vals),
+                 shard_time_axis(mesh2, remap),
+                 shard_time_axis(mesh2, zeros),
+                 shard_time_axis(mesh2, zeros),
+                 shard_time_axis(mesh2, seg_ids), jnp.int32(W),
+                 jnp.asarray([100], dtype=jnp.int32))
+
+        def one(t):
+            return {k: np.asarray(v) for k, v in window_local_partials(
+                jnp.asarray(ts[t]), jnp.asarray(gid[t]),
+                jnp.asarray(vals[t]), jnp.asarray(remap[t]),
+                jnp.int32(0), jnp.int32(0), jnp.int32(W), jnp.int32(100),
+                num_groups=GW, num_buckets=W, which=ALL_AGGS).items()}
+
+        def comb(cur, prev):
+            got = {"count": cur["count"] + prev["count"],
+                   "sum": cur["sum"] + prev["sum"],
+                   "min": np.minimum(cur["min"], prev["min"]),
+                   "max": np.maximum(cur["max"], prev["max"])}
+            take = cur["last_ts"] >= prev["last_ts"]
+            got["last"] = np.where(take, cur["last"], prev["last"])
+            got["last_ts"] = np.where(take, cur["last_ts"],
+                                      prev["last_ts"])
+            return got
+
+        ps = [one(t) for t in range(T)]
+        # run 0 = slots 0..1, run 1 = slot 2, run 2 = slot 3: tails
+        # hold the whole run, mid-run slots the inclusive prefix
+        want = {0: ps[0], 1: comb(ps[1], ps[0]), 2: ps[2], 3: ps[3]}
+        for t, ref in want.items():
+            for k in ref:
+                got = np.asarray(out[k][t])
+                assert got.tobytes() == ref[k].astype(
+                    got.dtype).tobytes(), (t, k)

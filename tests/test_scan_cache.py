@@ -488,7 +488,7 @@ def test_stats_cache_section(runtimes):
             stats = s.reader.cache_stats()
             assert set(stats) == {"scan_cache", "encoded_cache",
                                   "stack_cache", "pipeline",
-                                  "parts_memo", "decode"}
+                                  "parts_memo", "decode", "mesh"}
             assert stats["decode"]["mode"] == "auto"
             assert stats["pipeline"]["enabled"] is True
             assert stats["encoded_cache"]["entries"] == 1

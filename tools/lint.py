@@ -368,6 +368,25 @@ def _scanagent_raw_store_read(node: ast.Call) -> bool:
 _METRIC_FACTORIES = {"counter", "gauge", "histogram"}
 
 
+_MESH_CONSTRUCTORS = {"Mesh", "shard_map", "NamedSharding"}
+
+
+def _mesh_construction_outside_parallel(node: ast.Call) -> bool:
+    """Mesh/shard_map/NamedSharding construction outside
+    horaedb_tpu/parallel/: mesh topology and sharding specs stay
+    declared in ONE place (parallel/mesh.py builds meshes,
+    parallel/scan.py owns the shard_map programs and placement
+    helpers) — a second construction site is how two halves of the
+    engine end up disagreeing about axis names and layouts."""
+    func = node.func
+    name = None
+    if isinstance(func, ast.Name):
+        name = func.id
+    elif isinstance(func, ast.Attribute):
+        name = func.attr
+    return name in _MESH_CONSTRUCTORS
+
+
 def _metric_call_without_help(node: ast.Call) -> bool:
     """True for `<...registry...>.counter/gauge/histogram(...)` calls
     whose help text is missing or an empty string literal.  Receivers
@@ -586,6 +605,18 @@ def lint_file(path: pathlib.Path) -> list[str]:
                     "device-native path removed; route reads through "
                     "the reader (ops/device_decode.py)")
         elif (isinstance(node, ast.Call) and "horaedb_tpu" in path.parts
+                and "parallel" not in path.parts
+                and _mesh_construction_outside_parallel(node)):
+            src = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+            if "noqa" not in src:
+                problems.append(
+                    f"{path}:{node.lineno}: Mesh/shard_map/"
+                    "NamedSharding constructed outside "
+                    "horaedb_tpu/parallel/ — mesh topology stays "
+                    "declared in one place; build meshes via "
+                    "parallel.mesh and place arrays via "
+                    "parallel.scan's helpers (docs/parallel.md)")
+        elif (isinstance(node, ast.Call) and "horaedb_tpu" in path.parts
                 and _metric_call_without_help(node)):
             src = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
             if "noqa" not in src:
@@ -741,6 +772,10 @@ _BUDGET_FIELD_EXEMPT = {
     # [scan.decode] per-dispatch upload admission gate: the upload
     # lives on DEVICE for one dispatch (memory_device_bytes covers it)
     "max_upload_bytes",
+    # [scan.mesh] per-round transient-grid admission gate: the partial
+    # grid lives on DEVICE for one round dispatch
+    # (memory_device_bytes covers it), nothing host-resident
+    "max_grid_bytes",
     # [scanagent] response-size refusal cap: an agent never buffers
     # past it, and the coordinator's received partials are charged to
     # the scanagent_wire flow account

@@ -45,17 +45,37 @@ def next_record_path() -> str:
     return os.path.join(results, f"multichip_r{n:02d}.json")
 
 
-def run(n_devices: int, timeout_s: float) -> dict:
-    cmd = [sys.executable, "-c",
-           f"import __graft_entry__; "
-           f"__graft_entry__.dryrun_multichip({n_devices}); "
-           f"print('dryrun OK')"]
+def run(n_devices: int, timeout_s: float, mode: str = "dryrun",
+        rows: int = 2_000_000) -> dict:
+    if mode == "mesh":
+        # the mesh-scan A/B (ISSUE 15): BENCH_CONFIG=19 runs the 2-D
+        # mesh scan vs the single-chip control with in-bench
+        # bit-identity + top-k egress assertions.  On this box the
+        # rung is the CPU virtual mesh
+        # (--xla_force_host_platform_device_count); a TPU host runs
+        # the identical command on real chips and the record's
+        # backend/fallback labels say which it was
+        cmd = [sys.executable, "bench.py"]
+        env = dict(os.environ)
+        env["BENCH_CONFIG"] = "19"
+        env.setdefault("BENCH_ROWS", str(rows))
+        env["MESH_BENCH_DEVICES"] = str(n_devices)
+        flags = env.get("XLA_FLAGS", "")
+        want = f"--xla_force_host_platform_device_count={n_devices}"
+        if "host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = f"{flags} {want}".strip()
+    else:
+        cmd = [sys.executable, "-c",
+               f"import __graft_entry__; "
+               f"__graft_entry__.dryrun_multichip({n_devices}); "
+               f"print('dryrun OK')"]
+        env = None
     t0 = time.perf_counter()
-    record = {"n_devices": n_devices, "timeout_s": timeout_s,
-              "cmd": " ".join(cmd)}
+    record = {"mode": mode, "n_devices": n_devices,
+              "timeout_s": timeout_s, "cmd": " ".join(cmd)}
     try:
         proc = subprocess.run(cmd, cwd=ROOT, capture_output=True,
-                              text=True, timeout=timeout_s)
+                              text=True, timeout=timeout_s, env=env)
         record["rc"] = proc.returncode
         record["ok"] = proc.returncode == 0
         # rc=124 is how an outer `timeout(1)` reports — classify it as
@@ -64,6 +84,14 @@ def run(n_devices: int, timeout_s: float) -> dict:
                             "timeout" if proc.returncode == 124 else
                             "error")
         record["tail"] = (proc.stderr or proc.stdout or "")[-2000:]
+        if mode == "mesh" and proc.returncode == 0:
+            # bench.py prints ONE result JSON on its last stdout line
+            for line in reversed(proc.stdout.strip().splitlines()):
+                try:
+                    record["result"] = json.loads(line)
+                    break
+                except json.JSONDecodeError:
+                    continue
     except subprocess.TimeoutExpired as exc:
         # THE recording-gap fix: a killed run still writes a record
         record["rc"] = 124
@@ -81,11 +109,18 @@ def main() -> int:
     parser = argparse.ArgumentParser("multichip_run")
     parser.add_argument("--devices", type=int, default=8)
     parser.add_argument("--timeout", type=float, default=600.0)
+    parser.add_argument("--mode", choices=("dryrun", "mesh"),
+                        default="dryrun",
+                        help="dryrun = the shard_map program dryrun; "
+                             "mesh = the BENCH_CONFIG=19 mesh-scan "
+                             "A/B with in-bench bit-identity checks")
+    parser.add_argument("--rows", type=int, default=2_000_000)
     parser.add_argument("--out", default=None,
                         help="record path (default: next "
                              "bench_results/multichip_rNN.json)")
     args = parser.parse_args()
-    record = run(args.devices, args.timeout)
+    record = run(args.devices, args.timeout, mode=args.mode,
+                 rows=args.rows)
     path = args.out or next_record_path()
     with open(path, "w", encoding="utf-8") as f:
         json.dump(record, f, indent=1)
