@@ -39,6 +39,9 @@ SCANAGENT_SCHEDULES ?= 15
 MESH_SEED ?= 1337
 MESH_SCHEDULES ?= 12
 
+REPL_SEED ?= 1337
+REPL_SCHEDULES ?= 10
+
 chaos:
 	TORTURE_SEED=$(TORTURE_SEED) TORTURE_SCHEDULES=$(TORTURE_SCHEDULES) \
 	WAL_TORTURE_SEED=$(WAL_TORTURE_SEED) \
@@ -59,12 +62,15 @@ chaos:
 	SCANAGENT_SCHEDULES=$(SCANAGENT_SCHEDULES) \
 	MESH_SEED=$(MESH_SEED) \
 	MESH_SCHEDULES=$(MESH_SCHEDULES) \
+	REPL_SEED=$(REPL_SEED) \
+	REPL_SCHEDULES=$(REPL_SCHEDULES) \
 	python -m pytest tests/test_fault_injection.py tests/test_torture.py \
 	tests/test_objstore_middleware.py tests/test_wal.py \
 	tests/test_scan_cache.py tests/test_rollup.py \
 	tests/test_pipeline.py tests/test_combine.py \
 	tests/test_tenant.py tests/test_device_decode.py \
-	tests/test_scanagent.py tests/test_mesh_scan.py -q
+	tests/test_scanagent.py tests/test_mesh_scan.py \
+	tests/test_replication.py -q
 
 # stdlib AST lint gate (the reference CI runs fmt+clippy -D warnings;
 # this image ships no ruff/flake8, so the gate is tools/lint.py)

@@ -3750,12 +3750,343 @@ def run_config19(rows: int, iters: int) -> dict:
                 os.environ[key] = old
 
 
+def run_config20(rows: int, iters: int) -> dict:
+    """Failover SLO harness (ISSUE 16): the config-15-shaped OPEN-LOOP
+    driver — arrivals fire on a precomputed Poisson schedule regardless
+    of completions — over a real HTTP server with `[replication]` on,
+    a follower mirroring the WAL over the /repl/wal/* plane, and the
+    primary killed -9 at mid-leg:
+
+      dash     compliant: steady cached downsample dashboards
+      writer   compliant: steady small write batches (WAL + fence path)
+
+    At leg/2 the harness takes the primary's compute plane down (HTTP
+    listener gone, ingest loops aborted WITHOUT a final flush), drains
+    the already-committed WAL tail into the mirror — modeling the
+    Taurus split where the durable log plane survives compute death —
+    then promotes the follower: lease acquired at a higher epoch once
+    the dead primary's TTL lapses, mirror replayed, a fresh server
+    serving the same shared-store SSTs.  Arrivals during the outage
+    record their failure codes (that IS the failover damage); the
+    remaining schedule routes to the promoted node.
+
+    Recorded: failover_ms (kill -> promoted node serving, including
+    the lease-TTL wait), acked_write_loss (every 200-acked write must
+    be readable after promotion — MUST be 0), and compliant p99 per
+    phase.  iters scales the leg duration."""
+    import os
+    import random as random_mod
+    import tempfile
+
+    import aiohttp
+    import pyarrow as pa
+    from aiohttp import web
+
+    from horaedb_tpu.cluster.replication import (HttpWalSource,
+                                                 LeaseManager,
+                                                 LocalWalSource,
+                                                 ReplicationConfig,
+                                                 ReplicationError,
+                                                 WalFollower, promote,
+                                                 install_fence)
+    from horaedb_tpu.metric_engine import MetricEngine
+    from horaedb_tpu.objstore import FaultInjectingStore, MemoryObjectStore
+    from horaedb_tpu.server.config import ReadableDuration, ServerConfig
+    from horaedb_tpu.server.main import ServerState, build_app
+    from horaedb_tpu.storage.types import TimeRange
+    from horaedb_tpu.wal.config import WalConfig
+
+    lat_s = float(os.environ.get("BENCH_STORE_LATENCY_MS", "20")) / 1e3
+    seed = int(os.environ.get("REPL_BENCH_SEED", "20"))
+    segment_ms = 2 * 3600 * 1000
+    T0 = (1_700_000_000_000 // segment_ms) * segment_ms
+    leg_seconds = max(4.0, min(30.0, float(iters)))
+    kill_at = leg_seconds / 2.0
+    lease_ttl_ms = 2_000
+    n_fix = min(max(20_000, rows), 200_000)
+    hosts = 50
+    span = 3_600_000
+    # the writer lands in the OPEN segment ahead of the dashboards'
+    # completed window (the config-15 discipline: a dashboard
+    # aggregate never pre-flushes the writer's fresh memtable rows)
+    TW0 = T0 + 3 * segment_ms
+    dash_q = {"metric": "app", "filters": {}, "start": T0,
+              "end": T0 + span, "bucket_ms": 300_000}
+
+    def write_req(i: int) -> dict:
+        # unique (host, timestamp) per request, value = i: the
+        # verification pass recomputes these from the acked index set
+        return {"samples": [
+            {"name": "ingest", "labels": {"host": f"w{i % 8:02d}"},
+             "timestamp": TW0 + i * 1000, "value": float(i)}]}
+
+    def schedule(rng):
+        events = []
+
+        def poisson(rate, make):
+            t = 0.0
+            for i in range(int(leg_seconds * rate)):
+                t += rng.expovariate(rate)
+                events.append((t,) + make(i))
+
+        poisson(5.0, lambda i: ("/query", dash_q, -1))
+        poisson(10.0, lambda i: ("/write", write_req(i), i))
+        events.sort(key=lambda e: e[0])
+        return events
+
+    async def start_server(state):
+        app = build_app(state)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = runner.addresses[0][1]
+        return runner, f"http://127.0.0.1:{port}"
+
+    async def go():
+        store = FaultInjectingStore(MemoryObjectStore(), seed=seed,
+                                    latency_range=(lat_s, lat_s))
+        wal_dir = tempfile.mkdtemp(prefix="repl-bench-wal-")
+        mirror_dir = tempfile.mkdtemp(prefix="repl-bench-mirror-")
+        rng_np = np.random.default_rng(seed)
+        # fixture: a dashboard table plus a bulk table so promotion
+        # replays a real manifest — ingested WAL-free, then reopened
+        # with the WAL front end (the serving legs exercise the WAL)
+        engine = await MetricEngine.open("metrics/region_0", store,
+                                         segment_ms=segment_ms)
+        per_host = n_fix // hosts
+        ts = T0 + np.repeat(
+            np.arange(per_host, dtype=np.int64)
+            * max(1, span // max(per_host, 1)), hosts)
+        ids = np.tile(np.arange(hosts, dtype=np.int32), per_host)
+        names = pa.array([f"host_{i:03d}" for i in range(hosts)])
+        await engine.write_arrow("cpu", ["host"], pa.record_batch({
+            "host": pa.DictionaryArray.from_arrays(pa.array(ids), names),
+            "timestamp": pa.array(ts, type=pa.int64()),
+            "value": pa.array(rng_np.random(len(ts)), type=pa.float64()),
+        }))
+        m = 20 * 360
+        await engine.write_arrow("app", ["host"], pa.record_batch({
+            "host": pa.array([f"app_{i % 20:02d}" for i in range(m)]),
+            "timestamp": pa.array(
+                T0 + np.arange(m, dtype=np.int64) * 10_000 % span,
+                type=pa.int64()),
+            "value": pa.array(rng_np.random(m), type=pa.float64()),
+        }))
+        await engine.close()
+
+        wal_template = WalConfig(enabled=True, dir=wal_dir)
+        engine = await MetricEngine.open(
+            "metrics/region_0", store, segment_ms=segment_ms,
+            wal_config=wal_template)
+        cfg = ServerConfig()
+        cfg.replication.enabled = True
+        cfg.replication.region = 0
+        cfg.replication.holder = "bench-primary"
+        cfg.replication.lease_ttl = ReadableDuration.from_millis(
+            lease_ttl_ms)
+        cfg.replication.renew_interval = ReadableDuration.from_millis(500)
+        state = ServerState(engine, cfg)
+        await state.start_replication(store)
+        runner, base = await start_server(state)
+        follower = WalFollower(
+            HttpWalSource(base, "bench-follower", timeout_s=5.0),
+            mirror_dir,
+            ReplicationConfig(
+                poll_interval=ReadableDuration.from_millis(50)),
+            region=0)
+        follower.start()
+
+        target = {"base": base}
+        lat: dict = {}
+        session = aiohttp.ClientSession(
+            connector=aiohttp.TCPConnector(limit=0),
+            timeout=aiohttp.ClientTimeout(total=10))
+        acked: set = set()
+        t_start = time.perf_counter()
+
+        async def fire(at, path, payload, widx):
+            t0 = time.perf_counter()
+            try:
+                r = await session.post(  # noqa: session-wide timeout
+                    target["base"] + path, json=payload)
+                status = r.status
+                await r.release()
+            except asyncio.TimeoutError:
+                status = -1
+            except aiohttp.ClientError:
+                status = -2
+            dt = time.perf_counter() - t0
+            if status == 200 and widx >= 0:
+                acked.add(widx)
+            kind = "query" if path == "/query" else "write"
+            lat.setdefault(kind, []).append((at, dt, status))
+
+        fail = {}
+        engine2 = lease2 = runner2 = None
+
+        async def failover():
+            nonlocal engine2, lease2, runner2
+            await asyncio.sleep(kill_at)
+            t_kill = time.perf_counter()
+            # compute plane dies: listener gone, ingest loops aborted
+            # with NO final flush — acked tail lives only in WAL bytes
+            await runner.cleanup()
+            await follower.close()
+            # the durable log plane outlives the process: drain the
+            # committed tail into the mirror before replay
+            drain = WalFollower(LocalWalSource(state.repl,
+                                               "bench-follower"),
+                                mirror_dir, region=0)
+            for _ in range(100):
+                await drain.poll_once()
+                if drain.lag() == 0:
+                    break
+            else:
+                raise RuntimeError(
+                    f"mirror failed to drain: lag {drain.lag()}")
+            await drain.close()
+            await state.stop_replication()  # renewals stop with it
+            for t in engine.tables.values():
+                abort = getattr(t, "abort", None)
+                if abort is not None:
+                    await abort()
+            engine._runtimes.close()
+            fail["drain_ms"] = round((time.perf_counter() - t_kill)
+                                     * 1e3, 1)
+            mgr = LeaseManager(store, "metrics")
+            attempts = 0
+            while True:
+                attempts += 1
+                try:
+                    engine2, lease2 = await promote(
+                        "metrics", store, 0, mgr, "bench-follower",
+                        mirror_dir, wal_template,
+                        segment_ms=segment_ms,
+                        lease_ttl_ms=10_000, reason="primary_dead")
+                    break
+                except ReplicationError:
+                    # the dead primary's lease has not expired yet
+                    await asyncio.sleep(0.05)
+            lease2.start_renewal(2.0, 10_000)
+            state2 = ServerState(engine2, ServerConfig())
+            runner2, base2 = await start_server(state2)
+            target["base"] = base2
+            fail["failover_ms"] = round((time.perf_counter() - t_kill)
+                                        * 1e3, 1)
+            fail["lease_acquire_attempts"] = attempts
+
+        try:
+            # unmeasured preamble: warm both request shapes
+            for path, payload in (("/query", dash_q),
+                                  ("/write", write_req(10**9))):
+                r = await session.post(  # noqa: session-wide timeout
+                    base + path, json=payload)
+                await r.release()
+            lat.clear()
+            acked.clear()
+            fo = asyncio.create_task(failover())
+            tasks = []
+            for at, path, payload, widx in schedule(
+                    random_mod.Random(seed)):
+                delay = t_start + at - time.perf_counter()
+                if delay > 0:
+                    await asyncio.sleep(delay)
+                tasks.append(asyncio.create_task(
+                    fire(at, path, payload, widx)))
+            await asyncio.gather(*tasks)
+            await fo
+
+            # zero-acked-write-loss audit against the PROMOTED engine:
+            # every 200-acked write must be readable with its value
+            rng = TimeRange.new(TW0 - 1, TW0 + 10_000_000)
+            got = {}
+            for h in range(8):
+                t = await engine2.query("ingest",
+                                        [("host", f"w{h:02d}")], rng)
+                for ts_v, v in zip(t.column("timestamp").to_pylist(),
+                                   t.column("value").to_pylist()):
+                    got[(h, ts_v)] = v
+            lost = sum(
+                1 for i in sorted(acked)
+                if got.get((i % 8, TW0 + i * 1000)) != float(i))
+            out = {"rows": n_fix, "leg_seconds": leg_seconds,
+                   "store_latency_ms": lat_s * 1e3,
+                   "lease_ttl_ms": lease_ttl_ms, **fail,
+                   "acked_writes": len(acked),
+                   "acked_write_loss": lost}
+            for kind, ls in sorted(lat.items()):
+                for phase, sel in (
+                        ("pre_kill", [x for x in ls if x[0] < kill_at]),
+                        ("post_kill", [x for x in ls
+                                       if x[0] >= kill_at])):
+                    oks = [dt for _, dt, s in sel if s == 200]
+                    codes: dict = {}
+                    for _, _, s in sel:
+                        codes[str(s)] = codes.get(str(s), 0) + 1
+                    out[f"{kind}_{phase}"] = {
+                        "n": len(sel),
+                        "ok": len(oks),
+                        "p99_ms": (round(float(np.percentile(
+                            np.asarray(oks) * 1e3, 99)), 1)
+                            if oks else None),
+                        "codes": codes,
+                    }
+            return out
+        finally:
+            await session.close()
+            if runner2 is not None:
+                await runner2.cleanup()
+            if lease2 is not None:
+                await lease2.stop_renewal()
+            if engine2 is not None:
+                install_fence(engine2, None)
+                await engine2.close()
+
+    out = asyncio.run(go())
+    out["bar_zero_loss"] = out["acked_write_loss"] == 0
+    # the outage window is visible as non-200 codes post-kill; the SLO
+    # form: compliant p99 of SERVED requests stays bounded and every
+    # acked write survived
+    out["slo_query_p99_ms"] = 500.0
+    out["slo_write_p99_ms"] = 1000.0
+    served_ok = all(
+        out[k]["p99_ms"] is not None
+        and out[k]["p99_ms"] < (out["slo_write_p99_ms"]
+                                if k.startswith("write")
+                                else out["slo_query_p99_ms"])
+        for k in ("query_pre_kill", "write_pre_kill",
+                  "query_post_kill", "write_post_kill"))
+    out["bar_slo_ok"] = served_ok and out["bar_zero_loss"]
+    _log(f"config20: failover {out.get('failover_ms')} ms "
+         f"(drain {out.get('drain_ms')} ms, "
+         f"{out.get('lease_acquire_attempts')} lease attempts) | "
+         f"acked {out['acked_writes']} lost {out['acked_write_loss']} | "
+         f"served p99 bar {'MET' if out['bar_slo_ok'] else 'MISSED'}")
+    # vs_baseline (config-7 form): served-query p99 degradation across
+    # the failover — post-kill p99 over pre-kill p99, 1.0 = the
+    # promoted node serves exactly like the dead primary did (phases
+    # that served nothing fall back to 1.0: no served sample, no ratio)
+    pre = out["query_pre_kill"]["p99_ms"]
+    post = out["query_post_kill"]["p99_ms"]
+    degradation = (round(post / pre, 3)
+                   if pre and post else 1.0)
+    return {
+        "metric": ("replication failover: kill -9 at mid-leg, follower "
+                   "promoted from WAL mirror, open-loop SLO"),
+        "value": out.get("failover_ms"),
+        "unit": "ms",
+        "vs_baseline": degradation,
+        **out,
+    }
+
+
 RUNNERS = {2: run_config2, 3: run_config3, 4: run_config4, 5: run_config5,
            6: run_config6, 7: run_config7, 8: run_config8, 9: run_config9,
            10: run_config10, 11: run_config11, 12: run_config12,
            13: run_config13, 14: run_config14, 15: run_config15,
            16: run_config16, 17: run_config17, 18: run_config18,
-           19: run_config19}
+           19: run_config19, 20: run_config20}
 
 
 def main() -> None:

@@ -19,7 +19,27 @@ from horaedb_tpu.cluster.router import (
 from horaedb_tpu.cluster.breaker import BreakerConfig, CircuitBreaker
 from horaedb_tpu.cluster.cluster import Cluster, GatherMeta
 from horaedb_tpu.cluster.remote import RemoteRegion
+from horaedb_tpu.cluster.replication import (
+    HttpWalSource,
+    Lease,
+    LeaseManager,
+    LocalWalSource,
+    RebalanceConfig,
+    RebalanceExecutor,
+    ReplicationConfig,
+    ReplicationError,
+    ReplicationHub,
+    StaleEpochError,
+    StaleOwnerError,
+    WalFollower,
+    install_fence,
+    promote,
+)
 
 __all__ = ["BreakerConfig", "CircuitBreaker", "Cluster", "GatherMeta",
-           "MAX_TTL", "PartitionRule", "RemoteRegion", "RoutingTable",
-           "routing_key"]
+           "HttpWalSource", "Lease", "LeaseManager", "LocalWalSource",
+           "MAX_TTL", "PartitionRule", "RebalanceConfig",
+           "RebalanceExecutor", "RemoteRegion", "ReplicationConfig",
+           "ReplicationError", "ReplicationHub", "RoutingTable",
+           "StaleEpochError", "StaleOwnerError", "WalFollower",
+           "install_fence", "promote", "routing_key"]

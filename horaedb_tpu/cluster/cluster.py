@@ -56,6 +56,13 @@ _HEALTH_ERRORS = registry.counter(
     "health_monitor_errors_total",
     "heartbeat-round exceptions, by region (region=\"_round\" for "
     "whole-round failures that would otherwise be swallowed)")
+_STALE_OWNER_RETRIES = registry.counter(
+    "cluster_stale_owner_retries_total",
+    "routed retries after a 409 stale-owner answer mid-failover")
+
+# sentinel: a stale-owner retry that produced no result (the region
+# stays in the partial answer's missing set)
+_GATHER_MISS = object()
 
 
 @dataclass
@@ -98,6 +105,12 @@ class Cluster:
         # _SURVEY_EVERY rounds and surfaced on /debug/tasks
         self.rebalance_survey: Optional[dict] = None
         self._health_rounds = 0
+        # ownership re-resolution hook (cluster/replication.py): when a
+        # region answers 409 stale-owner mid-failover, _gather calls
+        # `await owner_resolver(rid, exc)` for a fresh backend to
+        # repoint at and retries ONE hop.  None = no resolver: the 409
+        # degrades to a partial answer like any other region failure.
+        self.owner_resolver = None
 
     @property
     def breaker_config(self) -> BreakerConfig:
@@ -198,6 +211,21 @@ class Cluster:
                 # no running event loop (sync caller building a cluster
                 # before serving): the operator starts it explicitly
                 pass
+
+    def repoint_region(self, region_id: int, backend) -> None:
+        """Swap a routed region's backend in place (failover repoint:
+        the old owner answered 409, the resolver found the new one).
+        Health/breaker state resets — the new backend's record starts
+        clean.  The OLD backend is not closed here: mid-gather its
+        coroutines may still be unwinding; the caller owns its
+        lifecycle."""
+        ensure(region_id in self.regions,
+               f"region {region_id} not attached")
+        self.regions[region_id] = backend
+        self._clear_dead_mark(region_id)
+        if not isinstance(backend, MetricEngine):
+            self.breakers[region_id] = CircuitBreaker(
+                str(region_id), self.breaker_config)
 
     def _clear_dead_mark(self, region_id: int) -> None:
         """A region whose backend changed (adopted locally, re-attached
@@ -426,6 +454,24 @@ class Cluster:
         return self._rebalance_from_stats(await self.region_stats(),
                                           skew_ratio)
 
+    def split_pivot(self, region_id: int) -> Optional[int]:
+        """Machine-executable split point for a hot region: the
+        midpoint of its WIDEST live routing rule (without per-key load
+        stats, halving the largest key share is the best static
+        guess).  None when the region has no splittable rule."""
+        best = None
+        for rule in self.routing.rules:
+            if rule.region_id != region_id:
+                continue
+            if rule.end_key - rule.start_key < 2:
+                continue
+            if best is None or (rule.end_key - rule.start_key
+                                > best.end_key - best.start_key):
+                best = rule
+        if best is None:
+            return None
+        return best.start_key + (best.end_key - best.start_key) // 2
+
     def _rebalance_from_stats(self, stats: dict[int, dict],
                               skew_ratio: float) -> list[dict]:
         sized = {rid: s["bytes"] for rid, s in stats.items()
@@ -443,6 +489,7 @@ class Cluster:
                 rules = stats[rid].get("rules", 0)
                 entry = {
                     "region": rid,
+                    "kind": "move",
                     "bytes": b,
                     "mean_bytes": round(mean),
                     "rules": rules,
@@ -451,14 +498,21 @@ class Cluster:
                                  "adopt_region({rid}) on a lighter node"
                                  .format(rid=rid)),
                 }
-                if rules >= 1:
+                pivot = self.split_pivot(rid) if rules >= 1 else None
+                if pivot is not None:
                     # hot shard: halve its key share in place; the new
-                    # region can then move independently
-                    entry["split_proposal"] = (
-                        f"split_region({rid}, pivot_key=<median series "
-                        f"hash>, new_region_id={next_rid}, "
-                        "table_ttl_ms=<table TTL>)")
+                    # region can then move independently.  pivot_key +
+                    # new_region_id make the entry machine-executable
+                    # (cluster/replication.py RebalanceExecutor) —
+                    # split_region(region, pivot_key, new_region_id,
+                    # table_ttl_ms) runs it verbatim.
+                    entry["kind"] = "split"
+                    entry["pivot_key"] = pivot
                     entry["new_region_id"] = next_rid
+                    entry["split_proposal"] = (
+                        f"split_region({rid}, pivot_key={pivot}, "
+                        f"new_region_id={next_rid}, "
+                        "table_ttl_ms=<table TTL>)")
                     next_rid += 1
                 plan.append(entry)
         return plan
@@ -734,26 +788,68 @@ class Cluster:
                     # attempt that lost the race
                     t.exception()
 
+    async def _retry_stale_owner(self, rid: int, exc, factory_for):
+        """One routed retry after a 409 stale-owner answer: ask the
+        resolver for the region's new backend, repoint, re-issue the
+        region call.  Any failure — no resolver, resolver error, no
+        backend, retry failure — returns _GATHER_MISS and the region
+        degrades to a partial answer (X-Missing-Regions), never a hard
+        error to the client."""
+        if self.owner_resolver is None:
+            return _GATHER_MISS
+        try:
+            backend = await self.owner_resolver(rid, exc)
+        except Exception as res_exc:  # noqa: BLE001 — degrade, not fail
+            logger.warning("gather: owner re-resolution for region %s "
+                           "failed: %s", rid, res_exc)
+            return _GATHER_MISS
+        if backend is None:
+            return _GATHER_MISS
+        _STALE_OWNER_RETRIES.inc()
+        self.repoint_region(rid, backend)
+        try:
+            return await self._call_region(rid, factory_for(rid))
+        except asyncio.CancelledError:
+            raise
+        except Exception as retry_exc:  # noqa: BLE001 — one hop only
+            logger.warning("gather: stale-owner retry for region %s "
+                           "failed: %s", rid, retry_exc)
+            return _GATHER_MISS
+
     async def _gather(self, time_range: TimeRange, factory_for
                       ) -> tuple[dict[int, object], GatherMeta]:
         """Degraded scatter-gather core: returns {rid: result} for the
         regions that answered plus the GatherMeta marker.  Raises only
         when EVERY routed region failed or was skipped — a query that
         can return no region's data at all has nothing to degrade to."""
+        from horaedb_tpu.cluster.replication import StaleOwnerError
+
         live, skipped = self._gather_targets(time_range)
         outcomes = await asyncio.gather(
             *(self._call_region(rid, factory_for(rid)) for rid in live),
             return_exceptions=True)
         results: dict[int, object] = {}
         errors: dict[int, str] = dict(skipped)
+        stale: dict[int, StaleOwnerError] = {}
         for rid, out in zip(live, outcomes):
             if isinstance(out, asyncio.CancelledError):
                 raise out
-            if isinstance(out, BaseException):
+            if isinstance(out, StaleOwnerError):
+                # mid-failover 409: never a hard error — try ONE
+                # routed retry against the re-resolved owner below,
+                # else degrade to a partial answer
+                stale[rid] = out
+                errors[rid] = str(out) or "stale owner"
+            elif isinstance(out, BaseException):
                 logger.warning("gather: region %s failed: %s", rid, out)
                 errors[rid] = str(out) or type(out).__name__
             else:
                 results[rid] = out
+        for rid, exc in stale.items():
+            retried = await self._retry_stale_owner(rid, exc, factory_for)
+            if retried is not _GATHER_MISS:
+                results[rid] = retried
+                errors.pop(rid, None)
         missing = sorted(set(errors))
         if not results:
             dl = deadline_mod.current_deadline()

@@ -36,6 +36,25 @@ from horaedb_tpu.utils import span, tracing
 DEFAULT_RPC_TIMEOUT_S = 60.0
 
 
+def _stale_owner_error(base_url: str, path: str, text: str):
+    """Typed 409 stale-owner answer; carries the region/new-owner hint
+    from the JSON body when the peer knows it."""
+    import json
+
+    from horaedb_tpu.cluster.replication import StaleOwnerError
+
+    region = owner = None
+    try:
+        body = json.loads(text)
+        region = body.get("region")
+        owner = body.get("owner")
+    except (ValueError, AttributeError):
+        pass
+    return StaleOwnerError(
+        f"remote region {base_url}{path} answered 409 stale-owner: "
+        f"{text[:200]}", region=region, owner=owner)
+
+
 class RemoteRegion:
     def __init__(self, base_url: str,
                  session: Optional[aiohttp.ClientSession] = None,
@@ -86,6 +105,13 @@ class RemoteRegion:
             headers = {**dl_headers, **kwargs.pop("headers", {})}
             async with session.post(self.base_url + path, timeout=timeout,
                                     headers=headers, **kwargs) as resp:
+                if resp.status == 409:
+                    # stale owner: the peer lost this region's lease
+                    # mid-failover.  Typed so the coordinator's gather
+                    # can re-resolve ownership and retry ONE hop
+                    # instead of degrading immediately.
+                    raise _stale_owner_error(self.base_url, path,
+                                             await resp.text())
                 if resp.status != 200:
                     # body may be a non-JSON error page (404, 500 html)
                     text = await resp.text()
@@ -201,6 +227,10 @@ class RemoteRegion:
                     "start": str(int(time_range.start)),
                     "end": str(int(time_range.end))},
                     timeout=timeout, headers=dl_headers) as resp:
+                if resp.status == 409:
+                    raise _stale_owner_error(self.base_url,
+                                             "/label_values",
+                                             await resp.text())
                 if resp.status != 200:
                     text = await resp.text()
                     raise Error(

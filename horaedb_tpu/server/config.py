@@ -19,6 +19,7 @@ from typing import Any, Optional
 from horaedb_tpu.common import Error, ReadableDuration, ReadableSize, ensure
 from horaedb_tpu.common.tenant import TenantsConfig, tenants_from_dict
 from horaedb_tpu.cluster.breaker import BreakerConfig
+from horaedb_tpu.cluster.replication import RebalanceConfig, ReplicationConfig
 from horaedb_tpu.metric_engine.meta import MetaConfig
 from horaedb_tpu.rollup.config import RollupConfig, rollup_from_dict
 from horaedb_tpu.scanagent.config import ScanAgentConfig, scanagent_from_dict
@@ -205,6 +206,12 @@ class ServerConfig:
     # memory plane: ledger sampler + pressure watermarks
     # (common/memledger.py, GET /debug/memory)
     memory: MemoryConfig = field(default_factory=MemoryConfig)
+    # replication plane: WAL shipping + lease-fenced ownership
+    # (cluster/replication.py); disabled reproduces single-copy
+    # behavior bit-for-bit
+    replication: ReplicationConfig = field(default_factory=ReplicationConfig)
+    # auto-executed rebalance envelope for survey_load recommendations
+    rebalance: RebalanceConfig = field(default_factory=RebalanceConfig)
     # self-monitoring meta-ingest (metric_engine/meta.py)
     meta: MetaConfig = field(default_factory=MetaConfig)
     # near-data scan agents: shard map + routing policy (scanagent/);
@@ -275,6 +282,12 @@ def _dc_from_dict(cls: type, data: dict[str, Any]) -> Any:
         elif key == "memory" and cls is ServerConfig:
             ensure(isinstance(value, dict), f"{where} expects a config table")
             kwargs[key] = _dc_from_dict(MemoryConfig, value)
+        elif key == "replication":
+            ensure(isinstance(value, dict), f"{where} expects a config table")
+            kwargs[key] = _dc_from_dict(ReplicationConfig, value)
+        elif key == "rebalance":
+            ensure(isinstance(value, dict), f"{where} expects a config table")
+            kwargs[key] = _dc_from_dict(RebalanceConfig, value)
         elif key == "meta":
             ensure(isinstance(value, dict), f"{where} expects a config table")
             kwargs[key] = _dc_from_dict(MetaConfig, value)
@@ -347,6 +360,29 @@ def load_config(path: Optional[str] = None) -> ServerConfig:
     if cfg.memory.soft_limit.bytes and cfg.memory.hard_limit.bytes:
         ensure(cfg.memory.soft_limit.bytes <= cfg.memory.hard_limit.bytes,
                "[memory] soft_limit must not exceed hard_limit")
+    if cfg.replication.enabled:
+        ensure(cfg.replication.lease_ttl.seconds > 0,
+               "[replication] lease_ttl must be positive")
+        ensure(cfg.replication.renew_interval.seconds
+               < cfg.replication.lease_ttl.seconds,
+               "[replication] renew_interval must be shorter than "
+               "lease_ttl (a lease must outlive at least one missed "
+               "renewal)")
+        ensure(cfg.replication.poll_interval.seconds > 0,
+               "[replication] poll_interval must be positive")
+        ensure(cfg.replication.max_batch_bytes >= 1,
+               "[replication] max_batch_bytes must be >= 1")
+        if cfg.replication.primary_url:
+            ensure(bool(cfg.replication.mirror_dir),
+                   "[replication] a follower (primary_url set) needs "
+                   "mirror_dir for its local WAL mirror")
+    if cfg.rebalance.enabled:
+        ensure(cfg.rebalance.max_concurrent_moves >= 1,
+               "[rebalance] max_concurrent_moves must be >= 1")
+        ensure(cfg.rebalance.skew_ratio > 1.0,
+               "[rebalance] skew_ratio must be > 1")
+        ensure(cfg.rebalance.interval.seconds > 0,
+               "[rebalance] interval must be positive")
     if cfg.meta.enabled:
         ensure(cfg.meta.interval.seconds > 0,
                "[meta] interval must be positive")

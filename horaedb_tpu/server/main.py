@@ -75,7 +75,12 @@ _UNGOVERNED_ENDPOINTS = frozenset({
     "/admin/scrub", "/admin/flush", "/admin/rollups",
     "/admin/tenants", "/admin/rebalance",
     "/debug/traces", "/debug/traces/{trace_id}", "/debug/tasks",
-    "/debug/memory"})
+    "/debug/memory",
+    # replication ops plane (cluster/replication.py): internal
+    # node-to-node shipping — the follower bounds its RPCs client-side,
+    # so replication never sheds under query admission pressure
+    "/repl/wal/segments", "/repl/wal/read", "/repl/wal/ack",
+    "/repl/status"})
 
 _SHED = registry.counter(
     "server_queries_shed_total",
@@ -442,7 +447,77 @@ class ServerState:
         # of already-attached remote regions too)
         if hasattr(engine, "breaker_config"):
             engine.breaker_config = config.breaker
+        # [replication]: the primary-side shipping hub over this
+        # engine's per-table WALs (segment listings, tail reads,
+        # follower acks + the retention hook).  The lease, follower,
+        # and stale-owner state wire in start_replication() — they
+        # need async store I/O the constructor cannot do.
+        self.repl = None
+        if (config.replication.enabled
+                and getattr(engine, "tables", None) is not None):
+            from horaedb_tpu.cluster.replication import ReplicationHub
+
+            self.repl = ReplicationHub(engine, config.replication)
+        self.lease = None
+        self.follower = None
+        # set when this node lost its region's lease: governed
+        # endpoints answer 409 stale-owner until a fresh lease (or
+        # restart) clears it — the coordinator re-resolves and retries
+        self.stale_owner: Optional[dict] = None
         self._generator_tasks: list[asyncio.Task] = []
+
+    async def start_replication(self, store) -> None:
+        """Async half of [replication] wiring: claim the configured
+        region's lease (fencing every flush on this engine), and/or
+        start tailing a primary into the mirror."""
+        cfg = self.config.replication
+        if not cfg.enabled:
+            return
+        from horaedb_tpu.cluster import replication as repl_mod
+
+        # a node with a primary_url is a FOLLOWER: it must not claim
+        # the region's lease at startup (that would fence the live
+        # primary); promotion acquires it explicitly at failover time
+        if cfg.region >= 0 and not cfg.primary_url:
+            holder = cfg.holder or f"server:{self.config.port}"
+            mgr = repl_mod.LeaseManager(store, "metrics")
+            lease = await mgr.acquire(
+                cfg.region, holder,
+                ttl_ms=int(cfg.lease_ttl.seconds * 1000))
+            lease.grant_ttl_ms(int(cfg.lease_ttl.seconds * 1000))
+
+            def on_lost(exc: BaseException) -> None:
+                self.stale_owner = {
+                    "region": cfg.region,
+                    "epoch": lease.epoch,
+                    "reason": str(exc),
+                }
+
+            lease.on_lost = on_lost
+            lease.start_renewal(cfg.renew_interval.seconds,
+                                int(cfg.lease_ttl.seconds * 1000))
+            repl_mod.install_fence(self.engine, lease)
+            self.lease = lease
+        if cfg.primary_url and cfg.mirror_dir:
+            source = repl_mod.HttpWalSource(
+                cfg.primary_url,
+                follower_id=cfg.holder or f"server:{self.config.port}",
+                timeout_s=cfg.rpc_timeout.seconds)
+            self.follower = repl_mod.WalFollower(
+                source, cfg.mirror_dir, cfg,
+                region=cfg.region if cfg.region >= 0 else None)
+            self.follower.start()
+
+    async def stop_replication(self) -> None:
+        if self.follower is not None:
+            await self.follower.close()
+            self.follower = None
+        if self.lease is not None:
+            await self.lease.stop_renewal()
+            self.lease = None
+        if self.repl is not None:
+            self.repl.close()
+            self.repl = None
 
     # ---- write-load generator (ref: main.rs:187-233) ----------------------
 
@@ -623,6 +698,15 @@ def _resilience_middleware(state: ServerState):
         path = request.path
         is_query = path in _QUERY_ENDPOINTS
         is_write = path in _WRITE_ENDPOINTS
+        if (is_query or is_write) and state.stale_owner is not None:
+            # this node lost its region's lease mid-failover: refuse
+            # data-plane traffic with 409 so the coordinator
+            # re-resolves ownership and retries against the new
+            # primary (cluster/replication.py StaleOwnerError)
+            return web.json_response(
+                {"error": "stale owner: this node's region lease was "
+                          "lost", **state.stale_owner},
+                status=409)
         if is_query:
             default_s = cfg.query_timeout.seconds or None
         elif is_write:
@@ -1345,6 +1429,79 @@ def build_app(state: ServerState) -> web.Application:
             return _error_response(e)
         return web.json_response({"values": vals})
 
+    # ---- replication ops plane (cluster/replication.py) -------------------
+    # Ungoverned: followers bound every RPC client-side (HttpWalSource
+    # carries an explicit timeout + X-Deadline-Ms), and shipping must
+    # keep draining even when the admission gate is shedding client
+    # load — replication lag during overload makes failover WORSE.
+
+    @routes.get("/repl/wal/segments")
+    async def repl_segments(req: web.Request) -> web.Response:
+        if state.repl is None:
+            return web.json_response(
+                {"error": "replication not enabled on this node"},
+                status=501)
+        follower = req.query.get("follower")
+        return web.json_response(state.repl.snapshot(follower_id=follower))
+
+    @routes.get("/repl/wal/read")
+    async def repl_read(req: web.Request) -> web.Response:
+        if state.repl is None:
+            return web.json_response(
+                {"error": "replication not enabled on this node"},
+                status=501)
+        try:
+            log = req.query["log"]
+            segment = int(req.query["segment"])
+            offset = int(req.query["offset"])
+            max_bytes = int(req.query["max_bytes"])
+        except (KeyError, ValueError) as e:
+            return web.json_response({"error": f"bad request: {e}"},
+                                     status=400)
+        out = await state.repl.read_tail(log, segment, offset, max_bytes)
+        if out is None:
+            # segment truncated (or unknown log): the follower resyncs
+            # from a fresh listing instead of treating this as an error
+            return web.Response(body=b"", headers={"X-Wal-Gone": "1"})
+        blob, sealed = out
+        return web.Response(body=blob,
+                            headers={"X-Wal-Sealed": "1" if sealed else "0"},
+                            content_type="application/octet-stream")
+
+    @routes.post("/repl/wal/ack")
+    async def repl_ack(req: web.Request) -> web.Response:
+        if state.repl is None:
+            return web.json_response(
+                {"error": "replication not enabled on this node"},
+                status=501)
+        try:
+            body = await req.json()
+            follower = str(body["follower"])
+            acks = {str(k): int(v) for k, v in body["acks"].items()}
+        except (KeyError, TypeError, ValueError, AttributeError) as e:
+            return web.json_response({"error": f"bad request: {e}"},
+                                     status=400)
+        state.repl.ack(follower, acks)
+        return web.json_response({"ok": True})
+
+    @routes.get("/repl/status")
+    async def repl_status(req: web.Request) -> web.Response:
+        body: dict = {"role": "none"}
+        if state.repl is not None:
+            body = state.repl.status()
+            body["role"] = "primary"
+        elif state.follower is not None:
+            body["role"] = "follower"
+            body["lag_seqs"] = state.follower.lag()
+            body["shipped_seqs"] = dict(state.follower.shipped_seqs)
+        if state.lease is not None:
+            body["lease"] = {"region": state.lease.region,
+                             "epoch": state.lease.epoch,
+                             "lost": state.lease.lost}
+        if state.stale_owner is not None:
+            body["stale_owner"] = state.stale_owner
+        return web.json_response(body)
+
     # sized for the Arrow-IPC bulk data plane (default 1 MiB would 413
     # any real ingest batch); the tenant middleware is outermost (the
     # identity must be ambient before the trace roots and the
@@ -1415,6 +1572,7 @@ async def run_server(config: ServerConfig,
         wal_config=wal_config, rollup_config=config.rollup,
         meta_config=config.meta, scanagent_config=config.scanagent)
     state = ServerState(engine, config)
+    await state.start_replication(store)
     if config.test.enable_write:
         state.start_generators()
 
@@ -1431,6 +1589,7 @@ async def run_server(config: ServerConfig,
             await asyncio.sleep(3600)
     finally:
         await state.stop_generators()
+        await state.stop_replication()
         await runner.cleanup()
         await engine.close()
         closer = getattr(store, "close", None)

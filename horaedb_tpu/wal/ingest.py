@@ -107,6 +107,12 @@ class IngestStorage(TimeMergeStorage):
         # flush-commit hook: called with the segment start after an SST
         # + manifest commit lands (the rollup manager's delta feed)
         self.on_flush = None
+        # ownership fence (cluster/replication.py): when set, every
+        # flush revalidates the region lease BEFORE the SST + manifest
+        # commit — a primary whose lease was stolen raises
+        # StaleEpochError here and can never commit past its epoch.
+        # None = unreplicated region, no fencing (current behavior).
+        self.fence = None
         # ledger accounts (memtable bytes + WAL backlog), set by open()
         self._mem_accounts: list = []
 
@@ -379,6 +385,13 @@ class IngestStorage(TimeMergeStorage):
         try:
             table, rng, seqs = mt.drain(self.inner.schema())
             if table is not None:
+                if self.fence is not None:
+                    # fencing point: the lease must still be ours AT
+                    # the commit attempt, not just when the flush was
+                    # scheduled — a stale-epoch holder fails here with
+                    # the rows intact (re-inserted below) for the new
+                    # primary's replay to cover
+                    await self.fence.check()
                 if self._on_op is not None:
                     self._on_op("flush")
                 # flushes run seconds-to-minutes on big memtables:

@@ -1,0 +1,1151 @@
+"""Replication plane tests (cluster/replication.py): WAL shipping +
+lease-fenced ownership + failover + the auto-rebalance envelope, plus
+the seeded failover chaos harness (knobs REPL_SEED / REPL_SCHEDULES,
+wired into `make chaos`).
+
+Invariants under test (ISSUE 16 acceptance):
+  * zero acked writes lost across kill -9 + promotion, and the
+    promoted follower serves grids byte-identical with a single-copy
+    control engine fed the same writes;
+  * a primary that lost its lease can never commit (stale-epoch flush
+    refused at the fencing point, no manifest/SST published);
+  * a 409 stale-owner answer mid-gather degrades to a routed retry or
+    a partial answer, never a hard client error.
+"""
+
+import asyncio
+import os
+import random
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from horaedb_tpu.cluster import Cluster
+from horaedb_tpu.cluster.replication import (
+    LeaseManager,
+    LocalWalSource,
+    RebalanceConfig,
+    RebalanceExecutor,
+    ReplicationError,
+    ReplicationHub,
+    StaleEpochError,
+    StaleOwnerError,
+    install_fence,
+    promote,
+)
+from horaedb_tpu.common import ReadableDuration
+from horaedb_tpu.metric_engine import Label, MetricEngine, Sample
+from horaedb_tpu.objstore import MemoryObjectStore
+from horaedb_tpu.storage.types import TimeRange
+from horaedb_tpu.wal import WalConfig
+from horaedb_tpu.wal.log import Wal, encode_record, verify_frames
+
+REPL_SEED = int(os.environ.get("REPL_SEED", "1337"), 0)
+REPL_SCHEDULES = int(os.environ.get("REPL_SCHEDULES", "10"), 0)
+
+T0 = 1_700_000_000_000
+HOUR = 3_600_000
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def sample(name, labels, ts, value):
+    return Sample(name=name, labels=[Label(k, v) for k, v in labels],
+                  timestamp=ts, value=value)
+
+
+def wal_config(wal_dir, **kw):
+    """Flush thresholds pinned sky-high: tests drive flushes
+    explicitly so the WAL backlog (the shipped tail) is deterministic."""
+    defaults = dict(enabled=True, dir=str(wal_dir), flush_rows=10**6,
+                    flush_bytes=1 << 30,
+                    flush_age=ReadableDuration.parse("1h"),
+                    flush_interval=ReadableDuration.parse("1h"),
+                    max_group_wait=ReadableDuration.from_millis(0))
+    defaults.update(kw)
+    return WalConfig(**defaults)
+
+
+BATCH_SCHEMA = pa.schema([("k", pa.string()), ("ts", pa.int64()),
+                          ("v", pa.float64())])
+
+
+def batch(rows):
+    k, t, v = zip(*rows)
+    return pa.record_batch(
+        [pa.array(list(k)), pa.array(list(t), type=pa.int64()),
+         pa.array(list(v), type=pa.float64())], schema=BATCH_SCHEMA)
+
+
+class Clock:
+    """Injected ms clock for lease TTL tests — no wall-time sleeps."""
+
+    def __init__(self, now=T0):
+        self.now = now
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, ms):
+        self.now += ms
+
+
+async def kill_engine(engine):
+    """Simulated kill -9: abort every WAL-fronted table (NO final
+    flush — the acked-but-unflushed tail stays only in the WAL) and
+    release the engine's runtime threads."""
+    for t in engine.tables.values():
+        abort = getattr(t, "abort", None)
+        if abort is not None:
+            await abort()
+        else:
+            await t.close()
+    if getattr(engine, "_runtimes", None) is not None:
+        engine._runtimes.close()
+
+
+async def grid_of(engine, metric, rng, bucket_ms=1000):
+    out = await engine.query_downsample(metric, [], rng,
+                                        bucket_ms=bucket_ms,
+                                        aggs=("sum", "count", "max"))
+    return out
+
+
+def grids_byte_identical(a, b):
+    assert list(map(str, a["tsids"])) == list(map(str, b["tsids"]))
+    assert a["num_buckets"] == b["num_buckets"]
+    assert set(a["aggs"]) == set(b["aggs"])
+    for agg, grid in a["aggs"].items():
+        ga = np.asarray(grid)
+        gb = np.asarray(b["aggs"][agg])
+        assert ga.tobytes() == gb.tobytes(), f"{agg} grid differs"
+
+
+# ---------------------------------------------------------------------------
+# satellite (a): WAL segment listing / high-watermark / tail reads /
+# retention hook
+
+
+class TestWalIntrospection:
+    def test_segments_and_high_watermark(self, tmp_path):
+        async def go():
+            cfg = wal_config(tmp_path, segment_bytes=1)  # seal per group
+            wal = Wal(str(tmp_path), cfg)
+            wal.replay()
+            wal.start()
+            assert wal.high_watermark == 0
+            b = batch([("a", 1, 1.0)])
+            for seq in (3, 7, 9):
+                await wal.append(seq, TimeRange.new(1, 2), b)
+            segs = wal.segments()
+            assert [s["id"] for s in segs] == sorted(s["id"] for s in segs)
+            assert wal.high_watermark == 9
+            # per-segment max_seq covers every committed seq exactly
+            assert sorted(s["max_seq"] for s in segs if s["max_seq"]) == \
+                [3, 7, 9]
+            assert all(s["size"] > 0 for s in segs if s["max_seq"])
+            await wal.close()
+
+        run(go())
+
+    def test_high_watermark_survives_replay(self, tmp_path):
+        async def go():
+            cfg = wal_config(tmp_path)
+            wal = Wal(str(tmp_path), cfg)
+            wal.replay()
+            wal.start()
+            await wal.append(5, TimeRange.new(1, 2), batch([("a", 1, 1.0)]))
+            await wal.append(8, TimeRange.new(2, 3), batch([("b", 2, 2.0)]))
+            await wal.close()
+            wal2 = Wal(str(tmp_path), cfg)
+            wal2.replay()
+            assert wal2.high_watermark == 8
+            assert max(s["max_seq"] for s in wal2.segments()) == 8
+            await wal2.close()
+
+        run(go())
+
+    def test_read_tail_frame_aligned(self, tmp_path):
+        async def go():
+            cfg = wal_config(tmp_path)
+            wal = Wal(str(tmp_path), cfg)
+            wal.replay()
+            wal.start()
+            b = batch([("a", 1, 1.0), ("b", 2, 2.0)])
+            for seq in (1, 2, 3):
+                await wal.append(seq, TimeRange.new(1, 3), b)
+            seg = wal.segments()[0]
+            # full read: every frame verifies, watermark matches
+            blob, sealed = await wal.read_tail(seg["id"], 0, 1 << 20)
+            assert len(blob) == seg["size"] and sealed is False
+            aligned, max_seq, count = verify_frames(blob)
+            assert (aligned, max_seq, count) == (len(blob), 3, 3)
+            # resume from a frame boundary: the remainder verifies too
+            one = len(encode_record(1, TimeRange.new(1, 3), b))
+            rest, _ = await wal.read_tail(seg["id"], one, 1 << 20)
+            a2, m2, c2 = verify_frames(rest)
+            assert (a2, m2, c2) == (len(rest), 3, 2)
+            # caught up -> empty blob, not None
+            assert await wal.read_tail(seg["id"], seg["size"], 64) == \
+                (b"", False)
+            # max_bytes caps the chunk
+            head, _ = await wal.read_tail(seg["id"], 0, 10)
+            assert len(head) == 10
+            # unknown segment -> None (truncated; follower resyncs)
+            assert await wal.read_tail(seg["id"] + 999, 0, 64) is None
+            await wal.close()
+
+        run(go())
+
+    def test_verify_frames_rejects_corruption(self):
+        b = batch([("a", 1, 1.0)])
+        rec = encode_record(4, TimeRange.new(1, 2), b)
+        # torn tail: only the whole frames count
+        aligned, max_seq, count = verify_frames(rec * 2 + rec[:7])
+        assert (aligned, max_seq, count) == (2 * len(rec), 4, 2)
+        # flipped payload byte: crc stops the walk at the corruption
+        bad = bytearray(rec * 2)
+        bad[len(rec) + 12] ^= 0xFF
+        aligned, _, count = verify_frames(bytes(bad))
+        assert (aligned, count) == (len(rec), 1)
+        assert verify_frames(b"") == (0, 0, 0)
+
+    def test_retention_hook_blocks_truncation(self, tmp_path):
+        async def go():
+            cfg = wal_config(tmp_path, segment_bytes=1)
+            wal = Wal(str(tmp_path), cfg)
+            wal.replay()
+            wal.start()
+            b = batch([("a", 1, 1.0)])
+            await wal.append(1, TimeRange.new(1, 2), b)
+            await wal.append(2, TimeRange.new(1, 2), b)
+            wal.mark_flushed([1, 2])
+            # hook refuses: flushed + sealed segments stay on disk
+            asked = []
+            wal.retention = lambda seg_id, max_seq: (
+                asked.append((seg_id, max_seq)) or False)
+            assert await wal.truncate() == 0
+            assert asked and all(seq <= 2 for _, seq in asked)
+            # hook allows -> default behavior returns bit-for-bit
+            wal.retention = None
+            assert await wal.truncate() >= 1
+            await wal.close()
+
+        run(go())
+
+
+# ---------------------------------------------------------------------------
+# lease-fenced ownership
+
+
+class TestLease:
+    def test_epoch_monotonic_across_holders(self):
+        async def go():
+            clock = Clock()
+            mgr = LeaseManager(MemoryObjectStore(), "metrics", clock=clock)
+            a = await mgr.acquire(7, "node-a", ttl_ms=10_000)
+            assert a.epoch == 1
+            # live lease is exclusive
+            with pytest.raises(ReplicationError):
+                await mgr.acquire(7, "node-b", ttl_ms=10_000)
+            # the holder itself may re-acquire (epoch still bumps)
+            a2 = await mgr.acquire(7, "node-a", ttl_ms=10_000)
+            assert a2.epoch == 2
+            # expiry opens the door; the new holder's epoch is greater
+            clock.advance(20_000)
+            b = await mgr.acquire(7, "node-b", ttl_ms=10_000)
+            assert b.epoch == 3
+            await b.release()
+            assert await mgr.read(7) is None
+
+        run(go())
+
+    def test_check_fences_stolen_lease(self):
+        async def go():
+            clock = Clock()
+            mgr = LeaseManager(MemoryObjectStore(), "metrics", clock=clock)
+            a = await mgr.acquire(7, "node-a", ttl_ms=10_000)
+            a.grant_ttl_ms(10_000)
+            await a.check()  # live and ours
+            clock.advance(11_000)
+            b = await mgr.acquire(7, "node-b", ttl_ms=10_000)
+            with pytest.raises(StaleEpochError):
+                await a.check()
+            assert a.lost
+            # a lost lease stays lost (no store read needed)
+            with pytest.raises(StaleEpochError):
+                await a.check()
+            # renewal must never resurrect the stolen lease either
+            with pytest.raises(StaleEpochError):
+                await a.renew()
+            await b.check()
+
+        run(go())
+
+    def test_expiry_without_thief_still_refuses(self):
+        async def go():
+            clock = Clock()
+            mgr = LeaseManager(MemoryObjectStore(), "metrics", clock=clock)
+            a = await mgr.acquire(7, "node-a", ttl_ms=5_000)
+            a.grant_ttl_ms(5_000)
+            clock.advance(6_000)
+            # conservative: expired un-renewed refuses even though no
+            # one stole it (under-serve beats double-commit)
+            with pytest.raises(StaleEpochError):
+                await a.check()
+
+        run(go())
+
+    def test_stale_epoch_flush_refused_no_commit(self, tmp_path):
+        """The acceptance invariant: after losing the lease, the old
+        primary's flush fails AT the commit point — no SST, no manifest
+        entry — and the acked rows stay scan-visible for the new
+        primary's replay to cover."""
+        async def go():
+            clock = Clock()
+            store = MemoryObjectStore()
+            engine = await MetricEngine.open(
+                "repl/region_7", store, segment_ms=2 * HOUR,
+                wal_config=wal_config(tmp_path / "wal"))
+            try:
+                mgr = LeaseManager(store, "repl", clock=clock)
+                lease = await mgr.acquire(7, "node-a", ttl_ms=10_000)
+                lease.grant_ttl_ms(10_000)
+                install_fence(engine, lease)
+                await engine.write([
+                    sample("cpu", [("host", "h1")], T0 + i, float(i))
+                    for i in range(4)])
+                ssts_before = (await engine.stats())["ssts"]
+                # steal the lease (expiry + new holder at higher epoch)
+                clock.advance(11_000)
+                await mgr.acquire(7, "node-b", ttl_ms=10_000)
+                with pytest.raises(StaleEpochError):
+                    await engine.flush()
+                stats = await engine.stats()
+                assert stats["ssts"] == ssts_before  # nothing committed
+                # acked rows remain served (re-inserted post-failure)
+                rng = TimeRange.new(T0, T0 + HOUR)
+                tbl = await engine.query("cpu", [("host", "h1")], rng)
+                assert sorted(tbl.column("value").to_pylist()) == \
+                    [0.0, 1.0, 2.0, 3.0]
+            finally:
+                install_fence(engine, None)
+                await engine.close()
+
+        run(go())
+
+
+# ---------------------------------------------------------------------------
+# the tentpole path: ship the WAL, kill the primary, promote the mirror
+
+
+class TestShipAndPromote:
+    def test_promote_byte_identical_zero_loss(self, tmp_path):
+        async def go():
+            clock = Clock()
+            store = MemoryObjectStore()
+            primary = await MetricEngine.open(
+                "repl/region_7", store, segment_ms=2 * HOUR,
+                wal_config=wal_config(tmp_path / "p_wal"))
+            # single-copy control: same writes, never killed
+            control = await MetricEngine.open(
+                "ctl/region_7", MemoryObjectStore(), segment_ms=2 * HOUR,
+                wal_config=wal_config(tmp_path / "c_wal"))
+            promoted = None
+            try:
+                flushed = [
+                    sample("cpu", [("host", f"h{i}")], T0 + 100 * i,
+                           float(i)) for i in range(8)]
+                await primary.write(flushed)
+                await control.write(flushed)
+                await primary.flush()  # these rows live in shared SSTs
+                await control.flush()
+                tail = [
+                    sample("cpu", [("host", f"h{i}")], T0 + 100 * i + 50,
+                           float(10 * i)) for i in range(8)]
+                await primary.write(tail)   # acked, WAL-only
+                await control.write(tail)
+
+                hub = ReplicationHub(primary)
+                from horaedb_tpu.cluster.replication import WalFollower
+                follower = WalFollower(
+                    LocalWalSource(hub, "f1"),
+                    str(tmp_path / "mirror"), region=7)
+                await follower.poll_once()
+                assert follower.lag() == 0
+                assert follower.healthy()
+                status = hub.status()
+                assert status["followers"]["f1"]["lag_seqs"] == 0
+
+                # kill -9 the primary: acked tail exists ONLY in the
+                # mirrored WAL now
+                hub.close()
+                await follower.close()
+                await kill_engine(primary)
+                primary = None
+
+                mgr = LeaseManager(store, "repl", clock=clock)
+                promoted, lease = await promote(
+                    "repl", store, 7, mgr, "node-b",
+                    str(tmp_path / "mirror"),
+                    wal_config(tmp_path / "p_wal"),
+                    segment_ms=2 * HOUR)
+                rng = TimeRange.new(T0, T0 + 10_000)
+                # zero acked-write loss: every row of both batches
+                tbl = await promoted.query("cpu", [], rng)
+                assert tbl.num_rows == 16
+                got = sorted(tbl.column("value").to_pylist())
+                want = sorted([float(i) for i in range(8)]
+                              + [float(10 * i) for i in range(8)])
+                assert got == want
+                # grids byte-identical with the single-copy control
+                grids_byte_identical(await grid_of(promoted, "cpu", rng),
+                                     await grid_of(control, "cpu", rng))
+                # the promoted engine is fenced at the new epoch and
+                # can commit (it owns the lease)
+                assert lease.epoch == 1
+                await promoted.flush()
+            finally:
+                if primary is not None:
+                    await primary.close()
+                await control.close()
+                if promoted is not None:
+                    install_fence(promoted, None)
+                    await promoted.close()
+
+        run(go())
+
+    def test_follower_restart_recovers_watermark(self, tmp_path):
+        """A restarted follower (fresh WalFollower over an existing
+        mirror) rebuilds its shipped watermark from the mirror's own
+        frames — it must not report full lag over bytes it already
+        holds, and a torn tail from a death mid-append is truncated
+        back to a frame boundary."""
+        async def go():
+            from horaedb_tpu.cluster.replication import WalFollower
+
+            store = MemoryObjectStore()
+            engine = await MetricEngine.open(
+                "rr/region_0", store, segment_ms=2 * HOUR,
+                wal_config=wal_config(tmp_path / "wal"))
+            try:
+                await engine.write([
+                    sample("cpu", [("host", "a")], T0 + i, float(i))
+                    for i in range(4)])
+                hub = ReplicationHub(engine)
+                mirror = tmp_path / "mirror"
+                f1 = WalFollower(LocalWalSource(hub, "f"), str(mirror))
+                await f1.poll_once()
+                assert f1.lag() == 0
+                await f1.close()
+                # simulate a death mid-append: torn trailing bytes
+                victim = next(mirror.rglob("*.wal"))
+                with open(victim, "ab") as fh:
+                    fh.write(b"\x01torn")
+                # the restarted follower recovers without re-shipping
+                f2 = WalFollower(LocalWalSource(hub, "f"), str(mirror))
+                shipped = await f2.poll_once()
+                assert f2.lag() == 0
+                assert shipped == 0  # nothing re-shipped
+                # torn tail truncated back to whole frames
+                blob = victim.read_bytes()
+                aligned, _, _ = verify_frames(blob)
+                assert aligned == len(blob)
+                await f2.close()
+                hub.close()
+            finally:
+                await engine.close()
+
+        run(go())
+
+    def test_retention_waits_for_follower_ack(self, tmp_path):
+        async def go():
+            store = MemoryObjectStore()
+            engine = await MetricEngine.open(
+                "repl/region_1", store, segment_ms=2 * HOUR,
+                wal_config=wal_config(tmp_path / "wal", segment_bytes=1))
+            try:
+                hub = ReplicationHub(engine)
+                hub.register_follower("f1")  # registered, nothing acked
+                await engine.write([
+                    sample("cpu", [("host", "a")], T0 + i, float(i))
+                    for i in range(4)])
+                await engine.flush()
+                # flush truncates — but the follower hasn't acked, so
+                # sealed segments survive for shipping
+                segs = {log: [s for s in segs if s["sealed"]]
+                        for log, segs in hub.snapshot()["logs"].items()}
+                assert any(segs.values())
+                # a fresh mirror can still catch up from zero
+                from horaedb_tpu.cluster.replication import WalFollower
+                follower = WalFollower(LocalWalSource(hub, "f1"),
+                                       str(tmp_path / "mirror"), region=1)
+                await follower.poll_once()
+                assert follower.lag() == 0
+                # acked now: the next truncation drops the backlog
+                for wal in (t.wal for t in engine.tables.values()
+                            if getattr(t, "wal", None) is not None):
+                    await wal.truncate()
+                remaining = sum(
+                    1 for segs in hub.snapshot()["logs"].values()
+                    for s in segs if s["sealed"])
+                assert remaining == 0
+                await follower.close()
+                hub.close()
+            finally:
+                await engine.close()
+
+        run(go())
+
+    def test_unregistered_follower_keeps_default(self, tmp_path):
+        """No followers -> retention defers to the WAL default: a
+        single-copy node truncates exactly as before (bit-for-bit)."""
+        async def go():
+            store = MemoryObjectStore()
+            engine = await MetricEngine.open(
+                "solo/region_0", store, segment_ms=2 * HOUR,
+                wal_config=wal_config(tmp_path / "wal", segment_bytes=1))
+            try:
+                hub = ReplicationHub(engine)
+                await engine.write([
+                    sample("cpu", [("host", "a")], T0 + i, float(i))
+                    for i in range(4)])
+                await engine.flush()
+                sealed = sum(
+                    1 for segs in hub.snapshot()["logs"].values()
+                    for s in segs if s["sealed"])
+                assert sealed == 0  # truncated on flush as always
+                hub.close()
+            finally:
+                await engine.close()
+
+        run(go())
+
+
+# ---------------------------------------------------------------------------
+# satellite (b): 409 stale-owner mid-gather -> routed retry or partial
+
+
+class _StaleBackend:
+    """Region backend whose reads always answer 409 stale-owner."""
+
+    def __init__(self, region, owner=None):
+        self.region = region
+        self.owner = owner
+        self.calls = 0
+
+    async def query(self, *a, **kw):
+        self.calls += 1
+        raise StaleOwnerError(f"region {self.region} moved",
+                              region=self.region, owner=self.owner)
+
+    async def query_downsample(self, *a, **kw):
+        raise StaleOwnerError(f"region {self.region} moved",
+                              region=self.region, owner=self.owner)
+
+    async def label_values(self, *a, **kw):
+        raise StaleOwnerError(f"region {self.region} moved",
+                              region=self.region, owner=self.owner)
+
+    async def close(self):
+        pass
+
+
+class TestGatherStaleOwner:
+    def _seed_cluster(self):
+        async def open_c():
+            c = await Cluster.open("cluster", MemoryObjectStore(),
+                                   num_regions=2, segment_ms=2 * HOUR)
+            await c.write([
+                sample("cpu", [("host", f"h{i:03d}")], T0 + 1000, float(i))
+                for i in range(32)])
+            return c
+        return open_c
+
+    def test_stale_owner_degrades_to_partial(self):
+        async def go():
+            c = await self._seed_cluster()()
+            try:
+                rng = TimeRange.new(T0, T0 + HOUR)
+                full = sorted((await c.query("cpu", [], rng))
+                              .column("value").to_pylist())
+                assert len(full) == 32
+                old = c.regions[1]
+                c.repoint_region(1, _StaleBackend(1))
+                # no resolver: one hop degrades to a partial answer,
+                # never a hard error
+                tbl, meta = await c.query_gather("cpu", [], rng)
+                assert meta.partial and meta.missing_regions == [1]
+                assert "stale" in meta.errors[1].lower() or \
+                    "moved" in meta.errors[1]
+                assert 0 < tbl.num_rows < 32
+                c.repoint_region(1, old)
+            finally:
+                await c.close()
+
+        run(go())
+
+    def test_stale_owner_routed_retry_recovers(self):
+        async def go():
+            c = await self._seed_cluster()()
+            try:
+                rng = TimeRange.new(T0, T0 + HOUR)
+                real = c.regions[1]
+                stale = _StaleBackend(1, owner="node-b")
+                c.repoint_region(1, stale)
+                resolved = []
+
+                async def resolver(rid, exc):
+                    resolved.append((rid, exc.owner))
+                    return real
+
+                c.owner_resolver = resolver
+                tbl, meta = await c.query_gather("cpu", [], rng)
+                # ONE routed hop: complete answer, region repointed
+                assert not meta.partial and meta.missing_regions == []
+                assert tbl.num_rows == 32
+                assert resolved == [(1, "node-b")]
+                assert c.regions[1] is real
+                # subsequent gathers hit the healed backend directly
+                tbl2, meta2 = await c.query_gather("cpu", [], rng)
+                assert tbl2.num_rows == 32 and not meta2.partial
+            finally:
+                await c.close()
+
+        run(go())
+
+    def test_resolver_failure_still_partial(self):
+        async def go():
+            c = await self._seed_cluster()()
+            try:
+                rng = TimeRange.new(T0, T0 + HOUR)
+                c.repoint_region(1, _StaleBackend(1))
+
+                async def bad_resolver(rid, exc):
+                    raise RuntimeError("meta service down")
+
+                c.owner_resolver = bad_resolver
+                tbl, meta = await c.query_gather("cpu", [], rng)
+                assert meta.partial and meta.missing_regions == [1]
+            finally:
+                await c.close()
+
+        run(go())
+
+
+# ---------------------------------------------------------------------------
+# tentpole part 3: the auto-rebalance envelope
+
+
+class _PlanCluster:
+    """Stub cluster exposing exactly what RebalanceExecutor consumes."""
+
+    def __init__(self, plan):
+        self.rebalance_survey = {"at_ms": T0, "plan": plan}
+        self.splits = []
+
+    async def split_region(self, rid, pivot, new_rid, ttl_ms):
+        self.splits.append((rid, pivot, new_rid, ttl_ms))
+
+
+def _split_entry(rid=0, new_rid=9):
+    return {"region": rid, "kind": "split", "pivot_key": 1 << 62,
+            "new_region_id": new_rid, "reason": "hot shard"}
+
+
+class TestRebalanceExecutor:
+    def test_gate_order_and_outcomes(self):
+        async def go():
+            clock = Clock()
+            cluster = _PlanCluster([_split_entry()])
+            # disabled: recorded, nothing executes
+            ex = RebalanceExecutor(cluster, RebalanceConfig(), clock=clock)
+            assert (await ex.run_once())[0]["outcome"] == "disabled"
+            # enabled but dry_run (the default envelope): still no moves
+            ex = RebalanceExecutor(
+                cluster, RebalanceConfig(enabled=True), clock=clock)
+            rec = (await ex.run_once())[0]
+            assert rec["outcome"] == "dry_run"
+            assert rec["detail"] == "hot shard"
+            assert cluster.splits == []
+            # fully armed: the split executes with the config's TTL
+            cfg = RebalanceConfig(enabled=True, dry_run=False)
+            ex = RebalanceExecutor(cluster, cfg, clock=clock)
+            assert (await ex.run_once())[0]["outcome"] == "executed"
+            assert cluster.splits == [(0, 1 << 62, 9, cfg.table_ttl_ms)]
+            # cooldown: the same region refuses a second move until the
+            # window lapses
+            assert (await ex.run_once())[0]["outcome"] == "cooldown"
+            clock.advance(cfg.cooldown.seconds * 1000 + 1)
+            assert (await ex.run_once())[0]["outcome"] == "executed"
+            assert [r["outcome"] for r in ex.history] == \
+                ["executed", "cooldown", "executed"]
+
+        run(go())
+
+    def test_replica_health_and_throttle_gates(self):
+        async def go():
+            clock = Clock()
+            cluster = _PlanCluster([_split_entry()])
+            cfg = RebalanceConfig(enabled=True, dry_run=False)
+            ex = RebalanceExecutor(cluster, cfg, clock=clock)
+            ex.replica_healthy = lambda rid: False
+            assert (await ex.run_once())[0]["outcome"] == \
+                "replica_unhealthy"
+            assert cluster.splits == []
+            # require_replica_healthy=False ignores the probe
+            cfg2 = RebalanceConfig(enabled=True, dry_run=False,
+                                   require_replica_healthy=False)
+            ex2 = RebalanceExecutor(cluster, cfg2, clock=clock)
+            ex2.replica_healthy = lambda rid: False
+            assert (await ex2.run_once())[0]["outcome"] == "executed"
+            # throttle: at the concurrency cap nothing new starts
+            ex3 = RebalanceExecutor(cluster, cfg, clock=clock)
+            ex3._inflight = cfg.max_concurrent_moves
+            assert (await ex3.run_once())[0]["outcome"] == "throttled"
+
+        run(go())
+
+    def test_move_needs_target_hook(self):
+        async def go():
+            clock = Clock()
+            entry = {"region": 2, "kind": "move", "reason": "skew"}
+            cluster = _PlanCluster([entry])
+            cfg = RebalanceConfig(enabled=True, dry_run=False)
+            ex = RebalanceExecutor(cluster, cfg, clock=clock)
+            assert (await ex.run_once())[0]["outcome"] == "no_target"
+
+            async def decline(rid, e):
+                return False
+
+            ex.move_target = decline
+            assert (await ex.run_once())[0]["outcome"] == "declined"
+            moved = []
+
+            async def adopt(rid, e):
+                moved.append(rid)
+                return True
+
+            ex.move_target = adopt
+            assert (await ex.run_once())[0]["outcome"] == "executed"
+            assert moved == [2]
+
+        run(go())
+
+    def test_split_pivot_from_routing(self):
+        async def go():
+            c = await Cluster.open("cluster", MemoryObjectStore(),
+                                   num_regions=2, segment_ms=2 * HOUR)
+            try:
+                pivot = c.split_pivot(0)
+                rule = next(r for r in c.routing.rules
+                            if r.region_id == 0)
+                assert rule.start_key < pivot < rule.end_key
+            finally:
+                await c.close()
+
+        run(go())
+
+
+# ---------------------------------------------------------------------------
+# server plane: /repl/* endpoints, 409 middleware, config sections
+
+
+class TestServerRepl:
+    def test_repl_endpoints_and_stale_owner_409(self, tmp_path):
+        async def go():
+            from aiohttp.test_utils import TestClient, TestServer
+
+            from horaedb_tpu.server.config import ServerConfig
+            from horaedb_tpu.server.main import ServerState, build_app
+
+            engine = await MetricEngine.open(
+                "m", MemoryObjectStore(), segment_ms=2 * HOUR,
+                wal_config=wal_config(tmp_path / "wal"))
+            cfg = ServerConfig()
+            cfg.replication.enabled = True
+            state = ServerState(engine, cfg)
+            client = TestClient(TestServer(build_app(state)))
+            await client.start_server()
+            try:
+                r = await client.post("/write", json={"samples": [
+                    {"name": "m1", "labels": {"h": "a"},
+                     "timestamp": T0, "value": 1.5}]})
+                assert r.status == 200
+                # the shipping surface: listing registers the follower
+                r = await client.get("/repl/wal/segments",
+                                     params={"follower": "f1"})
+                assert r.status == 200
+                snap = await r.json()
+                assert snap["high_watermarks"] and snap["logs"]
+                log, segs = next((log, segs) for log, segs
+                                 in snap["logs"].items() if segs)
+                seg = segs[0]
+                r = await client.get("/repl/wal/read", params={
+                    "log": log, "segment": str(seg["id"]), "offset": "0",
+                    "max_bytes": str(1 << 20)})
+                assert r.status == 200
+                assert r.headers["X-Wal-Sealed"] in ("0", "1")
+                blob = await r.read()
+                aligned, max_seq, _ = verify_frames(blob)
+                assert aligned == len(blob) > 0
+                # truncated segment -> X-Wal-Gone, not an error
+                r = await client.get("/repl/wal/read", params={
+                    "log": log, "segment": "999999", "offset": "0",
+                    "max_bytes": "64"})
+                assert r.status == 200
+                assert r.headers["X-Wal-Gone"] == "1"
+                r = await client.post("/repl/wal/ack", json={
+                    "follower": "f1", "acks": {log: max_seq}})
+                assert r.status == 200
+                r = await client.get("/repl/status")
+                body = await r.json()
+                assert body["role"] == "primary"
+                assert body["followers"]["f1"]["acks"][log] == max_seq
+                # losing the lease turns the data plane into 409s...
+                state.stale_owner = {"region": 7, "epoch": 3,
+                                     "reason": "lease stolen"}
+                r = await client.post("/query", json={
+                    "metric": "m1", "start": T0, "end": T0 + 10})
+                assert r.status == 409
+                body = await r.json()
+                assert body["region"] == 7 and body["epoch"] == 3
+                r = await client.post("/write", json={"samples": []})
+                assert r.status == 409
+                # ...but the ops plane keeps answering (ungoverned)
+                r = await client.get("/repl/status")
+                assert r.status == 200
+                assert (await r.json())["stale_owner"]["region"] == 7
+            finally:
+                await client.close()
+                await state.stop_replication()
+                await engine.close()
+
+        run(go())
+
+    def test_repl_disabled_answers_501(self, tmp_path):
+        async def go():
+            from aiohttp.test_utils import TestClient, TestServer
+
+            from horaedb_tpu.server.config import ServerConfig
+            from horaedb_tpu.server.main import ServerState, build_app
+
+            engine = await MetricEngine.open(
+                "m", MemoryObjectStore(), segment_ms=2 * HOUR,
+                wal_config=wal_config(tmp_path / "wal"))
+            state = ServerState(engine, ServerConfig())
+            client = TestClient(TestServer(build_app(state)))
+            await client.start_server()
+            try:
+                for path in ("/repl/wal/segments", "/repl/wal/read"):
+                    r = await client.get(path)
+                    assert r.status == 501
+                r = await client.get("/repl/status")
+                assert r.status == 200  # status always answers
+                assert (await r.json())["role"] == "none"
+            finally:
+                await client.close()
+                await engine.close()
+
+        run(go())
+
+    def test_config_sections_parse_and_validate(self):
+        from horaedb_tpu.common import Error
+        from horaedb_tpu.server.config import ServerConfig, _dc_from_dict
+
+        cfg = _dc_from_dict(ServerConfig, {
+            "replication": {"enabled": True, "region": 3,
+                            "primary_url": "http://127.0.0.1:5001",
+                            "mirror_dir": "/tmp/mirror",
+                            "lease_ttl": "8s", "renew_interval": "2s"},
+            "rebalance": {"enabled": True, "dry_run": False,
+                          "cooldown": "60s", "max_concurrent_moves": 2},
+        })
+        assert cfg.replication.region == 3
+        assert cfg.replication.lease_ttl.seconds == 8.0
+        assert cfg.rebalance.max_concurrent_moves == 2
+        with pytest.raises(Error):
+            _dc_from_dict(ServerConfig, {"replication": {"bogus": 1}})
+
+    def test_load_config_validations(self, tmp_path):
+        pytest.importorskip("tomllib")
+        from horaedb_tpu.common import Error
+        from horaedb_tpu.server.config import load_config
+
+        p = tmp_path / "cfg.toml"
+        p.write_text('[replication]\nenabled = true\n'
+                     'lease_ttl = "2s"\nrenew_interval = "5s"\n')
+        with pytest.raises(Error, match="renew_interval"):
+            load_config(str(p))
+        p.write_text('[replication]\nenabled = true\n'
+                     'primary_url = "http://x:1"\n')
+        with pytest.raises(Error, match="mirror_dir"):
+            load_config(str(p))
+        p.write_text('[rebalance]\nenabled = true\nskew_ratio = 0.5\n')
+        with pytest.raises(Error, match="skew_ratio"):
+            load_config(str(p))
+
+
+# ---------------------------------------------------------------------------
+# satellite (c): seeded failover chaos.  The fast variant runs a fixed
+# small subset in tier-1; `make chaos` sweeps REPL_SCHEDULES seeded
+# rounds (kill -9 at random points mid-ingest, lease-expiry races,
+# double-failover flapping).
+
+
+async def _chaos_round(tmp_path, rnd, round_idx):
+    """One randomized failover drill: seeded writes with interleaved
+    flushes and follower polls, kill -9 at a random point, promote the
+    mirror, verify zero acked-write loss and exactly-once visibility."""
+    from horaedb_tpu.cluster.replication import WalFollower
+
+    clock = Clock()
+    store = MemoryObjectStore()
+    root = f"chaos{round_idx}"
+    wal_dir = tmp_path / f"p{round_idx}"
+    mirror = tmp_path / f"m{round_idx}"
+    engine = await MetricEngine.open(
+        f"{root}/region_0", store, segment_ms=2 * HOUR,
+        wal_config=wal_config(wal_dir))
+    hub = ReplicationHub(engine)
+    follower = WalFollower(LocalWalSource(hub, "f"), str(mirror),
+                           region=0)
+    acked = {}  # (host, ts) -> last acked value
+    promoted = None
+    try:
+        n_batches = rnd.randrange(2, 7)
+        for b in range(n_batches):
+            rows = [(f"h{rnd.randrange(6)}", T0 + 100 * rnd.randrange(40),
+                     float(rnd.randrange(1000))) for _ in
+                    range(rnd.randrange(1, 12))]
+            # last write to a series+ts wins (OVERWRITE semantics):
+            # dedup within the batch the same way
+            await engine.write([
+                sample("cpu", [("host", h)], ts, v) for h, ts, v in rows])
+            for h, ts, v in rows:
+                acked[(h, ts)] = v
+            if rnd.random() < 0.4:
+                await engine.flush()
+            if rnd.random() < 0.7:
+                await follower.poll_once()
+        # final catch-up poll with probability — a lagging follower
+        # that missed the last batch would NOT be freshest; this drill
+        # always catches up first (lag-aware promotion is asserted via
+        # follower.lag() below)
+        await follower.poll_once()
+        assert follower.lag() == 0
+        hub.close()
+        await follower.close()
+        await kill_engine(engine)
+        engine = None
+
+        mgr = LeaseManager(store, root, clock=clock)
+        promoted, lease = await promote(
+            root, store, 0, mgr, "node-b", str(mirror),
+            wal_config(wal_dir), segment_ms=2 * HOUR)
+        rng = TimeRange.new(T0 - 1, T0 + 100 * 41)
+        tbl = await promoted.query("cpu", [], rng)
+        hosts = tbl.column("tsid").to_pylist()
+        del hosts
+        # exactly-once per (series, ts): no dupes, no losses, last
+        # acked value wins
+        by_host = {}
+        for h in {h for h, _ in acked}:
+            t = await promoted.query("cpu", [("host", h)], rng)
+            pairs = list(zip(t.column("timestamp").to_pylist(),
+                             t.column("value").to_pylist()))
+            assert len(pairs) == len(set(ts for ts, _ in pairs)), \
+                f"duplicate (series, ts) rows on host {h}"
+            by_host[h] = dict(pairs)
+        for (h, ts), v in acked.items():
+            assert by_host[h].get(ts) == v, \
+                f"acked write lost or stale: {h}@{ts}"
+        total = sum(len(d) for d in by_host.values())
+        assert total == len(acked)
+        # the fence holds after failover too: steal the lease, the
+        # promoted primary's next flush must refuse
+        clock.advance(60_000)
+        await mgr.acquire(0, "node-c", ttl_ms=10_000)
+        with pytest.raises(StaleEpochError):
+            await promoted.flush()
+        assert lease.lost
+    finally:
+        if engine is not None:
+            hub.close()
+            await follower.close()
+            await engine.close()
+        if promoted is not None:
+            install_fence(promoted, None)
+            await promoted.close()
+
+
+async def _lease_race_round(rnd):
+    """Seeded lease-expiry race: contenders pile onto an expired lease;
+    at most one wins, epochs stay monotonic, and every loser's fence
+    refuses."""
+    clock = Clock()
+    store = MemoryObjectStore()
+    mgr = LeaseManager(store, "race", clock=clock)
+    a = await mgr.acquire(0, "node-a", ttl_ms=5_000)
+    epoch0 = a.epoch
+    clock.advance(rnd.randrange(5_001, 9_000))
+    contenders = [f"node-{c}" for c in "bcd"[:rnd.randrange(2, 4)]]
+    rnd.shuffle(contenders)
+    results = await asyncio.gather(
+        *(mgr.acquire(0, who, ttl_ms=5_000) for who in contenders),
+        return_exceptions=True)
+    winners = [r for r in results if not isinstance(r, BaseException)]
+    losers = [r for r in results if isinstance(r, BaseException)]
+    assert all(isinstance(e, ReplicationError) for e in losers)
+    # the old holder is fenced no matter who won
+    with pytest.raises(StaleEpochError):
+        await a.check()
+    for w in winners:
+        assert w.epoch > epoch0
+    # the record's holder is exactly one of the winners, and ITS fence
+    # check passes; any other "winner" lost the read-back race
+    rec = await mgr.read(0)
+    assert rec is not None and rec.holder in {w.record.holder
+                                              for w in winners}
+    live = [w for w in winners if w.record.holder == rec.holder
+            and w.epoch == rec.epoch]
+    assert len(live) == 1
+    await live[0].check()
+    for w in winners:
+        if w is not live[0]:
+            with pytest.raises(StaleEpochError):
+                await w.check()
+
+
+async def _double_failover_round(tmp_path, rnd, round_idx):
+    """Flapping drill: primary dies -> B promotes; B dies -> C promotes
+    from B's mirror chain.  Every acked write survives both hops and
+    epochs climb monotonically."""
+    from horaedb_tpu.cluster.replication import WalFollower
+
+    clock = Clock()
+    store = MemoryObjectStore()
+    root = f"flap{round_idx}"
+    a_wal = tmp_path / f"fa{round_idx}"
+    b_mirror = tmp_path / f"fb{round_idx}"
+    c_mirror = tmp_path / f"fc{round_idx}"
+    mgr = LeaseManager(store, root, clock=clock)
+    a = await MetricEngine.open(f"{root}/region_0", store,
+                                segment_ms=2 * HOUR,
+                                wal_config=wal_config(a_wal))
+    b = c = None
+    acked = {}
+    try:
+        rows = [(f"h{i}", T0 + 100 * i, float(rnd.randrange(100)))
+                for i in range(rnd.randrange(3, 10))]
+        await a.write([sample("cpu", [("host", h)], ts, v)
+                       for h, ts, v in rows])
+        acked.update({(h, ts): v for h, ts, v in rows})
+        hub_a = ReplicationHub(a)
+        fb = WalFollower(LocalWalSource(hub_a, "b"), str(b_mirror))
+        await fb.poll_once()
+        assert fb.lag() == 0
+        hub_a.close()
+        await fb.close()
+        await kill_engine(a)
+        a = None
+
+        b, lease_b = await promote(root, store, 0, mgr, "node-b",
+                                   str(b_mirror), wal_config(a_wal),
+                                   segment_ms=2 * HOUR)
+        epoch_b = lease_b.epoch
+        rows2 = [(f"g{i}", T0 + 100 * i + 7, float(rnd.randrange(100)))
+                 for i in range(rnd.randrange(1, 6))]
+        await b.write([sample("cpu", [("host", h)], ts, v)
+                       for h, ts, v in rows2])
+        acked.update({(h, ts): v for h, ts, v in rows2})
+        if rnd.random() < 0.5:
+            await b.flush()
+        hub_b = ReplicationHub(b)
+        fc = WalFollower(LocalWalSource(hub_b, "c"), str(c_mirror))
+        await fc.poll_once()
+        assert fc.lag() == 0
+        hub_b.close()
+        await fc.close()
+        install_fence(b, None)  # the fence object dies with the node
+        await kill_engine(b)
+        b = None
+
+        clock.advance(60_000)  # B's lease expires with it
+        c, lease_c = await promote(root, store, 0, mgr, "node-c",
+                                   str(c_mirror), wal_config(a_wal),
+                                   segment_ms=2 * HOUR)
+        assert lease_c.epoch > epoch_b
+        rng = TimeRange.new(T0 - 1, T0 + 100_000)
+        for (h, ts), v in acked.items():
+            t = await c.query("cpu", [("host", h)], rng)
+            got = dict(zip(t.column("timestamp").to_pylist(),
+                           t.column("value").to_pylist()))
+            assert got.get(ts) == v, f"lost across double failover: {h}"
+    finally:
+        if a is not None:
+            await a.close()
+        if b is not None:
+            install_fence(b, None)
+            await b.close()
+        if c is not None:
+            install_fence(c, None)
+            await c.close()
+
+
+class TestFailoverChaosFast:
+    """Tier-1 subset: two fixed-seed rounds of each drill."""
+
+    def test_failover_round_fast(self, tmp_path):
+        async def go():
+            for i in range(2):
+                await _chaos_round(tmp_path, random.Random(REPL_SEED + i),
+                                   i)
+
+        run(go())
+
+    def test_lease_race_fast(self):
+        async def go():
+            for i in range(2):
+                await _lease_race_round(random.Random(REPL_SEED + i))
+
+        run(go())
+
+    def test_double_failover_fast(self, tmp_path):
+        async def go():
+            await _double_failover_round(
+                tmp_path, random.Random(REPL_SEED), 0)
+
+        run(go())
+
+
+@pytest.mark.slow
+class TestFailoverChaos:
+    """`make chaos`: REPL_SCHEDULES seeded rounds per drill."""
+
+    def test_failover_chaos(self, tmp_path):
+        async def go():
+            for i in range(REPL_SCHEDULES):
+                await _chaos_round(tmp_path,
+                                   random.Random(REPL_SEED + 1000 + i), i)
+
+        run(go())
+
+    def test_lease_race_chaos(self):
+        async def go():
+            for i in range(max(REPL_SCHEDULES * 4, 20)):
+                await _lease_race_round(
+                    random.Random(REPL_SEED + 2000 + i))
+
+        run(go())
+
+    def test_double_failover_flapping(self, tmp_path):
+        async def go():
+            for i in range(max(REPL_SCHEDULES // 2, 2)):
+                await _double_failover_round(
+                    tmp_path, random.Random(REPL_SEED + 3000 + i), i)
+
+        run(go())

@@ -70,6 +70,14 @@ Checks, all hard failures:
     A budget nobody ledgers is RSS nobody can attribute, which is how
     the 1B-row ladder's "169 GiB projected" stays hand math
     (docs/observability.md, memory plane)
+  - replication fencing discipline under horaedb_tpu/wal/ and
+    horaedb_tpu/cluster/: a manifest/SST commit call
+    (write_stamped / _persist_stamped / manifest.add_file) whose
+    enclosing function never references a fence is an error — on the
+    replicated path every commit revalidates the lease epoch first
+    (cluster/replication.py Lease.check), or a primary that lost its
+    lease mid-flush can still publish files the NEW primary's replay
+    doesn't know about (docs/robustness.md, split-brain domain)
   - combine grid discipline under horaedb_tpu/: allocating a dense
     `(groups, num_buckets)`-shaped array (np.zeros/full/empty/ones
     with a 2-tuple shape whose second element is named like a bucket
@@ -627,6 +635,9 @@ def lint_file(path: pathlib.Path) -> list[str]:
                     "(docs/observability.md)")
     if "wal" in path.parts and "horaedb_tpu" in path.parts:
         problems.extend(_lint_wal_module(path, tree, lines))
+    if ("horaedb_tpu" in path.parts
+            and ("wal" in path.parts or "cluster" in path.parts)):
+        problems.extend(_lint_fencing(path, tree, lines))
     if ("horaedb_tpu" in path.parts and "server" in path.parts
             and path.name == "main.py"):
         problems.extend(_lint_server_routes(path, tree, lines))
@@ -676,6 +687,54 @@ def _lint_wal_module(path: pathlib.Path, tree: ast.AST,
             f"{path}:{write_calls[0]}: file write in wal/ with no "
             "os.fsync anywhere in the module — an unfsynced WAL write "
             "must never be an ack point")
+    return problems
+
+
+# manifest/SST commit surface on the replicated path: any of these
+# called under horaedb_tpu/wal/ or horaedb_tpu/cluster/ publishes
+# files other nodes will read, so the enclosing function must
+# revalidate the lease epoch (reference something fence-named) before
+# committing — a stale-epoch primary must never commit
+_FENCED_COMMIT_METHODS = {"write_stamped", "_persist_stamped", "add_file"}
+
+
+def _lint_fencing(path: pathlib.Path, tree: ast.AST,
+                  lines: list[str]) -> list[str]:
+    """Replication fencing discipline (docs/robustness.md, split-brain
+    domain): under wal/ and cluster/, a function that calls a
+    manifest/SST commit method without referencing a fence anywhere in
+    its body is a commit site a stale-epoch primary could still reach
+    after losing its lease.  The fence seam is duck-typed
+    (IngestStorage.fence -> Lease.check), so 'references a fence' is
+    the name-level contract the AST can see."""
+    problems: list[str] = []
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        commit_calls: list[int] = []
+        has_fence_ref = False
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) and "fence" in node.id.lower():
+                has_fence_ref = True
+            elif (isinstance(node, ast.Attribute)
+                    and "fence" in node.attr.lower()):
+                has_fence_ref = True
+            elif (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _FENCED_COMMIT_METHODS):
+                commit_calls.append(node.lineno)
+        if has_fence_ref or not commit_calls:
+            continue
+        for lineno in commit_calls:
+            src = lines[lineno - 1] if lineno <= len(lines) else ""
+            if "noqa" in src:
+                continue
+            problems.append(
+                f"{path}:{lineno}: unfenced manifest/SST commit in "
+                f"{fn.name}() under the replicated path — revalidate "
+                "the lease epoch first (await self.fence.check(); "
+                "cluster/replication.py), or a primary that lost its "
+                "lease mid-flush can still publish files")
     return problems
 
 
@@ -790,6 +849,10 @@ _BUDGET_FIELD_EXEMPT = {
     # sizing knobs for on-disk files / a transient commit queue — the
     # resident WAL bytes are the wal_backlog account
     "segment_bytes", "max_group_bytes",
+    # [replication] per-read-RPC byte cap for WAL tail shipping: a
+    # transient wire chunk (one aiohttp response body), appended to the
+    # mirror file and dropped — nothing host-resident to ledger
+    "max_batch_bytes",
     # ops.encode.DeviceBatch per-window memo state counter, not a
     # config budget: charged inside the scan_cache account's
     # windows_nbytes memo allowance
