@@ -122,6 +122,14 @@ class ReplicationConfig:
     max_batch_bytes: int = 4 << 20
     rpc_timeout: ReadableDuration = field(
         default_factory=lambda: ReadableDuration.from_secs(10))
+    # follower liveness horizon for RETENTION: a follower silent (no
+    # poll, no ack) longer than this stops pinning sealed segments — a
+    # follower that died for good must not grow primary disk without
+    # bound.  It is never deregistered: its next poll refreshes
+    # liveness and it resyncs anything truncated meanwhile from the
+    # shared SSTs (flushed_seqs) + a fresh listing.
+    follower_ttl: ReadableDuration = field(
+        default_factory=lambda: ReadableDuration.from_secs(60))
 
 
 @dataclass
@@ -358,14 +366,23 @@ class Lease:
             self._renew_task = None
 
     async def release(self) -> None:
-        """Voluntary handoff: stop renewing and delete the record if it
-        is still ours.  The epoch gauge child is removed (zeroing
-        discipline) — a released region has no current epoch."""
+        """Voluntary handoff: stop renewing and, if the record is still
+        ours, replace it with an already-expired TOMBSTONE that keeps
+        the epoch (holder cleared, expires_at_ms=0).  Deleting instead
+        would restart the next acquire at epoch 1, breaking the strict
+        monotonicity every epoch consumer is promised ('always greater
+        than every epoch that ever committed') — the tombstone makes a
+        release/re-acquire cycle continue the sequence.  The epoch
+        gauge child is removed (zeroing discipline) — a released
+        region has no current holder."""
         await self.stop_renewal()
         cur = await self.manager.read(self.region)
         if (cur is not None and cur.epoch == self.epoch
                 and cur.holder == self.record.holder):
-            await self.manager.store.delete(self.manager._path(self.region))
+            tomb = LeaseRecord(region=self.region, holder="",
+                               epoch=self.epoch, expires_at_ms=0)
+            await self.manager.store.put(
+                self.manager._path(self.region), tomb.to_json())
         self.lost = True
         _LEASE_EPOCH.remove(region=str(self.region))
 
@@ -395,13 +412,25 @@ class ReplicationHub:
     and flushed rows live in the SHARED SSTs a follower adopts; the
     hook is what keeps the *acked high-watermark* meaningful, so a
     promotion knows exactly how fresh its mirror is.
+
+    Retention only honors LIVE followers: one silent past
+    `follower_ttl` (no poll, no ack) stops pinning segments — it
+    registered on its first poll with no deregistration path, so
+    without a liveness horizon a follower dead for good would block
+    WAL truncation forever.  A stale follower that comes back
+    refreshes its liveness on the next poll and resyncs from the
+    listing + the shared-SST floor.
     """
 
-    def __init__(self, engine, config: Optional[ReplicationConfig] = None):
+    def __init__(self, engine, config: Optional[ReplicationConfig] = None,
+                 clock: Callable[[], int] = now_ms):
         self.engine = engine
         self.config = config or ReplicationConfig()
+        self._clock = clock
         # follower -> {log -> highest acked (durably mirrored) seq}
         self._acks: dict[str, dict[str, int]] = {}
+        # follower -> last poll/ack wall ms (liveness for retention)
+        self._last_seen: dict[str, int] = {}
         for name, wal in self._wals().items():
             wal.retention = self._retention_for(name)
 
@@ -409,18 +438,26 @@ class ReplicationHub:
         return {name: t.wal for name, t in self.engine.tables.items()
                 if getattr(t, "wal", None) is not None}
 
+    def _live(self, follower_id: str) -> bool:
+        ttl_ms = int(self.config.follower_ttl.seconds * 1000)
+        last = self._last_seen.get(follower_id, 0)
+        return self._clock() - last <= ttl_ms
+
     def _retention_for(self, log: str):
         def allow_delete(segment_id: int, max_seq: int) -> bool:
             del segment_id
             return all(acks.get(log, 0) >= max_seq
-                       for acks in self._acks.values())
+                       for fid, acks in self._acks.items()
+                       if self._live(fid))
         return allow_delete
 
     def register_follower(self, follower_id: str) -> None:
         self._acks.setdefault(follower_id, {})
+        self._last_seen[follower_id] = self._clock()
 
     def ack(self, follower_id: str, acks: dict[str, int]) -> None:
         mine = self._acks.setdefault(follower_id, {})
+        self._last_seen[follower_id] = self._clock()
         for log, seq in acks.items():
             mine[log] = max(mine.get(log, 0), int(seq))
 
@@ -450,25 +487,49 @@ class ReplicationHub:
         return await wal.read_tail(segment_id, offset, max_bytes)
 
     def status(self) -> dict:
-        """/repl/status + /debug/tasks surface."""
+        """/repl/status + /debug/tasks surface.  `retention_held_by`
+        names the LIVE followers currently pinning otherwise-deletable
+        sealed segments (fully SST-covered, un-acked, follower not yet
+        past the liveness TTL) — the stuck-retention signal an
+        operator greps for when primary disk grows; `stale` followers
+        no longer pin anything."""
         wals = self._wals()
         hw = {name: wal.high_watermark for name, wal in wals.items()}
         flushed = {name: wal.flushed_seq for name, wal in wals.items()}
+        # per log, the newest seq in a sealed + fully-flushed segment:
+        # deletable but for follower acks (flushed_seq is a contiguous
+        # prefix, so max_seq <= flushed_seq covers the whole segment)
+        blockable = {
+            name: max((s["max_seq"] for s in wal.segments()
+                       if s["sealed"]
+                       and s["max_seq"] <= wal.flushed_seq), default=0)
+            for name, wal in wals.items()}
+        followers = {}
+        held_by = []
+        for fid, acks in self._acks.items():
+            lag = max((hw.get(log, 0) - max(acks.get(log, 0),
+                                            flushed.get(log, 0))
+                       for log in hw), default=0)
+            live = self._live(fid)
+            followers[fid] = {"acks": dict(acks), "lag_seqs": lag,
+                              "stale": not live,
+                              "last_seen_ms": self._last_seen.get(fid, 0)}
+            if live and any(acks.get(log, 0) < m
+                            for log, m in blockable.items() if m):
+                held_by.append(fid)
         return {
             "high_watermarks": hw,
-            "followers": {
-                fid: {"acks": dict(acks),
-                      "lag_seqs": max(
-                          (hw.get(log, 0) - max(acks.get(log, 0),
-                                                flushed.get(log, 0))
-                           for log in hw), default=0)}
-                for fid, acks in self._acks.items()},
+            "followers": followers,
+            "retention_held_by": sorted(held_by),
+            "follower_ttl_ms": int(
+                self.config.follower_ttl.seconds * 1000),
         }
 
     def close(self) -> None:
         for wal in self._wals().values():
             wal.retention = None
         self._acks = {}
+        self._last_seen = {}
 
 
 # ---- wal sources (the follower's view of a primary) -------------------------

@@ -1458,6 +1458,12 @@ def build_app(state: ServerState) -> web.Application:
         except (KeyError, ValueError) as e:
             return web.json_response({"error": f"bad request: {e}"},
                                      status=400)
+        if offset < 0 or max_bytes <= 0:
+            # range-check here: out-of-range values trip Wal.read_tail's
+            # internal ensure(), which would surface as a 500
+            return web.json_response(
+                {"error": "bad request: offset must be >= 0 and "
+                          "max_bytes > 0"}, status=400)
         out = await state.repl.read_tail(log, segment, offset, max_bytes)
         if out is None:
             # segment truncated (or unknown log): the follower resyncs

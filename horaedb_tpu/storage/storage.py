@@ -236,7 +236,8 @@ class CloudObjectStorage(TimeMergeStorage):
         return result
 
     async def write_stamped(self, table: pa.Table,
-                            time_range: TimeRange) -> WriteResult:
+                            time_range: TimeRange,
+                            pre_commit=None) -> WriteResult:
         """Memtable-flush write path (wal/ingest.py): rows arrive with
         `__seq__` already filled per row (each entry's original write
         seq).  Seqs are PRESERVED — restamping would let a flush racing
@@ -244,6 +245,13 @@ class CloudObjectStorage(TimeMergeStorage):
         SST is sorted by (PK, __seq__) and dedup keeps working off the
         original write order, exactly like a compaction output (which
         also carries heterogeneous per-row seqs).
+
+        `pre_commit` (an async callable) runs AFTER the SST/sidecar
+        puts and immediately before the manifest add — the replication
+        fencing seam: the SST upload can take a whole lease TTL, so
+        ownership must be revalidated at the publish point, not just
+        when the flush started.  A raise leaves an orphan SST object
+        but no manifest entry — invisible to every reader.
         """
         ensure(self.manifest is not None, "storage not opened")
         ensure(table.schema.names == self._schema.arrow_schema.names,
@@ -258,10 +266,12 @@ class CloudObjectStorage(TimeMergeStorage):
             return ordered.combine_chunks().to_batches()[0]
 
         stamped = await self.runtimes.run("sst", prep)
-        return await self._persist_stamped(file_id, stamped, time_range)
+        return await self._persist_stamped(file_id, stamped, time_range,
+                                           pre_commit=pre_commit)
 
     async def _persist_stamped(self, file_id: int, stamped: pa.RecordBatch,
-                               time_range: TimeRange) -> WriteResult:
+                               time_range: TimeRange,
+                               pre_commit=None) -> WriteResult:
         """THE persist tail shared by the direct write path and the WAL
         flush path (write_stamped): SST put overlapped with the sidecar
         put, which completes BEFORE the manifest add — readers never
@@ -275,6 +285,8 @@ class CloudObjectStorage(TimeMergeStorage):
                                  self.config.write, self._schema,
                                  runtimes=self.runtimes),
             self._write_sidecar(file_id, stamped))
+        if pre_commit is not None:
+            await pre_commit()
         meta = FileMeta(max_sequence=file_id, num_rows=stamped.num_rows,
                         size=size, time_range=time_range)
         await self.manifest.add_file(file_id, meta)

@@ -385,20 +385,29 @@ class IngestStorage(TimeMergeStorage):
         try:
             table, rng, seqs = mt.drain(self.inner.schema())
             if table is not None:
-                if self.fence is not None:
-                    # fencing point: the lease must still be ours AT
-                    # the commit attempt, not just when the flush was
-                    # scheduled — a stale-epoch holder fails here with
-                    # the rows intact (re-inserted below) for the new
-                    # primary's replay to cover
-                    await self.fence.check()
+                fence = self.fence
+                if fence is not None:
+                    # cheap pre-flight: fail before paying the SST
+                    # upload when the lease is ALREADY gone.  The real
+                    # fencing point is pre_commit below — the upload
+                    # can run seconds-to-minutes (a whole lease TTL),
+                    # so the lease is revalidated again immediately
+                    # before the manifest publish; a stale-epoch
+                    # holder fails either way with the rows intact
+                    # (re-inserted below) for the new primary's
+                    # replay to cover
+                    await fence.check()
                 if self._on_op is not None:
                     self._on_op("flush")
                 # flushes run seconds-to-minutes on big memtables:
                 # the wide buckets keep them out of the +Inf bin
                 with span("memtable_flush", buckets=WIDE_BUCKETS,
                           segment=seg, rows=mt.rows):
-                    await self.inner.write_stamped(table, rng)
+                    if fence is not None:
+                        await self.inner.write_stamped(
+                            table, rng, pre_commit=fence.check)
+                    else:
+                        await self.inner.write_stamped(table, rng)
         except BaseException:
             # the rows are acked: put them back so reads keep
             # serving them; the WAL still covers them for replay

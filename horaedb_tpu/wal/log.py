@@ -472,13 +472,30 @@ class Wal:
         """Record that these seqs are covered by a committed SST; their
         segments become truncatable once fully drained and sealed."""
         remaining = set(seqs)
-        if remaining:
-            self._flushed_seq = max(self._flushed_seq, max(remaining))
         for seg in self._sealed.values():
             if seg.pending:
                 seg.pending -= remaining
         if self._active is not None and self._active.pending:
             self._active.pending -= remaining
+        self._recompute_flushed()
+
+    def _recompute_flushed(self) -> None:
+        """Advance `_flushed_seq` to the contiguous SST-covered PREFIX:
+        the highest seq with every committed seq at or below it covered
+        by a committed SST.  Memtables are per time-segment and flush
+        out of order while sharing this log with interleaved seqs, so a
+        max over any one flushed batch would overshoot — reporting seqs
+        flushed while older ones are still only WAL-resident, which a
+        follower would read as \"caught up\" over rows a failover would
+        lose.  Derived from the pending sets: anything below every
+        still-pending seq has been flushed out of them."""
+        floor = self._max_seq
+        for seg in self._sealed.values():
+            if seg.pending:
+                floor = min(floor, min(seg.pending) - 1)
+        if self._active is not None and self._active.pending:
+            floor = min(floor, min(self._active.pending) - 1)
+        self._flushed_seq = max(self._flushed_seq, floor)
 
     async def truncate(self) -> int:
         """Delete sealed, fully-flushed segments.  SST + manifest commit
@@ -547,10 +564,11 @@ class Wal:
 
     @property
     def flushed_seq(self) -> int:
-        """Highest seq covered by a committed SST (0 = none).  Seqs at
-        or below this are durable in the shared object store, so a
-        follower counts them as caught up without shipping — their
-        segments may already be truncated."""
+        """The contiguous SST-covered prefix (0 = none): EVERY
+        committed seq at or below this is covered by a committed SST,
+        so a follower counts them all as caught up without shipping —
+        their segments may already be truncated.  Out-of-order segment
+        flushes do not advance it past a still-WAL-resident seq."""
         return self._flushed_seq
 
     def segments(self) -> list[dict]:

@@ -27,6 +27,7 @@ from horaedb_tpu.cluster.replication import (
     LocalWalSource,
     RebalanceConfig,
     RebalanceExecutor,
+    ReplicationConfig,
     ReplicationError,
     ReplicationHub,
     StaleEpochError,
@@ -213,6 +214,31 @@ class TestWalIntrospection:
         assert (aligned, count) == (len(rec), 1)
         assert verify_frames(b"") == (0, 0, 0)
 
+    def test_flushed_seq_is_contiguous_prefix(self, tmp_path):
+        """Memtables are per time-segment and flush OUT OF ORDER over
+        one shared WAL with interleaved seqs: flushing the newer batch
+        (2, 4) must not report flushed_seq=4 while 1 and 3 are still
+        only WAL-resident — a follower would count them caught up and
+        a failover would lose them."""
+        async def go():
+            cfg = wal_config(tmp_path)
+            wal = Wal(str(tmp_path), cfg)
+            wal.replay()
+            wal.start()
+            b = batch([("a", 1, 1.0)])
+            for seq in (1, 2, 3, 4):
+                await wal.append(seq, TimeRange.new(1, 2), b)
+            assert wal.flushed_seq == 0
+            wal.mark_flushed([2, 4])  # newer segment flushed first
+            assert wal.flushed_seq == 0  # 1 and 3 still WAL-only
+            wal.mark_flushed([1])
+            assert wal.flushed_seq == 2  # 3 still pending
+            wal.mark_flushed([3])
+            assert wal.flushed_seq == 4  # prefix complete
+            await wal.close()
+
+        run(go())
+
     def test_retention_hook_blocks_truncation(self, tmp_path):
         async def go():
             cfg = wal_config(tmp_path, segment_bytes=1)
@@ -258,8 +284,16 @@ class TestLease:
             clock.advance(20_000)
             b = await mgr.acquire(7, "node-b", ttl_ms=10_000)
             assert b.epoch == 3
+            # release leaves an expired TOMBSTONE, not a deletion: the
+            # epoch sequence must survive a release/re-acquire cycle
+            # (strict monotonicity across everything that ever
+            # committed), so the next holder continues it
             await b.release()
-            assert await mgr.read(7) is None
+            tomb = await mgr.read(7)
+            assert tomb is not None and tomb.epoch == 3
+            assert tomb.holder == "" and tomb.expires_at_ms == 0
+            c = await mgr.acquire(7, "node-c", ttl_ms=10_000)
+            assert c.epoch == 4
 
         run(go())
 
@@ -327,6 +361,58 @@ class TestLease:
                 stats = await engine.stats()
                 assert stats["ssts"] == ssts_before  # nothing committed
                 # acked rows remain served (re-inserted post-failure)
+                rng = TimeRange.new(T0, T0 + HOUR)
+                tbl = await engine.query("cpu", [("host", "h1")], rng)
+                assert sorted(tbl.column("value").to_pylist()) == \
+                    [0.0, 1.0, 2.0, 3.0]
+            finally:
+                install_fence(engine, None)
+                await engine.close()
+
+        run(go())
+
+    def test_lease_stolen_mid_sst_upload_cannot_commit(self, tmp_path):
+        """The worst-case split-brain window: the lease is stolen
+        DURING the SST upload (which can run a whole lease TTL), after
+        the flush's pre-flight fence check already passed.  The
+        publish-point re-check (write_stamped's pre_commit) must still
+        refuse — the SST object may exist but no manifest entry ever
+        appears, so no reader sees it."""
+        async def go():
+            clock = Clock()
+            hooks = {"steal": None}
+
+            class StealingStore(MemoryObjectStore):
+                async def put(self, path, data):
+                    if path.endswith(".sst") and hooks["steal"]:
+                        steal, hooks["steal"] = hooks["steal"], None
+                        await steal()
+                    await super().put(path, data)
+
+            store = StealingStore()
+            engine = await MetricEngine.open(
+                "repl/region_9", store, segment_ms=2 * HOUR,
+                wal_config=wal_config(tmp_path / "wal"))
+            try:
+                mgr = LeaseManager(store, "repl", clock=clock)
+                lease = await mgr.acquire(9, "node-a", ttl_ms=10_000)
+                lease.grant_ttl_ms(10_000)
+                install_fence(engine, lease)
+                await engine.write([
+                    sample("cpu", [("host", "h1")], T0 + i, float(i))
+                    for i in range(4)])
+                ssts_before = (await engine.stats())["ssts"]
+
+                async def steal():
+                    clock.advance(11_000)
+                    await mgr.acquire(9, "node-b", ttl_ms=10_000)
+
+                hooks["steal"] = steal
+                with pytest.raises(StaleEpochError):
+                    await engine.flush()
+                stats = await engine.stats()
+                assert stats["ssts"] == ssts_before  # nothing published
+                # acked rows stay served for the new primary's replay
                 rng = TimeRange.new(T0, T0 + HOUR)
                 tbl = await engine.query("cpu", [("host", "h1")], rng)
                 assert sorted(tbl.column("value").to_pylist()) == \
@@ -494,6 +580,55 @@ class TestShipAndPromote:
                     for s in segs if s["sealed"])
                 assert remaining == 0
                 await follower.close()
+                hub.close()
+            finally:
+                await engine.close()
+
+        run(go())
+
+    def test_dead_follower_stops_pinning_retention(self, tmp_path):
+        """A follower that registered once and then died for good must
+        not block WAL truncation forever: past `follower_ttl` its acks
+        drop out of the retention quorum, so primary disk stays
+        bounded, and /repl/status marks it stale.  A comeback poll
+        re-arms retention."""
+        async def go():
+            clock = Clock()
+            store = MemoryObjectStore()
+            engine = await MetricEngine.open(
+                "repl/region_2", store, segment_ms=2 * HOUR,
+                wal_config=wal_config(tmp_path / "wal", segment_bytes=1))
+            try:
+                cfg = ReplicationConfig(
+                    follower_ttl=ReadableDuration.from_secs(30))
+                hub = ReplicationHub(engine, cfg, clock=clock)
+                hub.register_follower("f1")  # ...then dies for good
+                await engine.write([
+                    sample("cpu", [("host", "a")], T0 + i, float(i))
+                    for i in range(4)])
+                await engine.flush()
+
+                def sealed_count():
+                    return sum(1 for segs in hub.snapshot()["logs"].values()
+                               for s in segs if s["sealed"])
+
+                # still inside the TTL: retention pins sealed segments
+                assert sealed_count() > 0
+                status = hub.status()
+                assert status["followers"]["f1"]["stale"] is False
+                assert status["retention_held_by"] == ["f1"]
+                # past the TTL: the dead follower stops pinning
+                clock.advance(31_000)
+                status = hub.status()
+                assert status["followers"]["f1"]["stale"] is True
+                assert status["retention_held_by"] == []
+                for wal in (t.wal for t in engine.tables.values()
+                            if getattr(t, "wal", None) is not None):
+                    await wal.truncate()
+                assert sealed_count() == 0
+                # a comeback poll refreshes liveness (and retention)
+                hub.snapshot(follower_id="f1")
+                assert hub.status()["followers"]["f1"]["stale"] is False
                 hub.close()
             finally:
                 await engine.close()
@@ -798,6 +933,13 @@ class TestServerRepl:
                     "max_bytes": "64"})
                 assert r.status == 200
                 assert r.headers["X-Wal-Gone"] == "1"
+                # out-of-range offset/max_bytes answer 400, not a 500
+                # out of Wal.read_tail's internal ensure()
+                for bad in ({"offset": "-1", "max_bytes": "64"},
+                            {"offset": "0", "max_bytes": "0"}):
+                    r = await client.get("/repl/wal/read", params={
+                        "log": log, "segment": str(seg["id"]), **bad})
+                    assert r.status == 400
                 r = await client.post("/repl/wal/ack", json={
                     "follower": "f1", "acks": {log: max_seq}})
                 assert r.status == 200
