@@ -42,6 +42,9 @@ MESH_SCHEDULES ?= 12
 REPL_SEED ?= 1337
 REPL_SCHEDULES ?= 10
 
+FAILOVER_SEED ?= 1337
+FAILOVER_SCHEDULES ?= 5
+
 chaos:
 	TORTURE_SEED=$(TORTURE_SEED) TORTURE_SCHEDULES=$(TORTURE_SCHEDULES) \
 	WAL_TORTURE_SEED=$(WAL_TORTURE_SEED) \
@@ -64,6 +67,8 @@ chaos:
 	MESH_SCHEDULES=$(MESH_SCHEDULES) \
 	REPL_SEED=$(REPL_SEED) \
 	REPL_SCHEDULES=$(REPL_SCHEDULES) \
+	FAILOVER_SEED=$(FAILOVER_SEED) \
+	FAILOVER_SCHEDULES=$(FAILOVER_SCHEDULES) \
 	python -m pytest tests/test_fault_injection.py tests/test_torture.py \
 	tests/test_objstore_middleware.py tests/test_wal.py \
 	tests/test_scan_cache.py tests/test_rollup.py \

@@ -537,7 +537,7 @@ def main() -> None:
     try:
         config = int(os.environ.get("BENCH_CONFIG", 1))
     except ValueError:
-        sys.exit(f"BENCH_CONFIG must be 0-20, got "
+        sys.exit(f"BENCH_CONFIG must be 0-21, got "
                  f"{os.environ.get('BENCH_CONFIG')!r}")
 
     ensure_responsive_backend()
@@ -553,7 +553,7 @@ def main() -> None:
         from horaedb_tpu.bench.suite import RUNNERS
 
         if config not in RUNNERS:
-            sys.exit(f"BENCH_CONFIG must be 0-20, got {config}")
+            sys.exit(f"BENCH_CONFIG must be 0-21, got {config}")
         result = RUNNERS[config](rows, iters)
     # a config's own backend/fallback labels win (config 6 is pure host
     # work and must never read as a device number)

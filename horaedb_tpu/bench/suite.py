@@ -3959,7 +3959,9 @@ def run_config20(rows: int, iters: int) -> dict:
             while True:
                 attempts += 1
                 try:
-                    engine2, lease2 = await promote(
+                    # config 21 is the self-driving variant; this
+                    # manual retry loop is the CONTROL leg
+                    engine2, lease2 = await promote(  # noqa: control leg
                         "metrics", store, 0, mgr, "bench-follower",
                         mirror_dir, wal_template,
                         segment_ms=segment_ms,
@@ -4081,12 +4083,378 @@ def run_config20(rows: int, iters: int) -> dict:
     }
 
 
+def run_config21(rows: int, iters: int) -> dict:
+    """Self-driving failover SLO harness (ISSUE 17): the config-20
+    drill with the promotion decision moved INTO the system.  The
+    harness only kills — it never calls promote().  A StandbyMonitor
+    tails the primary's lease record; when the lease sits expired past
+    the jittered grace window, the monitor runs the election itself
+    (fitness publish, sibling check, lease acquire at a higher epoch),
+    replays its mirror, and the on_promoted hook brings up the new
+    serving node.  Config 20 is the CONTROL leg (manual promote retry
+    loop); the delta between the two failover_ms values is the price
+    of self-driving detection + election.
+
+    Recorded: failover_ms (kill -> promoted node serving — detection,
+    grace, election, replay, server start), acked_write_loss (MUST be
+    0), election attempts/outcome, and bar_failover_bound: failover_ms
+    must stay under lease TTL + the worst-case grace window + a fixed
+    slack for check ticks, fitness wait, replay, and listener start."""
+    import os
+    import random as random_mod
+    import tempfile
+
+    import aiohttp
+    from aiohttp import web
+    import pyarrow as pa
+
+    from horaedb_tpu.cluster.replication import (FailoverConfig,
+                                                 LeaseManager,
+                                                 LocalWalSource,
+                                                 ReplicationConfig,
+                                                 ReplicationError,
+                                                 StandbyMonitor,
+                                                 WalFollower,
+                                                 install_fence)
+    from horaedb_tpu.metric_engine import MetricEngine
+    from horaedb_tpu.objstore import FaultInjectingStore, MemoryObjectStore
+    from horaedb_tpu.server.config import ReadableDuration, ServerConfig
+    from horaedb_tpu.server.main import ServerState, build_app
+    from horaedb_tpu.storage.types import TimeRange
+    from horaedb_tpu.wal.config import WalConfig
+
+    lat_s = float(os.environ.get("BENCH_STORE_LATENCY_MS", "20")) / 1e3
+    seed = int(os.environ.get("FAILOVER_BENCH_SEED",
+                              os.environ.get("FAILOVER_SEED", "21")))
+    segment_ms = 2 * 3600 * 1000
+    T0 = (1_700_000_000_000 // segment_ms) * segment_ms
+    leg_seconds = max(4.0, min(30.0, float(iters)))
+    kill_at = leg_seconds / 2.0
+    lease_ttl_ms = 2_000
+    grace_ms = 500
+    jitter = 0.5
+    n_fix = min(max(20_000, rows), 200_000)
+    hosts = 50
+    span = 3_600_000
+    TW0 = T0 + 3 * segment_ms
+    dash_q = {"metric": "app", "filters": {}, "start": T0,
+              "end": T0 + span, "bucket_ms": 300_000}
+
+    def write_req(i: int) -> dict:
+        return {"samples": [
+            {"name": "ingest", "labels": {"host": f"w{i % 8:02d}"},
+             "timestamp": TW0 + i * 1000, "value": float(i)}]}
+
+    def schedule(rng):
+        events = []
+
+        def poisson(rate, make):
+            t = 0.0
+            for i in range(int(leg_seconds * rate)):
+                t += rng.expovariate(rate)
+                events.append((t,) + make(i))
+
+        poisson(5.0, lambda i: ("/query", dash_q, -1))
+        poisson(10.0, lambda i: ("/write", write_req(i), i))
+        events.sort(key=lambda e: e[0])
+        return events
+
+    async def start_server(state):
+        app = build_app(state)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = runner.addresses[0][1]
+        return runner, f"http://127.0.0.1:{port}"
+
+    async def go():
+        store = FaultInjectingStore(MemoryObjectStore(), seed=seed,
+                                    latency_range=(lat_s, lat_s))
+        wal_dir = tempfile.mkdtemp(prefix="failover-bench-wal-")
+        mirror_dir = tempfile.mkdtemp(prefix="failover-bench-mirror-")
+        rng_np = np.random.default_rng(seed)
+        engine = await MetricEngine.open("metrics/region_0", store,
+                                         segment_ms=segment_ms)
+        per_host = n_fix // hosts
+        ts = T0 + np.repeat(
+            np.arange(per_host, dtype=np.int64)
+            * max(1, span // max(per_host, 1)), hosts)
+        ids = np.tile(np.arange(hosts, dtype=np.int32), per_host)
+        names = pa.array([f"host_{i:03d}" for i in range(hosts)])
+        await engine.write_arrow("cpu", ["host"], pa.record_batch({
+            "host": pa.DictionaryArray.from_arrays(pa.array(ids), names),
+            "timestamp": pa.array(ts, type=pa.int64()),
+            "value": pa.array(rng_np.random(len(ts)), type=pa.float64()),
+        }))
+        m = 20 * 360
+        await engine.write_arrow("app", ["host"], pa.record_batch({
+            "host": pa.array([f"app_{i % 20:02d}" for i in range(m)]),
+            "timestamp": pa.array(
+                T0 + np.arange(m, dtype=np.int64) * 10_000 % span,
+                type=pa.int64()),
+            "value": pa.array(rng_np.random(m), type=pa.float64()),
+        }))
+        await engine.close()
+
+        wal_template = WalConfig(enabled=True, dir=wal_dir)
+        engine = await MetricEngine.open(
+            "metrics/region_0", store, segment_ms=segment_ms,
+            wal_config=wal_template)
+        cfg = ServerConfig()
+        cfg.replication.enabled = True
+        cfg.replication.region = 0
+        cfg.replication.holder = "bench-primary"
+        cfg.replication.lease_ttl = ReadableDuration.from_millis(
+            lease_ttl_ms)
+        cfg.replication.renew_interval = ReadableDuration.from_millis(500)
+        state = ServerState(engine, cfg)
+        await state.start_replication(store)
+        runner, base = await start_server(state)
+        # the standby tails the primary's DURABLE log plane in-process
+        # (the Taurus split: the log outlives the compute that wrote it)
+        follower = WalFollower(
+            LocalWalSource(state.repl, "bench-standby"), mirror_dir,
+            ReplicationConfig(
+                poll_interval=ReadableDuration.from_millis(50)),
+            region=0)
+
+        target = {"base": base}
+        lat: dict = {}
+        fail: dict = {}
+        session = aiohttp.ClientSession(
+            connector=aiohttp.TCPConnector(limit=0),
+            timeout=aiohttp.ClientTimeout(total=10))
+        acked: set = set()
+        t_start = time.perf_counter()
+        engine2 = lease2 = runner2 = None
+
+        async def on_promoted(engine_p, lease_p):
+            # the takeover hook IS the failover-time finish line: the
+            # monitor won the election and replayed its mirror; bring
+            # up the serving node and flip the routing target
+            nonlocal engine2, lease2, runner2
+            engine2, lease2 = engine_p, lease_p
+            lease_p.start_renewal(2.0, 10_000)
+            state2 = ServerState(engine_p, ServerConfig())
+            runner2, base2 = await start_server(state2)
+            target["base"] = base2
+            fail["failover_ms"] = round(
+                (time.perf_counter() - fail["_t_kill"]) * 1e3, 1)
+            fail["epoch"] = lease_p.epoch
+
+        monitor = StandbyMonitor(
+            follower, LeaseManager(store, "metrics"), 0,
+            "bench-standby",
+            FailoverConfig(
+                enabled=True,
+                grace=ReadableDuration.from_millis(grace_ms),
+                jitter=jitter,
+                check_interval=ReadableDuration.from_millis(100),
+                fitness_wait=ReadableDuration.from_millis(100),
+                cooldown=ReadableDuration.from_millis(1000)),
+            wal_template, segment_ms=segment_ms, lease_ttl_ms=10_000,
+            on_promoted=on_promoted)
+        monitor.start()
+
+        # the steady-state ship loop is the harness's (serialized
+        # against the kill-time drain; the monitor only polls inside
+        # its own election)
+        stop_ship = asyncio.Event()
+
+        async def shipper():
+            while not stop_ship.is_set():
+                try:
+                    await follower.poll_once()
+                except ReplicationError:
+                    return
+                await asyncio.sleep(0.05)
+
+        ship_task = asyncio.create_task(shipper())
+
+        async def fire(at, path, payload, widx):
+            t0 = time.perf_counter()
+            try:
+                r = await session.post(  # noqa: session-wide timeout
+                    target["base"] + path, json=payload)
+                status = r.status
+                await r.release()
+            except asyncio.TimeoutError:
+                status = -1
+            except aiohttp.ClientError:
+                status = -2
+            dt = time.perf_counter() - t0
+            if status == 200 and widx >= 0:
+                acked.add(widx)
+            kind = "query" if path == "/query" else "write"
+            lat.setdefault(kind, []).append((at, dt, status))
+
+        async def kill():
+            """The harness's ONLY failure action: compute plane down,
+            log plane drained, renewals stopped.  No promote() —
+            detection, election, and takeover are the monitor's job."""
+            await asyncio.sleep(kill_at)
+            t_kill = time.perf_counter()
+            fail["_t_kill"] = t_kill
+            await runner.cleanup()
+            await state.lease.stop_renewal()
+            stop_ship.set()
+            await ship_task
+            # the durable log plane outlives the process: drain the
+            # already-committed tail into the mirror, then let the
+            # compute die for real
+            for _ in range(100):
+                await follower.poll_once()
+                if follower.lag() == 0:
+                    break
+            else:
+                raise RuntimeError(
+                    f"mirror failed to drain: lag {follower.lag()}")
+            fail["drain_ms"] = round((time.perf_counter() - t_kill)
+                                     * 1e3, 1)
+            await state.stop_replication()
+            for t in engine.tables.values():
+                abort = getattr(t, "abort", None)
+                if abort is not None:
+                    await abort()
+            engine._runtimes.close()
+
+        try:
+            for path, payload in (("/query", dash_q),
+                                  ("/write", write_req(10**9))):
+                r = await session.post(  # noqa: session-wide timeout
+                    base + path, json=payload)
+                await r.release()
+            lat.clear()
+            acked.clear()
+            ko = asyncio.create_task(kill())
+            tasks = []
+            for at, path, payload, widx in schedule(
+                    random_mod.Random(seed)):
+                delay = t_start + at - time.perf_counter()
+                if delay > 0:
+                    await asyncio.sleep(delay)
+                tasks.append(asyncio.create_task(
+                    fire(at, path, payload, widx)))
+            await asyncio.gather(*tasks)
+            await ko
+            # the monitor owns the rest: wait for its election to land
+            # (failover_ms is stamped LAST in on_promoted, so seeing
+            # it means the promoted node is serving)
+            for _ in range(600):
+                if "failover_ms" in fail:
+                    break
+                await asyncio.sleep(0.05)
+            if "failover_ms" not in fail:
+                raise RuntimeError(
+                    "standby monitor never promoted: "
+                    f"{monitor.election_state()}")
+
+            rng = TimeRange.new(TW0 - 1, TW0 + 10_000_000)
+            got = {}
+            for h in range(8):
+                t = await engine2.query("ingest",
+                                        [("host", f"w{h:02d}")], rng)
+                for ts_v, v in zip(t.column("timestamp").to_pylist(),
+                                   t.column("value").to_pylist()):
+                    got[(h, ts_v)] = v
+            lost = sum(
+                1 for i in sorted(acked)
+                if got.get((i % 8, TW0 + i * 1000)) != float(i))
+            fail.pop("_t_kill", None)
+            out = {"rows": n_fix, "leg_seconds": leg_seconds,
+                   "store_latency_ms": lat_s * 1e3,
+                   "lease_ttl_ms": lease_ttl_ms,
+                   "grace_ms": grace_ms, "jitter": jitter, **fail,
+                   "harness_promote_calls": 0,
+                   "election_attempts": monitor.attempts,
+                   "election_outcome": (monitor.last_outcome or {}
+                                        ).get("outcome"),
+                   "acked_writes": len(acked),
+                   "acked_write_loss": lost}
+            for kind, ls in sorted(lat.items()):
+                for phase, sel in (
+                        ("pre_kill", [x for x in ls if x[0] < kill_at]),
+                        ("post_kill", [x for x in ls
+                                       if x[0] >= kill_at])):
+                    oks = [dt for _, dt, s in sel if s == 200]
+                    codes: dict = {}
+                    for _, _, s in sel:
+                        codes[str(s)] = codes.get(str(s), 0) + 1
+                    out[f"{kind}_{phase}"] = {
+                        "n": len(sel),
+                        "ok": len(oks),
+                        "p99_ms": (round(float(np.percentile(
+                            np.asarray(oks) * 1e3, 99)), 1)
+                            if oks else None),
+                        "codes": codes,
+                    }
+            return out
+        finally:
+            await session.close()
+            await monitor.close()
+            await follower.close()
+            if runner2 is not None:
+                await runner2.cleanup()
+            if lease2 is not None:
+                await lease2.stop_renewal()
+            if engine2 is not None:
+                install_fence(engine2, None)
+                await engine2.close()
+
+    out = asyncio.run(go())
+    out["bar_zero_loss"] = out["acked_write_loss"] == 0
+    # detection + election + replay must land inside the lease TTL +
+    # the worst-case jittered grace window + a fixed slack (two check
+    # ticks, the fitness wait, mirror replay, listener start); a
+    # self-driving failover that cannot beat this bound is worse than
+    # the paged-operator path it replaces
+    slack_ms = 3_000.0
+    out["failover_bound_ms"] = (lease_ttl_ms
+                                + grace_ms * (1.0 + out["jitter"])
+                                + slack_ms)
+    out["bar_failover_bound"] = (
+        out.get("failover_ms") is not None
+        and out["failover_ms"] <= out["failover_bound_ms"])
+    out["slo_query_p99_ms"] = 500.0
+    out["slo_write_p99_ms"] = 1000.0
+    served_ok = all(
+        out[k]["p99_ms"] is not None
+        and out[k]["p99_ms"] < (out["slo_write_p99_ms"]
+                                if k.startswith("write")
+                                else out["slo_query_p99_ms"])
+        for k in ("query_pre_kill", "write_pre_kill",
+                  "query_post_kill", "write_post_kill"))
+    out["bar_slo_ok"] = (served_ok and out["bar_zero_loss"]
+                         and out["bar_failover_bound"])
+    _log(f"config21: self-driving failover {out.get('failover_ms')} ms "
+         f"(bound {out['failover_bound_ms']} ms, drain "
+         f"{out.get('drain_ms')} ms, epoch {out.get('epoch')}, "
+         f"{out['election_attempts']} election attempts, 0 harness "
+         f"promotes) | acked {out['acked_writes']} lost "
+         f"{out['acked_write_loss']} | bar "
+         f"{'MET' if out['bar_slo_ok'] else 'MISSED'}")
+    pre = out["query_pre_kill"]["p99_ms"]
+    post = out["query_post_kill"]["p99_ms"]
+    degradation = (round(post / pre, 3)
+                   if pre and post else 1.0)
+    return {
+        "metric": ("self-driving failover: kill -9 at mid-leg, standby "
+                   "monitor detects + elects + promotes on its own, "
+                   "open-loop SLO"),
+        "value": out.get("failover_ms"),
+        "unit": "ms",
+        "vs_baseline": degradation,
+        **out,
+    }
+
+
 RUNNERS = {2: run_config2, 3: run_config3, 4: run_config4, 5: run_config5,
            6: run_config6, 7: run_config7, 8: run_config8, 9: run_config9,
            10: run_config10, 11: run_config11, 12: run_config12,
            13: run_config13, 14: run_config14, 15: run_config15,
            16: run_config16, 17: run_config17, 18: run_config18,
-           19: run_config19, 20: run_config20}
+           19: run_config19, 20: run_config20, 21: run_config21}
 
 
 def main() -> None:

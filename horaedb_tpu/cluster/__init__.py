@@ -20,6 +20,7 @@ from horaedb_tpu.cluster.breaker import BreakerConfig, CircuitBreaker
 from horaedb_tpu.cluster.cluster import Cluster, GatherMeta
 from horaedb_tpu.cluster.remote import RemoteRegion
 from horaedb_tpu.cluster.replication import (
+    FailoverConfig,
     HttpWalSource,
     Lease,
     LeaseManager,
@@ -31,15 +32,22 @@ from horaedb_tpu.cluster.replication import (
     ReplicationHub,
     StaleEpochError,
     StaleOwnerError,
+    StandbyMonitor,
     WalFollower,
     install_fence,
     promote,
 )
+from horaedb_tpu.cluster.placement import (
+    LeaseOwnerResolver,
+    PlacementController,
+)
 
-__all__ = ["BreakerConfig", "CircuitBreaker", "Cluster", "GatherMeta",
-           "HttpWalSource", "Lease", "LeaseManager", "LocalWalSource",
-           "MAX_TTL", "PartitionRule", "RebalanceConfig",
-           "RebalanceExecutor", "RemoteRegion", "ReplicationConfig",
-           "ReplicationError", "ReplicationHub", "RoutingTable",
-           "StaleEpochError", "StaleOwnerError", "WalFollower",
-           "install_fence", "promote", "routing_key"]
+__all__ = ["BreakerConfig", "CircuitBreaker", "Cluster",
+           "FailoverConfig", "GatherMeta", "HttpWalSource", "Lease",
+           "LeaseManager", "LeaseOwnerResolver", "LocalWalSource",
+           "MAX_TTL", "PartitionRule", "PlacementController",
+           "RebalanceConfig", "RebalanceExecutor", "RemoteRegion",
+           "ReplicationConfig", "ReplicationError", "ReplicationHub",
+           "RoutingTable", "StaleEpochError", "StaleOwnerError",
+           "StandbyMonitor", "WalFollower", "install_fence", "promote",
+           "routing_key"]

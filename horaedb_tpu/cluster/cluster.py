@@ -236,6 +236,24 @@ class Cluster:
         self._health_errors.pop(region_id, None)
         self.breakers.pop(region_id, None)
 
+    def enable_lease_routing(self, cache_ttl_ms: int = 1000,
+                             backend_factory=None):
+        """Wire [replication] into routing: `owner_resolver` answers
+        409 stale-owner retries from the region's LIVE lease record in
+        this cluster's own store/root — the same record the new
+        primary's fence commits against — instead of a stubbed
+        callable.  `backend_factory(record)` builds the backend for a
+        resolved record; default follows the record's advertised URL
+        with a RemoteRegion.  Returns the resolver (its TTL'd cache is
+        inspectable in tests)."""
+        from horaedb_tpu.cluster.placement import LeaseOwnerResolver
+        from horaedb_tpu.cluster.replication import LeaseManager
+
+        manager = LeaseManager(self._store, self._root_path)
+        self.owner_resolver = LeaseOwnerResolver(
+            manager, backend_factory, cache_ttl_ms=cache_ttl_ms)
+        return self.owner_resolver
+
     # ---- region movement --------------------------------------------------
 
     async def detach_region(self, region_id: int) -> None:

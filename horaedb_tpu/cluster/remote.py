@@ -26,6 +26,7 @@ import aiohttp
 
 from horaedb_tpu.common.deadline import current_deadline, remaining_budget
 from horaedb_tpu.common.error import Error
+from horaedb_tpu.common.tenant import current_tenant
 from horaedb_tpu.metric_engine.types import Sample
 from horaedb_tpu.storage.types import TimeRange
 from horaedb_tpu.utils import span, tracing
@@ -93,6 +94,14 @@ class RemoteRegion:
         trace = tracing.active_trace()
         if trace is not None and not trace.finished:
             headers[tracing.TRACE_HEADER] = trace.trace_id
+        # tenant identity + node-tier weight ride along so the peer's
+        # fair scheduler grants this tenant its configured share even
+        # when the peer's own [tenants] table doesn't know the name
+        # (auto-minted tenants there default to weight 1.0 otherwise)
+        tenant = current_tenant()
+        if tenant is not None:
+            headers["X-Tenant"] = tenant.name
+            headers["X-Tenant-Weight"] = repr(tenant.limits.weight)
         return aiohttp.ClientTimeout(total=budget), headers
 
     async def _post_raw(self, path: str, **kwargs) -> bytes:

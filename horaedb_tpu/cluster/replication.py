@@ -43,7 +43,7 @@ from horaedb_tpu.common.loops import loops
 from horaedb_tpu.common.time_ext import ReadableDuration, now_ms
 from horaedb_tpu.objstore import NotFoundError, ObjectStore
 from horaedb_tpu.utils import registry, tracing
-from horaedb_tpu.wal.log import verify_frames
+from horaedb_tpu.wal.log import mirror_watermarks, verify_frames
 
 logger = logging.getLogger(__name__)
 
@@ -64,6 +64,9 @@ _FAILOVERS = registry.counter(
 _REBALANCE_MOVES = registry.counter(
     "rebalance_moves_total",
     "auto-rebalance plan entries processed, by kind and outcome")
+_ELECTIONS = registry.counter(
+    "standby_elections_total",
+    "standby self-promotion election attempts, by outcome")
 
 
 class ReplicationError(Error):
@@ -159,6 +162,39 @@ class RebalanceConfig:
     table_ttl_ms: int = 7 * 24 * 3600 * 1000
 
 
+@dataclass
+class FailoverConfig:
+    """[failover]: standby self-promotion.  A follower with this on
+    runs a StandbyMonitor that watches the primary's lease record and,
+    once the lease sits expired past a jittered grace window, races
+    `promote()` against sibling standbys — the lease's monotonic-epoch
+    acquire IS the election.  Disabled by default: failover stays an
+    operator/placement-controller decision unless opted in."""
+
+    enabled: bool = False
+    # how long an EXPIRED lease must stay unclaimed before this
+    # standby runs an election.  The grace window absorbs a primary
+    # that is slow to renew (store hiccup, GC pause) without flapping;
+    # config validation refuses a grace shorter than one renewal.
+    grace: ReadableDuration = field(
+        default_factory=lambda: ReadableDuration.from_secs(5))
+    # per-election random extra wait, as a fraction of `grace` —
+    # decorrelates sibling standbys so the freshest (which also defers
+    # least, see the fitness check) usually acquires uncontested
+    jitter: float = 0.5
+    # lease-record poll cadence for the monitor loop
+    check_interval: ReadableDuration = field(
+        default_factory=lambda: ReadableDuration.from_millis(500))
+    # pause between publishing our fitness record and reading the
+    # siblings' — the pre-acquire "freshest mirror wins" exchange
+    fitness_wait: ReadableDuration = field(
+        default_factory=lambda: ReadableDuration.from_millis(200))
+    # flap suppression: after a LOST or failed election this standby
+    # sits out at least this long before arming another grace window
+    cooldown: ReadableDuration = field(
+        default_factory=lambda: ReadableDuration.from_secs(5))
+
+
 # ---- lease-fenced ownership -------------------------------------------------
 
 
@@ -168,11 +204,16 @@ class LeaseRecord:
     holder: str
     epoch: int
     expires_at_ms: int
+    # the holder's serving address — what lease-backed routing resolves
+    # a region's owner to after a failover (empty for in-process
+    # holders; the resolver then needs a holder->backend factory)
+    url: str = ""
 
     def to_json(self) -> bytes:
         return json.dumps({
             "region": self.region, "holder": self.holder,
             "epoch": self.epoch, "expires_at_ms": self.expires_at_ms,
+            "url": self.url,
         }).encode()
 
     @classmethod
@@ -180,7 +221,8 @@ class LeaseRecord:
         d = json.loads(blob)
         return cls(region=int(d["region"]), holder=str(d["holder"]),
                    epoch=int(d["epoch"]),
-                   expires_at_ms=int(d["expires_at_ms"]))
+                   expires_at_ms=int(d["expires_at_ms"]),
+                   url=str(d.get("url", "")))
 
 
 class LeaseManager:
@@ -214,7 +256,7 @@ class LeaseManager:
         return LeaseRecord.from_json(blob)
 
     async def acquire(self, region: int, holder: str,
-                      ttl_ms: int) -> "Lease":
+                      ttl_ms: int, url: str = "") -> "Lease":
         """Take (or retake) the region's lease, bumping the epoch.
         Raises ReplicationError while another holder's lease is live."""
         now = self._clock()
@@ -226,7 +268,7 @@ class LeaseManager:
                 f"(epoch {cur.epoch}, {cur.expires_at_ms - now}ms left)")
         epoch = (cur.epoch if cur is not None else 0) + 1
         rec = LeaseRecord(region=region, holder=holder, epoch=epoch,
-                          expires_at_ms=now + ttl_ms)
+                          expires_at_ms=now + ttl_ms, url=url)
         await self.store.put(self._path(region), rec.to_json())
         back = await self.read(region)
         if back is None or back.holder != holder or back.epoch != epoch:
@@ -305,7 +347,8 @@ class Lease:
         rec = LeaseRecord(
             region=self.region, holder=self.record.holder,
             epoch=self.epoch,
-            expires_at_ms=self.manager._clock() + self._ttl_ms())
+            expires_at_ms=self.manager._clock() + self._ttl_ms(),
+            url=self.record.url)
         await self.manager.store.put(self.manager._path(self.region),
                                      rec.to_json())
         self.record = rec
@@ -427,6 +470,7 @@ class ReplicationHub:
         self.engine = engine
         self.config = config or ReplicationConfig()
         self._clock = clock
+        self._closed = False
         # follower -> {log -> highest acked (durably mirrored) seq}
         self._acks: dict[str, dict[str, int]] = {}
         # follower -> last poll/ack wall ms (liveness for retention)
@@ -465,6 +509,12 @@ class ReplicationHub:
         """One poll's worth of listing state: per-log segments + high
         watermarks.  Passing `follower_id` registers the follower (its
         first poll arms retention)."""
+        if self._closed:
+            # a closed hub (primary dead or demoted) must REFUSE to
+            # answer, matching a dead HTTP primary: an empty listing
+            # would read as "everything truncated" and a tailing
+            # follower would drop its whole mirror
+            raise ReplicationError("replication hub closed")
         if follower_id:
             self.register_follower(follower_id)
         wals = self._wals()
@@ -481,6 +531,8 @@ class ReplicationHub:
 
     async def read_tail(self, log: str, segment_id: int, offset: int,
                         max_bytes: int) -> Optional[tuple[bytes, bool]]:
+        if self._closed:
+            raise ReplicationError("replication hub closed")
         wal = self._wals().get(log)
         if wal is None:
             raise ReplicationError(f"unknown wal log {log!r}")
@@ -526,6 +578,7 @@ class ReplicationHub:
         }
 
     def close(self) -> None:
+        self._closed = True
         for wal in self._wals().values():
             wal.retention = None
         self._acks = {}
@@ -710,6 +763,18 @@ class WalFollower:
         _LAG.remove(region=str(self.region if self.region is not None
                                else "_"))
 
+    async def retarget(self, source) -> None:
+        """Point the ship loop at a NEW primary (an election loser
+        falling back to tailing the winner).  The mirror is kept: its
+        bytes are the old primary's stream, which the winner replayed
+        from its own mirror of the same stream, so per-segment sizes
+        stay valid append offsets; a divergent tail (we out-shipped
+        the winner) fails frame verification on the next read and
+        takes the existing resync-from-scratch path for that segment."""
+        old = self.source
+        self.source = source
+        await old.close()
+
     async def _ship_loop(self, hb, interval_s: float) -> None:
         while not self._stopping:
             hb.beat()
@@ -801,11 +866,18 @@ class WalFollower:
                                                  int(seg["size"]))
             # segments gone from the listing were truncated (all seqs
             # flushed to shared SSTs + acked): the mirror drops them
-            # too, bounding follower disk to the primary's WAL backlog
-            for seg_id in sorted(set(prog) - seen):
-                await asyncio.to_thread(
-                    self._unlink_blocking, self._mirror_path(log, seg_id))
-                prog.pop(seg_id, None)
+            # too, bounding follower disk to the primary's WAL backlog.
+            # Only honored when the remote's flushed floor COVERS what
+            # we shipped for this log — a listing that drops segments
+            # without the SST floor to justify it is a dying/aborted
+            # primary, and these mirror bytes are the failover
+            # candidate's only copy of the acked tail.
+            if self._flushed.get(log, 0) >= self.shipped_seqs.get(log, 0):
+                for seg_id in sorted(set(prog) - seen):
+                    await asyncio.to_thread(
+                        self._unlink_blocking,
+                        self._mirror_path(log, seg_id))
+                    prog.pop(seg_id, None)
             self._refresh_lag()
         if self.shipped_seqs:
             await self.source.ack(dict(self.shipped_seqs))
@@ -893,7 +965,9 @@ async def promote(root_path: str, store: ObjectStore, region_id: int,
                   mirror_dir: str, wal_config, *,
                   segment_ms: int = 2 * 3600 * 1000, config=None,
                   lease_ttl_ms: int = 10_000,
-                  reason: str = "primary_dead"):
+                  reason: str = "primary_dead", url: str = "",
+                  pre_open: Optional[
+                      Callable[[], Awaitable[None]]] = None):
     """Failover: acquire the region's lease (bumping the epoch — the
     old primary is fenced from here on), then open a full engine over
     the region's SHARED paths with the WAL dir pointed at the mirror.
@@ -901,6 +975,13 @@ async def promote(root_path: str, store: ObjectStore, region_id: int,
     seqs preserved; flushed data comes from the shared SSTs via the
     manifest — together, grids byte-identical with what the old
     primary would have served.
+
+    `url` is stamped into the lease record so lease-backed routing can
+    re-resolve the region's owner after the takeover.  `pre_open` runs
+    AFTER the lease is won but BEFORE the engine opens — the standby
+    monitor uses it to stop its follower's ship loop, so no mirror
+    append can race the replay (losers never reach it: a lost acquire
+    raises first, leaving the follower tailing untouched).
 
     Returns (engine, lease); the lease is already installed as the
     fence on every WAL-fronted table and renewal is NOT started (the
@@ -911,11 +992,13 @@ async def promote(root_path: str, store: ObjectStore, region_id: int,
     from horaedb_tpu.metric_engine import MetricEngine
 
     lease = await lease_manager.acquire(region_id, holder,
-                                        ttl_ms=lease_ttl_ms)
+                                        ttl_ms=lease_ttl_ms, url=url)
     lease.grant_ttl_ms(lease_ttl_ms)
     wal_cfg = dataclasses.replace(wal_config, enabled=True,
                                   dir=mirror_dir)
     try:
+        if pre_open is not None:
+            await pre_open()
         engine = await MetricEngine.open(
             f"{root_path}/region_{region_id}", store,
             segment_ms=segment_ms, config=config, wal_config=wal_cfg)
@@ -927,6 +1010,282 @@ async def promote(root_path: str, store: ObjectStore, region_id: int,
     logger.info("failover: promoted %r for region %d at epoch %d (%s)",
                 holder, region_id, lease.epoch, reason)
     return engine, lease
+
+
+class StandbyMonitor:
+    """Self-driving failover: one per standby (a `WalFollower` with
+    [failover] on).  The loop — registered and heartbeated like every
+    background loop — polls the primary's lease record in the SHARED
+    store and treats it as the sole source of truth:
+
+      * record live            -> reset; retarget tailing at its holder
+      * record expired/missing -> arm a jittered grace deadline; once
+        past it, run an ELECTION
+
+    An election is the lease's monotonic-epoch acquire, nothing more:
+    every standby that reaches its deadline publishes a FITNESS record
+    (highest durably mirrored seq) next to the lease, waits one beat,
+    and stands down if a fresh sibling record is strictly fitter — so
+    the freshest mirror normally acquires uncontested, and when two
+    tie the acquire's read-back verify still picks exactly one winner.
+    Losers fall back to tailing the new primary (via `retarget`) under
+    a cooldown, which is the flapping suppression: a standby that just
+    lost cannot immediately re-arm against the winner's first renewal
+    hiccup.
+
+    A store PARTITION never elects: the deadline only arms/fires off a
+    SUCCESSFUL read showing the lease expired, and an unreachable
+    store fails the acquire anyway — the conservative outcome is a
+    region with no primary, never two.
+    """
+
+    def __init__(self, follower: WalFollower,
+                 lease_manager: LeaseManager, region_id: int,
+                 holder: str, config: Optional[FailoverConfig],
+                 wal_config, *,
+                 segment_ms: int = 2 * 3600 * 1000, engine_config=None,
+                 lease_ttl_ms: int = 10_000, url: str = "",
+                 on_promoted: Optional[Callable] = None,
+                 retarget: Optional[Callable] = None,
+                 clock: Callable[[], int] = now_ms, rng=None):
+        import random
+
+        self.follower = follower
+        self.lease_manager = lease_manager
+        self.region = region_id
+        self.holder = holder
+        self.config = config or FailoverConfig()
+        self.wal_config = wal_config
+        self.segment_ms = segment_ms
+        self.engine_config = engine_config
+        self.lease_ttl_ms = lease_ttl_ms
+        self.url = url
+        # async (engine, lease) -> None: the owner's takeover hook
+        # (start renewal, swap the served engine, open a hub...)
+        self.on_promoted = on_promoted
+        # async LeaseRecord -> None: re-point self.follower at the
+        # record's holder (None = keep tailing the original source)
+        self._retarget = retarget
+        self._clock = clock
+        self._rng = rng if rng is not None else random.Random()
+        self.role = "standby"
+        self.engine = None
+        self.lease: Optional[Lease] = None
+        self.attempts = 0
+        self.last_outcome: Optional[dict] = None
+        self._observed: Optional[LeaseRecord] = None
+        self._grace_deadline_ms: Optional[int] = None
+        self._cooldown_until_ms = 0
+        self._retargeted_epoch = 0
+        self._task: Optional[asyncio.Task] = None
+        self._stopping = False
+
+    # ---- observability ----------------------------------------------------
+
+    def election_state(self) -> dict:
+        """/repl/status + /debug/tasks backlog: everything an operator
+        needs to see where this standby stands in an election."""
+        obs = self._observed
+        return {
+            "role": self.role,
+            "region": self.region,
+            "holder": self.holder,
+            "observed_epoch": obs.epoch if obs is not None else 0,
+            "observed_holder": obs.holder if obs is not None else "",
+            "grace_deadline_ms": self._grace_deadline_ms,
+            "cooldown_until_ms": self._cooldown_until_ms,
+            "attempts": self.attempts,
+            "last_outcome": self.last_outcome,
+        }
+
+    def _outcome(self, outcome: str, detail: str = "") -> None:
+        rec = {"outcome": outcome, "at_ms": self._clock()}
+        if detail:
+            rec["detail"] = detail
+        self.last_outcome = rec
+        _ELECTIONS.labels(outcome=outcome).inc()
+
+    # ---- lifecycle --------------------------------------------------------
+
+    def start(self) -> None:
+        ensure(self._task is None, "standby monitor already started")
+        interval = self.config.check_interval.seconds
+        self._task = loops.spawn(
+            lambda hb: self._loop(hb, interval),
+            name=f"standby-monitor:region_{self.region}",
+            kind="standby-monitor", owner="replication",
+            period_s=interval, backlog=self.election_state)
+
+    async def close(self) -> None:
+        self._stopping = True
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+        # best-effort: drop our fitness record so a later election
+        # round never weighs a departed standby
+        try:
+            await self.lease_manager.store.delete(self._fitness_path())
+        except Exception:  # noqa: BLE001 — NotFound / store gone
+            pass
+
+    async def _loop(self, hb, interval_s: float) -> None:
+        while not self._stopping:
+            hb.beat()
+            try:
+                await self._tick()
+                hb.ok()
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:  # noqa: BLE001 — store partition
+                # or election error: nothing arms or fires without a
+                # successful lease read, so just retry next tick
+                hb.error(exc)
+                logger.warning("standby monitor region %d: %s",
+                               self.region, exc)
+            if self._stopping:
+                return
+            await asyncio.sleep(interval_s)
+
+    # ---- the watch/elect state machine ------------------------------------
+
+    async def _tick(self) -> None:
+        if self.role != "standby":
+            return
+        now = self._clock()
+        rec = await self.lease_manager.read(self.region)
+        if (rec is not None and rec.holder
+                and rec.expires_at_ms > now):
+            # live primary: disarm, and (once per epoch) re-point our
+            # tailing at whoever holds the lease now — the loser path
+            self._observed = rec
+            self._grace_deadline_ms = None
+            if (rec.holder != self.holder
+                    and self._retarget is not None
+                    and rec.epoch > self._retargeted_epoch):
+                await self._retarget(rec)
+                self._retargeted_epoch = rec.epoch
+            return
+        if now < self._cooldown_until_ms:
+            return
+        if self._grace_deadline_ms is None:
+            grace_ms = int(self.config.grace.seconds * 1000)
+            jitter_ms = int(self._rng.random() * self.config.jitter
+                            * grace_ms)
+            self._grace_deadline_ms = now + grace_ms + jitter_ms
+            await self._publish_fitness()
+            return
+        # keep our fitness fresh while the grace window runs, so
+        # siblings deciding at their own deadlines see current numbers
+        await self._publish_fitness()
+        if now < self._grace_deadline_ms:
+            return
+        await self._elect()
+
+    async def _elect(self) -> None:
+        # final drain: the primary is presumed dead, but its already-
+        # committed tail may still be readable (shared hub / surviving
+        # log plane) — best effort, a dead wire just fails fast
+        try:
+            await self.follower.poll_once()
+        except Exception:  # noqa: BLE001 — dead primary, expected
+            pass
+        await self._publish_fitness()
+        await asyncio.sleep(self.config.fitness_wait.seconds)
+        fitter = await self._fresher_sibling()
+        if fitter is not None:
+            # stand down this round; re-arm so we run again if the
+            # fitter sibling dies before claiming
+            self._outcome("deferred", detail=f"fresher mirror {fitter}")
+            self._grace_deadline_ms = None
+            self._cooldown_until_ms = (
+                self._clock()
+                + int(self.config.cooldown.seconds * 1000))
+            return
+        self.attempts += 1
+        try:
+            engine, lease = await promote(
+                self.lease_manager.root_path, self.lease_manager.store,
+                self.region, self.lease_manager, self.holder,
+                self.follower.mirror_dir, self.wal_config,
+                segment_ms=self.segment_ms, config=self.engine_config,
+                lease_ttl_ms=self.lease_ttl_ms,
+                reason="standby_election", url=self.url,
+                pre_open=self.follower.close)
+        except ReplicationError as exc:
+            # lost the race (a sibling's acquire landed first): fall
+            # back to tailing — the next live-lease tick retargets us
+            self._outcome("lost", detail=str(exc))
+            self._grace_deadline_ms = None
+            self._cooldown_until_ms = (
+                self._clock()
+                + int(self.config.cooldown.seconds * 1000))
+            return
+        self.engine, self.lease = engine, lease
+        self.role = "primary"
+        self._grace_deadline_ms = None
+        self._outcome("won", detail=f"epoch {lease.epoch}")
+        self._stopping = True
+        logger.info("standby %r won region %d election at epoch %d",
+                    self.holder, self.region, lease.epoch)
+        if self.on_promoted is not None:
+            await self.on_promoted(engine, lease)
+
+    # ---- fitness: freshest mirror wins ------------------------------------
+
+    def _fitness_path(self, holder: Optional[str] = None) -> str:
+        safe = "".join(c if c.isalnum() or c in "._-" else "_"
+                       for c in (holder or self.holder))
+        return (f"{self.lease_manager.root_path}/leases/"
+                f"region_{self.region}.fitness.{safe}.json")
+
+    def _fitness(self) -> int:
+        """Durably covered progress, summed over logs (seqs are
+        per-log monotonic, so the sum is monotone in coverage).  Falls
+        back to scanning the mirror's own frames when the follower has
+        not polled yet (a standby restarted straight into an outage)."""
+        f = self.follower
+        logs = set(f.shipped_seqs) | set(f._flushed)
+        if not logs:
+            return sum(mirror_watermarks(f.mirror_dir).values())
+        return sum(max(f.shipped_seqs.get(log, 0),
+                       f._flushed.get(log, 0)) for log in logs)
+
+    async def _publish_fitness(self) -> None:
+        rec = {"holder": self.holder, "fitness": self._fitness(),
+               "at_ms": self._clock()}
+        await self.lease_manager.store.put(
+            self._fitness_path(), json.dumps(rec).encode())
+
+    async def _fresher_sibling(self) -> Optional[str]:
+        """The holder of a FRESH sibling fitness record strictly fitter
+        than ours, else None.  Stale records (older than the grace
+        horizon) are a departed standby's leftovers and never block."""
+        store = self.lease_manager.store
+        prefix = (f"{self.lease_manager.root_path}/leases/"
+                  f"region_{self.region}.fitness.")
+        now = self._clock()
+        horizon_ms = max(
+            1000,
+            int(self.config.grace.seconds * 1000)
+            + 2 * int(self.config.fitness_wait.seconds * 1000))
+        mine = self._fitness()
+        my_path = self._fitness_path()
+        for meta in await store.list(prefix):
+            if meta.path == my_path:
+                continue
+            try:
+                d = json.loads(await store.get(meta.path))
+            except (NotFoundError, ValueError):
+                continue
+            if now - int(d.get("at_ms", 0)) > horizon_ms:
+                continue
+            if int(d.get("fitness", 0)) > mine:
+                return str(d.get("holder", meta.path))
+        return None
 
 
 # ---- auto-executed rebalance ------------------------------------------------

@@ -19,7 +19,9 @@ from typing import Any, Optional
 from horaedb_tpu.common import Error, ReadableDuration, ReadableSize, ensure
 from horaedb_tpu.common.tenant import TenantsConfig, tenants_from_dict
 from horaedb_tpu.cluster.breaker import BreakerConfig
-from horaedb_tpu.cluster.replication import RebalanceConfig, ReplicationConfig
+from horaedb_tpu.cluster.replication import (FailoverConfig,
+                                             RebalanceConfig,
+                                             ReplicationConfig)
 from horaedb_tpu.metric_engine.meta import MetaConfig
 from horaedb_tpu.rollup.config import RollupConfig, rollup_from_dict
 from horaedb_tpu.scanagent.config import ScanAgentConfig, scanagent_from_dict
@@ -212,6 +214,10 @@ class ServerConfig:
     replication: ReplicationConfig = field(default_factory=ReplicationConfig)
     # auto-executed rebalance envelope for survey_load recommendations
     rebalance: RebalanceConfig = field(default_factory=RebalanceConfig)
+    # standby self-promotion: the follower's StandbyMonitor election
+    # policy (cluster/replication.py); disabled keeps failover an
+    # operator/placement-controller decision
+    failover: FailoverConfig = field(default_factory=FailoverConfig)
     # self-monitoring meta-ingest (metric_engine/meta.py)
     meta: MetaConfig = field(default_factory=MetaConfig)
     # near-data scan agents: shard map + routing policy (scanagent/);
@@ -288,6 +294,9 @@ def _dc_from_dict(cls: type, data: dict[str, Any]) -> Any:
         elif key == "rebalance":
             ensure(isinstance(value, dict), f"{where} expects a config table")
             kwargs[key] = _dc_from_dict(RebalanceConfig, value)
+        elif key == "failover":
+            ensure(isinstance(value, dict), f"{where} expects a config table")
+            kwargs[key] = _dc_from_dict(FailoverConfig, value)
         elif key == "meta":
             ensure(isinstance(value, dict), f"{where} expects a config table")
             kwargs[key] = _dc_from_dict(MetaConfig, value)
@@ -363,11 +372,11 @@ def load_config(path: Optional[str] = None) -> ServerConfig:
     if cfg.replication.enabled:
         ensure(cfg.replication.lease_ttl.seconds > 0,
                "[replication] lease_ttl must be positive")
-        ensure(cfg.replication.renew_interval.seconds
+        ensure(2 * cfg.replication.renew_interval.seconds
                < cfg.replication.lease_ttl.seconds,
-               "[replication] renew_interval must be shorter than "
-               "lease_ttl (a lease must outlive at least one missed "
-               "renewal)")
+               "[replication] renew_interval must be under half of "
+               "lease_ttl (a lease must survive one missed renewal "
+               "with margin, or the fence can expire mid-flush)")
         ensure(cfg.replication.poll_interval.seconds > 0,
                "[replication] poll_interval must be positive")
         ensure(cfg.replication.max_batch_bytes >= 1,
@@ -376,6 +385,25 @@ def load_config(path: Optional[str] = None) -> ServerConfig:
             ensure(bool(cfg.replication.mirror_dir),
                    "[replication] a follower (primary_url set) needs "
                    "mirror_dir for its local WAL mirror")
+    if cfg.failover.enabled:
+        ensure(cfg.replication.enabled,
+               "[failover] requires [replication] enabled (a standby "
+               "monitor watches the replication lease records)")
+        ensure(bool(cfg.replication.primary_url)
+               and bool(cfg.replication.mirror_dir),
+               "[failover] runs on a follower: set [replication] "
+               "primary_url and mirror_dir")
+        ensure(cfg.failover.grace.seconds
+               >= cfg.replication.renew_interval.seconds,
+               "[failover] grace must be at least one [replication] "
+               "renew_interval (a shorter grace window elects over a "
+               "live primary's single renewal hiccup — flapping)")
+        ensure(cfg.failover.check_interval.seconds > 0,
+               "[failover] check_interval must be positive")
+        ensure(cfg.failover.jitter >= 0.0,
+               "[failover] jitter must be >= 0")
+        ensure(cfg.failover.fitness_wait.seconds >= 0.0,
+               "[failover] fitness_wait must be >= 0")
     if cfg.rebalance.enabled:
         ensure(cfg.rebalance.max_concurrent_moves >= 1,
                "[rebalance] max_concurrent_moves must be >= 1")

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import dataclasses
 import logging
 import math
 import random
@@ -460,6 +461,9 @@ class ServerState:
             self.repl = ReplicationHub(engine, config.replication)
         self.lease = None
         self.follower = None
+        # [failover]: the standby's self-promotion monitor (wired in
+        # start_replication when this node is a follower)
+        self.monitor = None
         # set when this node lost its region's lease: governed
         # endpoints answer 409 stale-owner until a fresh lease (or
         # restart) clears it — the coordinator re-resolves and retries
@@ -483,17 +487,10 @@ class ServerState:
             mgr = repl_mod.LeaseManager(store, "metrics")
             lease = await mgr.acquire(
                 cfg.region, holder,
-                ttl_ms=int(cfg.lease_ttl.seconds * 1000))
+                ttl_ms=int(cfg.lease_ttl.seconds * 1000),
+                url=self._advertise_url())
             lease.grant_ttl_ms(int(cfg.lease_ttl.seconds * 1000))
-
-            def on_lost(exc: BaseException) -> None:
-                self.stale_owner = {
-                    "region": cfg.region,
-                    "epoch": lease.epoch,
-                    "reason": str(exc),
-                }
-
-            lease.on_lost = on_lost
+            lease.on_lost = self._on_lease_lost(cfg.region, lease)
             lease.start_renewal(cfg.renew_interval.seconds,
                                 int(cfg.lease_ttl.seconds * 1000))
             repl_mod.install_fence(self.engine, lease)
@@ -507,8 +504,64 @@ class ServerState:
                 source, cfg.mirror_dir, cfg,
                 region=cfg.region if cfg.region >= 0 else None)
             self.follower.start()
+            if self.config.failover.enabled and cfg.region >= 0:
+                # [failover]: this standby elects itself when the
+                # primary's lease sits expired past the grace window
+                self.monitor = repl_mod.StandbyMonitor(
+                    self.follower,
+                    repl_mod.LeaseManager(store, "metrics"),
+                    cfg.region,
+                    cfg.holder or f"server:{self.config.port}",
+                    self.config.failover, self.config.wal,
+                    lease_ttl_ms=int(cfg.lease_ttl.seconds * 1000),
+                    url=self._advertise_url(),
+                    on_promoted=self._on_promoted)
+                self.monitor.start()
+        # lease-backed routing for a cluster-backed server: the 409
+        # stale-owner retry re-resolves owners from live lease records
+        if (getattr(self.engine, "enable_lease_routing", None)
+                is not None
+                and getattr(self.engine, "owner_resolver", None) is None):
+            self.engine.enable_lease_routing()
+
+    def _advertise_url(self) -> str:
+        """The address peers should resolve this node's regions to —
+        stamped into lease records for lease-backed routing."""
+        return f"http://127.0.0.1:{self.config.port}"
+
+    def _on_lease_lost(self, region: int, lease):
+        def on_lost(exc: BaseException) -> None:
+            self.stale_owner = {
+                "region": region,
+                "epoch": lease.epoch,
+                "reason": str(exc),
+            }
+
+        return on_lost
+
+    async def _on_promoted(self, engine, lease) -> None:
+        """StandbyMonitor takeover hook: this node IS the primary now.
+        Swap the served engine (handlers read `state.engine` per
+        request), start the lease heartbeat, and open a shipping hub so
+        the next generation of standbys can tail us.  The pre-takeover
+        engine stays open — its owner (run_server / the harness)
+        closes it."""
+        from horaedb_tpu.cluster.replication import ReplicationHub
+
+        cfg = self.config.replication
+        self.engine = engine
+        self.lease = lease
+        self.follower = None  # the monitor closed it pre-replay
+        self.stale_owner = None
+        lease.on_lost = self._on_lease_lost(lease.region, lease)
+        lease.start_renewal(cfg.renew_interval.seconds,
+                            int(cfg.lease_ttl.seconds * 1000))
+        self.repl = ReplicationHub(engine, cfg)
 
     async def stop_replication(self) -> None:
+        if self.monitor is not None:
+            await self.monitor.close()
+            self.monitor = None
         if self.follower is not None:
             await self.follower.close()
             self.follower = None
@@ -643,6 +696,21 @@ def _tenant_middleware(state: ServerState):
             tenant = reg.resolve(request.headers.get("X-Tenant"))
         except Error as e:
             return web.json_response({"error": str(e)}, status=400)
+        # cluster-tier weight forwarding (cluster/remote.py): a peer
+        # coordinator sends the tenant's node-tier weight alongside
+        # X-Tenant so our fair scheduler grants the same share.  Only
+        # AUTO-minted tenants accept it — a configured tenant's weight
+        # is this node's policy, and the shared default tenant must
+        # never be re-weighted by one caller for everyone
+        fwd = request.headers.get("X-Tenant-Weight")
+        if fwd is not None and tenant.auto:
+            try:
+                w = float(fwd)
+            except ValueError:
+                w = 0.0
+            if 0.0 < w <= 1e6 and tenant.limits.weight != w:
+                tenant.limits = dataclasses.replace(
+                    tenant.limits, weight=w)
         t0 = time.perf_counter()
         try:
             with tenant_scope(tenant):
@@ -1500,6 +1568,17 @@ def build_app(state: ServerState) -> web.Application:
             body["role"] = "follower"
             body["lag_seqs"] = state.follower.lag()
             body["shipped_seqs"] = dict(state.follower.shipped_seqs)
+        if state.monitor is not None:
+            # [failover]: a node running a standby monitor is a
+            # STANDBY until it wins an election — even though it also
+            # carries a shipping hub (cascading standbys tail it), the
+            # monitor's role is the truth.  The election dict (observed
+            # epoch, grace deadline, last outcome) is the same one the
+            # monitor's loop backlog serves on /debug/tasks.
+            election = state.monitor.election_state()
+            if election["role"] == "standby":
+                body["role"] = "standby"
+            body["election"] = election
         if state.lease is not None:
             body["lease"] = {"region": state.lease.region,
                              "epoch": state.lease.epoch,

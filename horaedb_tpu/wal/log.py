@@ -173,6 +173,41 @@ def verify_frames(blob: bytes) -> tuple[int, int, int]:
     return off, max_seq, count
 
 
+def mirror_watermarks(wal_dir: str) -> dict:
+    """Per-log highest frame seq under a WAL-layout directory
+    (`{wal_dir}/{log}/{id:020d}.wal`), by walking every segment's
+    frames (crc-checked; a torn tail just stops the walk).  This is
+    the standby election's cold-start fitness source: a follower that
+    restarted straight into an outage has empty in-memory progress,
+    but its mirror's own bytes still prove exactly how fresh it is."""
+    out: dict = {}
+    try:
+        logs = os.listdir(wal_dir)
+    except OSError:
+        return out
+    for log in logs:
+        d = os.path.join(wal_dir, log)
+        if not os.path.isdir(d):
+            continue
+        max_seq = 0
+        try:
+            names = os.listdir(d)
+        except OSError:
+            continue
+        for name in names:
+            if not name.endswith(".wal"):
+                continue
+            try:
+                with open(os.path.join(d, name), "rb") as f:
+                    blob = f.read()
+            except OSError:
+                continue
+            _aligned, seq, _count = verify_frames(blob)
+            max_seq = max(max_seq, seq)
+        out[log] = max_seq
+    return out
+
+
 class Wal:
     """One table's segmented log + group-commit loop.
 

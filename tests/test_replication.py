@@ -1291,3 +1291,626 @@ class TestFailoverChaos:
                     tmp_path, random.Random(REPL_SEED + 3000 + i), i)
 
         run(go())
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 17 tentpole (a): self-driving failover — StandbyMonitor
+# elections.  Knobs FAILOVER_SEED / FAILOVER_SCHEDULES (wired into
+# `make chaos`); the fast class runs one fixed-seed round in tier-1.
+
+
+FAILOVER_SEED = int(os.environ.get("FAILOVER_SEED", "1337"), 0)
+FAILOVER_SCHEDULES = int(os.environ.get("FAILOVER_SCHEDULES", "5"), 0)
+
+
+async def _until(clock, pred, what, real_timeout_s=30.0, step_ms=100):
+    """Advance the injected clock until `pred()` — the ONLY thing the
+    harness does while the monitors detect, elect, and promote."""
+    loop = asyncio.get_event_loop()
+    deadline = loop.time() + real_timeout_s
+    while not pred():
+        assert loop.time() < deadline, f"drill stalled waiting: {what}"
+        clock.advance(step_ms)
+        await asyncio.sleep(0.02)
+
+
+async def _self_driving_round(tmp_path, rnd, round_idx):
+    """The acceptance drill: kill -9 A -> a StandbyMonitor promotes B;
+    kill -9 the winner -> the surviving monitor promotes C.  The
+    harness ONLY kills and advances time — ZERO operator/harness
+    promote() calls; each election is the lease's monotonic-epoch
+    acquire, raced by the monitors themselves.  Asserts exactly one
+    live winner per election, strictly climbing epochs, and zero
+    acked-write loss across both hops."""
+    from horaedb_tpu.cluster.replication import (FailoverConfig,
+                                                 StandbyMonitor,
+                                                 WalFollower)
+
+    clock = Clock()
+    store = MemoryObjectStore()
+    root = f"sd{round_idx}"
+    a_wal = tmp_path / f"sda{round_idx}"
+    holders = ("node-b", "node-c")
+    mirrors = {h: tmp_path / f"sd{h}{round_idx}" for h in holders}
+    mgr = LeaseManager(store, root, clock=clock)
+    cfg = FailoverConfig(
+        enabled=True,
+        grace=ReadableDuration.from_millis(300),
+        jitter=0.5,
+        check_interval=ReadableDuration.from_millis(10),
+        fitness_wait=ReadableDuration.from_millis(30),
+        cooldown=ReadableDuration.from_millis(200))
+    a = await MetricEngine.open(f"{root}/region_0", store,
+                                segment_ms=2 * HOUR,
+                                wal_config=wal_config(a_wal))
+    lease_a = await mgr.acquire(0, "node-a", ttl_ms=5_000)
+    install_fence(a, lease_a)
+    hubs = {"node-a": ReplicationHub(a)}
+    followers = {}
+    monitors = {}
+    promoted = {}  # holder -> (engine, lease), filled by on_promoted
+    open_engines = []
+    acked = {}
+
+    def wire(holder):
+        follower = WalFollower(LocalWalSource(hubs["node-a"], holder),
+                               str(mirrors[holder]), region=0)
+
+        async def on_promoted(engine, lease):
+            promoted[holder] = (engine, lease)
+            open_engines.append(engine)
+            hubs[holder] = ReplicationHub(engine)
+
+        async def retarget(rec):
+            # the loser path: fall back to tailing whoever holds the
+            # lease now (in-process topology -> the winner's hub)
+            hub = hubs.get(rec.holder)
+            if hub is not None:
+                await follower.retarget(LocalWalSource(hub, holder))
+
+        followers[holder] = follower
+        monitors[holder] = StandbyMonitor(
+            follower, mgr, 0, holder, cfg, wal_config(a_wal),
+            segment_ms=2 * HOUR, lease_ttl_ms=5_000,
+            on_promoted=on_promoted, retarget=retarget, clock=clock,
+            rng=random.Random(rnd.randrange(1 << 30)))
+
+    try:
+        for h in holders:
+            wire(h)
+            monitors[h].start()
+        rows = [(f"h{i}", T0 + 100 * i, float(rnd.randrange(100)))
+                for i in range(rnd.randrange(3, 10))]
+        await a.write([sample("cpu", [("host", h)], ts, v)
+                       for h, ts, v in rows])
+        acked.update({(h, ts): v for h, ts, v in rows})
+        if rnd.random() < 0.5:
+            await a.flush()
+        for f in followers.values():
+            await f.poll_once()
+            assert f.lag() == 0
+        # ---- kill -9 A.  Its lease simply stops being renewed; the
+        # monitors must notice the expiry, wait out their jittered
+        # grace windows, and run the election themselves.
+        hubs.pop("node-a").close()
+        install_fence(a, None)
+        await kill_engine(a)
+        a = None
+        await _until(clock, lambda: promoted, "first election")
+        rec = await mgr.read(0)
+        assert len(promoted) == 1, "exactly one winner per election"
+        w1 = rec.holder
+        assert w1 in promoted
+        e1, l1 = promoted[w1]
+        assert l1.epoch > lease_a.epoch
+        assert monitors[w1].role == "primary"
+        assert monitors[w1].last_outcome["outcome"] == "won"
+        loser = next(h for h in holders if h != w1)
+        # the loser self-heals: next live-lease tick retargets its
+        # tailing at the winner (possibly after a lost-election
+        # cooldown — that cooldown IS the flapping suppression)
+        await _until(
+            clock,
+            lambda: monitors[loser]._retargeted_epoch == l1.epoch,
+            "loser retarget", step_ms=20)
+        assert monitors[loser].role == "standby"
+        # writes to the new primary ship down the retargeted chain
+        rows2 = [(f"g{i}", T0 + 100 * i + 7, float(rnd.randrange(100)))
+                 for i in range(rnd.randrange(2, 6))]
+        await e1.write([sample("cpu", [("host", h)], ts, v)
+                        for h, ts, v in rows2])
+        acked.update({(h, ts): v for h, ts, v in rows2})
+        await followers[loser].poll_once()
+        assert followers[loser].lag() == 0
+        # ---- kill -9 the winner.  Only the losing monitor survives;
+        # it must wait out cooldown + lease expiry + grace, then take
+        # epoch 3 on its own.
+        hubs.pop(w1).close()
+        install_fence(e1, None)
+        await kill_engine(e1)
+        open_engines.remove(e1)
+        await _until(clock, lambda: loser in promoted,
+                     "second election")
+        e2, l2 = promoted[loser]
+        assert l2.epoch > l1.epoch > lease_a.epoch
+        rec = await mgr.read(0)
+        assert rec.holder == loser and rec.epoch == l2.epoch
+        # zero acked-write loss across both self-driven hops
+        rng_q = TimeRange.new(T0 - 1, T0 + 100_000)
+        for (h, ts), v in acked.items():
+            t = await e2.query("cpu", [("host", h)], rng_q)
+            got = dict(zip(t.column("timestamp").to_pylist(),
+                           t.column("value").to_pylist()))
+            assert got.get(ts) == v, \
+                f"acked write lost across self-driving failover: {h}"
+        # operator surface: the election history is inspectable
+        st = monitors[loser].election_state()
+        assert st["role"] == "primary"
+        assert st["last_outcome"]["outcome"] == "won"
+    finally:
+        for mon in monitors.values():
+            await mon.close()
+        for f in followers.values():
+            await f.close()
+        for hub in hubs.values():
+            hub.close()
+        if a is not None:
+            await a.close()
+        for e in open_engines:
+            install_fence(e, None)
+            await e.close()
+
+
+class TestSelfDrivingFailoverFast:
+    """Tier-1: one fixed-seed round of the zero-harness-promote
+    double-failover drill."""
+
+    def test_self_driving_double_failover(self, tmp_path):
+        run(_self_driving_round(tmp_path, random.Random(FAILOVER_SEED),
+                                0))
+
+
+@pytest.mark.slow
+class TestSelfDrivingFailover:
+    """`make chaos`: FAILOVER_SCHEDULES seeded rounds (jitter seeds,
+    batch shapes, and flush points vary per round)."""
+
+    def test_self_driving_sweep(self, tmp_path):
+        async def go():
+            for i in range(FAILOVER_SCHEDULES):
+                await _self_driving_round(
+                    tmp_path, random.Random(FAILOVER_SEED + 4000 + i),
+                    i)
+
+        run(go())
+
+
+class TestStandbyMonitorUnits:
+    def _stub_follower(self, tmp_path, shipped=None):
+        import types
+
+        return types.SimpleNamespace(
+            shipped_seqs=dict(shipped or {}), _flushed={},
+            mirror_dir=str(tmp_path / "mm"), lag=lambda: 0)
+
+    def test_store_partition_never_arms(self, tmp_path):
+        """An unreadable store must surface as a loop error, never as
+        an armed grace deadline: partitions elect nobody."""
+        from horaedb_tpu.cluster.replication import (FailoverConfig,
+                                                     StandbyMonitor)
+
+        class _BoomStore(MemoryObjectStore):
+            async def get(self, path):
+                raise ConnectionError("store partition")
+
+        async def go():
+            clock = Clock()
+            mgr = LeaseManager(_BoomStore(), "part", clock=clock)
+            mon = StandbyMonitor(
+                self._stub_follower(tmp_path), mgr, 0, "node-x",
+                FailoverConfig(enabled=True),
+                wal_config(tmp_path / "w"), clock=clock)
+            for _ in range(3):
+                clock.advance(60_000)  # way past any TTL
+                with pytest.raises(ConnectionError):
+                    await mon._tick()
+            assert mon._grace_deadline_ms is None
+            assert mon.attempts == 0 and mon.role == "standby"
+
+        run(go())
+
+    def test_defers_to_fresher_sibling(self, tmp_path):
+        """At its deadline a standby with a strictly fitter FRESH
+        sibling stands down (outcome `deferred`, cooldown armed) and
+        leaves the lease untouched."""
+        import json as _json
+
+        from horaedb_tpu.cluster.replication import (FailoverConfig,
+                                                     StandbyMonitor)
+
+        async def go():
+            clock = Clock()
+            store = MemoryObjectStore()
+            mgr = LeaseManager(store, "defer", clock=clock)
+            cfg = FailoverConfig(
+                enabled=True,
+                fitness_wait=ReadableDuration.from_millis(0),
+                cooldown=ReadableDuration.from_millis(500))
+            mon = StandbyMonitor(
+                self._stub_follower(tmp_path, shipped={"log": 5}),
+                mgr, 0, "node-x", cfg, wal_config(tmp_path / "w"),
+                clock=clock)
+            await store.put(
+                "defer/leases/region_0.fitness.node-y.json",
+                _json.dumps({"holder": "node-y", "fitness": 9,
+                             "at_ms": clock()}).encode())
+            mon._grace_deadline_ms = clock() - 1
+            await mon._elect()
+            assert mon.last_outcome["outcome"] == "deferred"
+            assert "node-y" in mon.last_outcome["detail"]
+            assert mon._cooldown_until_ms > clock()
+            assert await mgr.read(0) is None  # nobody promoted
+            # a STALE fitter record never blocks: the sibling is gone
+            clock.advance(120_000)
+            mon._cooldown_until_ms = 0
+            assert await mon._fresher_sibling() is None
+
+        run(go())
+
+    def test_repl_status_election_surface(self, tmp_path):
+        """/repl/status on a standby: role flips to `standby` and the
+        election dict (observed epoch, grace deadline, last outcome)
+        rides along — satellite (6)."""
+        async def go():
+            from aiohttp.test_utils import TestClient, TestServer
+
+            from horaedb_tpu.cluster.replication import (
+                FailoverConfig, StandbyMonitor, WalFollower)
+            from horaedb_tpu.server.config import ServerConfig
+            from horaedb_tpu.server.main import ServerState, build_app
+
+            engine = await MetricEngine.open(
+                "m", MemoryObjectStore(), segment_ms=2 * HOUR,
+                wal_config=wal_config(tmp_path / "wal"))
+            cfg = ServerConfig()
+            cfg.replication.enabled = True
+            state = ServerState(engine, cfg)
+            follower = WalFollower(
+                LocalWalSource(state.repl, "standby-1"),
+                str(tmp_path / "mirror"), region=0)
+            state.follower = follower
+            state.monitor = StandbyMonitor(
+                follower,
+                LeaseManager(MemoryObjectStore(), "metrics"),
+                0, "standby-1", FailoverConfig(enabled=True),
+                cfg.wal)
+            client = TestClient(TestServer(build_app(state)))
+            await client.start_server()
+            try:
+                r = await client.get("/repl/status")
+                body = await r.json()
+                assert body["role"] == "standby"
+                el = body["election"]
+                assert el["holder"] == "standby-1"
+                assert el["observed_epoch"] == 0
+                assert el["grace_deadline_ms"] is None
+                assert el["attempts"] == 0
+            finally:
+                await client.close()
+                await state.monitor.close()
+                await follower.close()
+                await state.stop_replication()
+                await engine.close()
+
+        run(go())
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 17 tentpole (c): lease-backed routing — the 409 routed retry
+# against REAL lease records (satellite 3), not stubbed resolvers.
+
+
+class _CountingStore(MemoryObjectStore):
+    def __init__(self):
+        super().__init__()
+        self.gets = 0
+
+    async def get(self, path):
+        self.gets += 1
+        return await super().get(path)
+
+
+class TestLeaseRouting:
+    async def _seeded(self, store):
+        c = await Cluster.open("cluster", store, num_regions=2,
+                               segment_ms=2 * HOUR)
+        await c.write([
+            sample("cpu", [("host", f"h{i:03d}")], T0 + 1000, float(i))
+            for i in range(32)])
+        return c
+
+    def test_routed_retry_follows_real_lease(self):
+        """A 409 mid-gather re-resolves from the LIVE lease record the
+        new primary's election wrote — full answer, region healed."""
+        async def go():
+            store = MemoryObjectStore()
+            c = await self._seeded(store)
+            try:
+                rng = TimeRange.new(T0, T0 + HOUR)
+                real = c.regions[1]
+                c.repoint_region(1, _StaleBackend(1, owner="node-b"))
+                resolver = c.enable_lease_routing(
+                    backend_factory=lambda rec:
+                        real if rec.holder == "node-b" else None)
+                assert c.owner_resolver is resolver
+                # the failover that triggers those 409s: node-b's
+                # takeover wrote this record (same path promote() uses)
+                mgr = LeaseManager(store, "cluster")
+                await mgr.acquire(1, "node-b", ttl_ms=60_000,
+                                  url="http://node-b:5001")
+                tbl, meta = await c.query_gather("cpu", [], rng)
+                assert not meta.partial and tbl.num_rows == 32
+                assert c.regions[1] is real
+            finally:
+                await c.close()
+
+        run(go())
+
+    def test_no_live_lease_degrades_to_partial(self):
+        """Mid-election there is NO owner: an expired record resolves
+        to None and the gather degrades to a partial answer."""
+        async def go():
+            store = MemoryObjectStore()
+            c = await self._seeded(store)
+            try:
+                rng = TimeRange.new(T0, T0 + HOUR)
+                c.repoint_region(1, _StaleBackend(1))
+                c.enable_lease_routing(backend_factory=lambda rec: c)
+                # written far in the (injected) past -> expired by the
+                # resolver's real clock
+                mgr = LeaseManager(store, "cluster", clock=Clock())
+                await mgr.acquire(1, "node-dead", ttl_ms=1_000)
+                tbl, meta = await c.query_gather("cpu", [], rng)
+                assert meta.partial and meta.missing_regions == [1]
+            finally:
+                await c.close()
+
+        run(go())
+
+    def test_resolver_cache_ttl_and_contradiction(self):
+        """A 409 storm costs one lease read per TTL; a hint that
+        contradicts the cached record busts the cache immediately."""
+        from horaedb_tpu.cluster.placement import LeaseOwnerResolver
+
+        async def go():
+            clock = Clock()
+            store = _CountingStore()
+            mgr = LeaseManager(store, "r", clock=clock)
+            await mgr.acquire(0, "node-b", ttl_ms=600_000, url="u-b")
+            backend = object()
+            resolver = LeaseOwnerResolver(
+                mgr, backend_factory=lambda rec: backend,
+                cache_ttl_ms=1000, clock=clock)
+            exc = StaleOwnerError("x", region=0, owner="node-b")
+            assert await resolver(0, exc) is backend
+            g = store.gets
+            for _ in range(5):  # storm within the TTL: all cache hits
+                assert await resolver(0, exc) is backend
+            assert store.gets == g
+            clock.advance(1001)  # TTL lapse -> one re-read
+            assert await resolver(0, exc) is backend
+            assert store.gets == g + 1
+            # contradicting owner hint -> immediate re-read
+            exc2 = StaleOwnerError("x", region=0, owner="node-z")
+            assert await resolver(0, exc2) is backend
+            assert store.gets == g + 2
+
+        run(go())
+
+    def test_mid_gather_failover_routes_to_new_owner(self):
+        """The election completes WHILE the gather is in flight: the
+        409 that follows routes to the record the election just wrote."""
+        async def go():
+            store = MemoryObjectStore()
+            c = await self._seeded(store)
+            started = asyncio.Event()
+            release = asyncio.Event()
+
+            class _Blocking:
+                async def query(self, *a, **kw):
+                    started.set()
+                    await release.wait()
+                    raise StaleOwnerError("owner moved mid-gather",
+                                          region=1, owner="node-b")
+
+                async def close(self):
+                    pass
+
+            try:
+                rng = TimeRange.new(T0, T0 + HOUR)
+                real = c.regions[1]
+                c.repoint_region(1, _Blocking())
+                c.enable_lease_routing(
+                    backend_factory=lambda rec:
+                        real if rec.holder == "node-b" else None)
+                task = asyncio.ensure_future(
+                    c.query_gather("cpu", [], rng))
+                await started.wait()
+                # failover lands mid-gather
+                mgr = LeaseManager(store, "cluster")
+                await mgr.acquire(1, "node-b", ttl_ms=60_000)
+                release.set()
+                tbl, meta = await task
+                assert not meta.partial and tbl.num_rows == 32
+                assert c.regions[1] is real
+            finally:
+                await c.close()
+
+        run(go())
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 17 tentpole (b): the closed placement loop
+
+
+class TestPlacementController:
+    def test_closes_replica_health_seam(self):
+        from horaedb_tpu.cluster.placement import PlacementController
+
+        async def go():
+            clock = Clock()
+            cluster = _PlanCluster([_split_entry()])
+            cfg = RebalanceConfig(enabled=True, dry_run=False)
+            ctl = PlacementController(cluster, cfg, clock=clock)
+            ex = RebalanceExecutor(cluster, cfg, clock=clock)
+            ctl.attach(ex)
+            lag = {"v": 5}
+            ctl.register_lag_probe(0, lambda: lag["v"])
+            rec = (await ex.run_once())[0]
+            assert rec["outcome"] == "replica_unhealthy"
+            assert ctl.history[-1]["outcome"] == "unhealthy"
+            assert cluster.splits == []
+            lag["v"] = 0  # replica caught up -> the move proceeds
+            assert (await ex.run_once())[0]["outcome"] == "executed"
+            assert len(cluster.splits) == 1
+
+        run(go())
+
+    def test_move_target_picks_least_loaded_willing_node(self):
+        from horaedb_tpu.cluster.placement import PlacementController
+
+        async def go():
+            clock = Clock()
+            entry = {"region": 2, "kind": "move", "reason": "skew"}
+            cluster = _PlanCluster([entry])
+            cfg = RebalanceConfig(enabled=True, dry_run=False)
+            ctl = PlacementController(cluster, cfg, clock=clock)
+            ex = RebalanceExecutor(cluster, cfg, clock=clock)
+            ctl.attach(ex)
+            # no registered nodes: the controller answers "no" (the
+            # executor sees a decline) and records WHY on its side
+            assert (await ex.run_once())[0]["outcome"] == "declined"
+            assert ctl.history[-1]["outcome"] == "no_target"
+            calls = []
+
+            async def decline(rid, e):
+                calls.append(("light", rid))
+                return False
+
+            async def adopt(rid, e):
+                calls.append(("heavy", rid))
+                return True
+
+            ctl.register_node("light", decline, load=lambda: 1)
+            ctl.register_node("heavy", adopt, load=lambda: 7)
+            assert (await ex.run_once())[0]["outcome"] == "executed"
+            # least-loaded asked first; its decline falls through
+            assert calls == [("light", 2), ("heavy", 2)]
+            assert ctl.history[-1]["detail"] == "-> heavy"
+
+        run(go())
+
+    def test_promotion_choice_freshest_then_name(self):
+        from horaedb_tpu.cluster.placement import PlacementController
+
+        async def go():
+            ctl = PlacementController(object(), clock=Clock())
+            assert ctl.choose_promotion(0) is None
+            assert await ctl.promote_region(0) is None
+            assert ctl.history[-1]["outcome"] == "no_standby"
+            order = []
+
+            def std(name, fit, result):
+                async def p():
+                    order.append(name)
+                    return result
+                ctl.register_standby(0, name, lambda: fit, p)
+
+            std("node-c", 9, "engine-c")
+            std("node-b", 5, "engine-b")
+            assert ctl.choose_promotion(0) == "node-c"  # freshest
+            assert await ctl.promote_region(0) == "engine-c"
+            assert order == ["node-c"]
+            assert ctl.history[-1]["outcome"] == "executed"
+            # fitness tie breaks deterministically by holder name
+            ctl2 = PlacementController(object(), clock=Clock())
+            ctl2.register_standby(1, "node-z", lambda: 5, std)
+            ctl2.register_standby(1, "node-a", lambda: 5, std)
+            assert ctl2.choose_promotion(1) == "node-a"
+
+        run(go())
+
+    def test_refresh_folds_survey_and_lag(self):
+        from horaedb_tpu.cluster.placement import PlacementController
+
+        class _SurveyCluster:
+            rebalance_survey = {"at_ms": T0, "stats": {
+                0: {"rows": 10, "bytes": 100, "rules": 1},
+                1: {"rows": 20, "bytes": 200, "rules": 1}}}
+
+        async def go():
+            ctl = PlacementController(_SurveyCluster(), clock=Clock())
+            ctl.register_lag_probe(1, lambda: 3)
+            snap = await ctl.refresh()
+            assert snap["regions"][0]["lag_seqs"] is None
+            assert snap["regions"][0]["healthy"]  # no probe: vacuous
+            assert snap["regions"][1]["lag_seqs"] == 3
+            assert not snap["regions"][1]["healthy"]
+            assert ctl.snapshot is snap
+
+        run(go())
+
+
+class TestFailoverConfig:
+    """Satellite (1): the new [failover] / [replication] validations."""
+
+    def _load(self, tmp_path, text):
+        from horaedb_tpu.server.config import load_config
+
+        p = tmp_path / "cfg.toml"
+        p.write_text(text)
+        return load_config(str(p))
+
+    REPL = ('[replication]\nenabled = true\nregion = 0\n'
+            'primary_url = "http://x:1"\nmirror_dir = "/tmp/m"\n'
+            'lease_ttl = "8s"\nrenew_interval = "2s"\n')
+
+    def test_renew_interval_must_be_under_half_ttl(self, tmp_path):
+        pytest.importorskip("tomllib")
+        from horaedb_tpu.common import Error
+
+        # exactly ttl/2 is rejected too: one missed renewal must leave
+        # margin before the fence expires
+        with pytest.raises(Error, match="renew_interval"):
+            self._load(tmp_path,
+                       '[replication]\nenabled = true\n'
+                       'lease_ttl = "4s"\nrenew_interval = "2s"\n')
+
+    def test_failover_needs_replication_follower(self, tmp_path):
+        pytest.importorskip("tomllib")
+        from horaedb_tpu.common import Error
+
+        with pytest.raises(Error, match="replication"):
+            self._load(tmp_path, '[failover]\nenabled = true\n')
+        with pytest.raises(Error, match="primary_url"):
+            self._load(tmp_path,
+                       '[replication]\nenabled = true\n'
+                       '[failover]\nenabled = true\n')
+
+    def test_grace_must_cover_one_renew_interval(self, tmp_path):
+        pytest.importorskip("tomllib")
+        from horaedb_tpu.common import Error
+
+        with pytest.raises(Error, match="grace"):
+            self._load(tmp_path, self.REPL +
+                       '[failover]\nenabled = true\ngrace = "1s"\n')
+
+    def test_valid_failover_section_parses(self, tmp_path):
+        pytest.importorskip("tomllib")
+        cfg = self._load(tmp_path, self.REPL +
+                         '[failover]\nenabled = true\ngrace = "5s"\n'
+                         'jitter = 0.25\ncheck_interval = "250ms"\n')
+        assert cfg.failover.enabled
+        assert cfg.failover.grace.seconds == 5.0
+        assert cfg.failover.jitter == 0.25
+        assert cfg.failover.check_interval.seconds == 0.25

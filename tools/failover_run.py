@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""Self-driving failover bench runner that ALWAYS records a result.
+
+Runs `BENCH_CONFIG=21` (the self-driving failover SLO harness: the
+harness only kills the primary at mid-leg — a StandbyMonitor detects
+the expired lease, wins the election, replays its mirror, and brings
+up the new serving node with ZERO harness promote() calls) in a
+subprocess under a hard timeout and writes
+`bench_results/failover_rNN.json` (next free index) with an explicit
+`status` of "ok" | "timeout" | "error" — on EVERY outcome, including
+the process being killed.  rc=124 (an outer `timeout(1)`) classifies
+as "timeout" too: the history must distinguish "timed out" from
+"never ran".
+
+`ok` requires BOTH bars: bar_zero_loss (no acked write lost across
+the election) and bar_failover_bound (detection + election + replay
+lands inside lease TTL + the worst-case jittered grace window +
+fixed slack).
+
+Usage:
+    python tools/failover_run.py [--rows 100000] [--iters 10]
+                                 [--timeout 300] [--out PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def next_record_path() -> str:
+    results = os.path.join(ROOT, "bench_results")
+    os.makedirs(results, exist_ok=True)
+    taken = set()
+    for p in glob.glob(os.path.join(results, "failover_r*.json")):
+        m = re.search(r"failover_r(\d+)\.json$", p)
+        if m:
+            taken.add(int(m.group(1)))
+    n = 1
+    while n in taken:
+        n += 1
+    return os.path.join(results, f"failover_r{n:02d}.json")
+
+
+def run(rows: int, iters: int, timeout_s: float) -> dict:
+    cmd = [sys.executable, "bench.py"]
+    env = dict(os.environ)
+    env["BENCH_CONFIG"] = "21"
+    env.setdefault("BENCH_ROWS", str(rows))
+    env.setdefault("BENCH_ITERS", str(iters))
+    t0 = time.perf_counter()
+    record = {"config": 21, "rows": rows, "iters": iters,
+              "timeout_s": timeout_s, "cmd": " ".join(cmd)}
+    try:
+        proc = subprocess.run(cmd, cwd=ROOT, capture_output=True,
+                              text=True, timeout=timeout_s, env=env)
+        record["rc"] = proc.returncode
+        record["ok"] = proc.returncode == 0
+        record["status"] = ("ok" if proc.returncode == 0 else
+                            "timeout" if proc.returncode == 124 else
+                            "error")
+        record["tail"] = (proc.stderr or proc.stdout or "")[-2000:]
+        if proc.returncode == 0:
+            # bench.py prints ONE result JSON on its last stdout line
+            for line in reversed(proc.stdout.strip().splitlines()):
+                try:
+                    record["result"] = json.loads(line)
+                    break
+                except json.JSONDecodeError:
+                    continue
+            result = record.get("result") or {}
+            record["ok"] = bool(result.get("bar_zero_loss", False)
+                                and result.get("bar_failover_bound",
+                                               False))
+            if not record["ok"]:
+                record["status"] = "error"
+    except subprocess.TimeoutExpired as exc:
+        # a killed run still writes a record
+        record["rc"] = 124
+        record["ok"] = False
+        record["status"] = "timeout"
+        tail = exc.stderr or exc.stdout or b""
+        if isinstance(tail, bytes):
+            tail = tail.decode(errors="replace")
+        record["tail"] = tail[-2000:]
+    record["elapsed_s"] = round(time.perf_counter() - t0, 1)
+    return record
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser("failover_run")
+    parser.add_argument("--rows", type=int, default=100_000)
+    parser.add_argument("--iters", type=int, default=10)
+    parser.add_argument("--timeout", type=float, default=300.0)
+    parser.add_argument("--out", default=None,
+                        help="record path (default: next "
+                             "bench_results/failover_rNN.json)")
+    args = parser.parse_args()
+    record = run(args.rows, args.iters, args.timeout)
+    path = args.out or next_record_path()
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(record, f, indent=1)
+        f.write("\n")
+    print(json.dumps({"record": os.path.relpath(path, ROOT), **record}))
+    return 0 if record["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -633,6 +633,19 @@ def lint_file(path: pathlib.Path) -> list[str]:
                     "with empty help text — /metrics is an operator "
                     "surface; describe the series "
                     "(docs/observability.md)")
+        elif (isinstance(node, ast.Call) and "horaedb_tpu" in path.parts
+                and path.name not in _PROMOTE_OWNERS
+                and _promote_call(node)):
+            src = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+            if "noqa" not in src:
+                problems.append(
+                    f"{path}:{node.lineno}: promote() called outside "
+                    "its declared owners — election ownership is the "
+                    "StandbyMonitor (cluster/replication.py) and "
+                    "PlacementController.promote_region "
+                    "(cluster/placement.py); everything else (tests "
+                    "aside) must go through them so exactly one code "
+                    "path can take a region's lease")
     if "wal" in path.parts and "horaedb_tpu" in path.parts:
         problems.extend(_lint_wal_module(path, tree, lines))
     if ("horaedb_tpu" in path.parts
@@ -649,6 +662,24 @@ def _is_call_to(node: ast.Call, mod: str, attr: str) -> bool:
             and node.func.attr == attr
             and isinstance(node.func.value, ast.Name)
             and node.func.value.id == mod)
+
+
+# promote() call sites allowed under horaedb_tpu/: the module defining
+# it (whose StandbyMonitor is THE election path) and the placement
+# controller's promotion seam.  tests/ and tools/ are outside the
+# horaedb_tpu package and unaffected.
+_PROMOTE_OWNERS = {"replication.py", "placement.py"}
+
+
+def _promote_call(node: ast.Call) -> bool:
+    """A call spelled `promote(...)` or `<obj>.promote(...)` — the
+    lease-acquiring failover entry point (cluster/replication.py)."""
+    func = node.func
+    if isinstance(func, ast.Name):
+        return func.id == "promote"
+    if isinstance(func, ast.Attribute):
+        return func.attr == "promote"
+    return False
 
 
 def _lint_wal_module(path: pathlib.Path, tree: ast.AST,
