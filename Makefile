@@ -39,6 +39,9 @@ SCANAGENT_SCHEDULES ?= 15
 MESH_SEED ?= 1337
 MESH_SCHEDULES ?= 12
 
+MESHDECODE_SEED ?= 1337
+MESHDECODE_SCHEDULES ?= 10
+
 REPL_SEED ?= 1337
 REPL_SCHEDULES ?= 10
 
@@ -65,6 +68,8 @@ chaos:
 	SCANAGENT_SCHEDULES=$(SCANAGENT_SCHEDULES) \
 	MESH_SEED=$(MESH_SEED) \
 	MESH_SCHEDULES=$(MESH_SCHEDULES) \
+	MESHDECODE_SEED=$(MESHDECODE_SEED) \
+	MESHDECODE_SCHEDULES=$(MESHDECODE_SCHEDULES) \
 	REPL_SEED=$(REPL_SEED) \
 	REPL_SCHEDULES=$(REPL_SCHEDULES) \
 	FAILOVER_SEED=$(FAILOVER_SEED) \
@@ -75,7 +80,7 @@ chaos:
 	tests/test_pipeline.py tests/test_combine.py \
 	tests/test_tenant.py tests/test_device_decode.py \
 	tests/test_scanagent.py tests/test_mesh_scan.py \
-	tests/test_replication.py -q
+	tests/test_mesh_decode.py tests/test_replication.py -q
 
 # stdlib AST lint gate (the reference CI runs fmt+clippy -D warnings;
 # this image ships no ruff/flake8, so the gate is tools/lint.py)

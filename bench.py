@@ -30,9 +30,11 @@ standing-rollup dashboard mix vs the raw cold scan, 12 the
 background-plane overhead A/B, 13 the pipelined cold-scan ladder
 vs the [scan.pipeline] off control, 14 the sparse-combine/top-k/memo
 ladder, 15 the open-loop multi-tenant SLO harness, 16 the
-device-native decode A/B vs the [scan.decode] host control, and 17
+device-native decode A/B vs the [scan.decode] host control, 17
 the near-data scan-agent dashboard mix — agent-served partials vs
-shipped segments over the seeded fault store).
+shipped segments over the seeded fault store, 19 the 2-D mesh-scan
+A/B, and 22 the mesh-placed fused-decode A/B — stored bytes to
+ranked answer vs the PR 15 mesh vs the single-chip control).
 """
 
 import asyncio
@@ -537,7 +539,7 @@ def main() -> None:
     try:
         config = int(os.environ.get("BENCH_CONFIG", 1))
     except ValueError:
-        sys.exit(f"BENCH_CONFIG must be 0-21, got "
+        sys.exit(f"BENCH_CONFIG must be 0-22, got "
                  f"{os.environ.get('BENCH_CONFIG')!r}")
 
     ensure_responsive_backend()
@@ -553,7 +555,7 @@ def main() -> None:
         from horaedb_tpu.bench.suite import RUNNERS
 
         if config not in RUNNERS:
-            sys.exit(f"BENCH_CONFIG must be 0-21, got {config}")
+            sys.exit(f"BENCH_CONFIG must be 0-22, got {config}")
         result = RUNNERS[config](rows, iters)
     # a config's own backend/fallback labels win (config 6 is pure host
     # work and must never read as a device number)

@@ -4449,12 +4449,304 @@ def run_config21(rows: int, iters: int) -> dict:
     }
 
 
+def run_config22(rows: int, iters: int) -> dict:
+    """The mesh-placed fused-decode A/B (ISSUE 19, `make
+    multichip-mesh`): one device program from stored bytes to ranked
+    answer — per-round shard_map dispatches fed RAW ENCODED sidecar
+    buffers (leaf-filter + k-way merge-dedup + bucket-aggregate +
+    segmented combine in one jit) vs the PR 15 mesh over host-decoded
+    windows vs the single-chip control, all on the SAME data and all
+    forced onto the XLA window kernel (HORAEDB_HOST_AGG=0 /
+    HORAEDB_FUSED_AGG=0) so the A/B isolates decode+combine placement.
+
+    Legs (cold = caches cleared per rep, grids byte-compared in-bench):
+      control_cold     no mesh, host decode (single-chip)
+      mesh_cold        [scan.mesh] rounds, host decode (PR 15)
+      meshdecode_cold  [scan.mesh] rounds from encoded bytes (ISSUE 19)
+      additive top-k   count-ranked winners through the compensated
+                       (hi, lo) device score plane — egress cells
+                       counter-asserted at O(k x buckets x aggs) per
+                       run part, at TWO group cardinalities (100 and
+                       800 hosts) so the bound provably does not scale
+                       with the group count
+
+    Half the segments get a second overlapping write so multi-SST
+    interleaved segments ride the device k-way merge (route="kway"
+    asserted, the full device lax.sort asserted NEVER paid).
+
+    The wall claim is honest per the recorded note: on this CPU
+    virtual-device rung all shards share 2 physical cores, so the XLA
+    single-chip control leg is the meaningful wall reference and the
+    pod-scale wall re-grades on real chips (tpu_verified discipline)."""
+    import os
+
+    import pyarrow as pa
+
+    from horaedb_tpu.common import ReadableDuration
+    from horaedb_tpu.common import runtimes as runtimes_mod
+    from horaedb_tpu.objstore import MemoryObjectStore
+    from horaedb_tpu.ops import device_decode as dd_mod
+    from horaedb_tpu.storage import read as read_mod
+    from horaedb_tpu.storage.config import (
+        StorageConfig,
+        ThreadsConfig,
+        from_dict,
+    )
+    from horaedb_tpu.storage.plan import TopKSpec
+    from horaedb_tpu.storage.read import AggregateSpec, ScanRequest
+    from horaedb_tpu.storage.storage import CloudObjectStorage, WriteRequest
+    from horaedb_tpu.storage.types import TimeRange
+
+    import jax
+
+    n_devices = len(jax.devices())
+    want_devices = int(os.environ.get("MESH_BENCH_DEVICES", "0") or 0)
+    if want_devices and n_devices < want_devices:
+        _log(f"config22: only {n_devices} devices visible "
+             f"(wanted {want_devices}) — the mesh will be smaller")
+
+    hosts = 100
+    hosts_big = 800
+    segment_ms = 2 * 3600 * 1000
+    segments = 16
+    per_seg = max(hosts, rows // segments)
+    bucket_ms = 60_000
+    T0 = (1_700_000_000_000 // segment_ms) * segment_ms
+    span = segments * segment_ms
+    _check_i32_span(np.asarray([span]), "config22")
+    schema = pa.schema([("host", pa.string()), ("ts", pa.int64()),
+                        ("v", pa.float64())])
+    rng = np.random.default_rng(22)
+
+    def cfg_of(mesh: bool, decode: str):
+        scan: dict = {"cache_max_rows": rows * 4,
+                      "combine": {"memo_max_bytes": 0},
+                      "cache": {"tier2_max_bytes": 1 << 30},
+                      "decode": {"mode": decode}}
+        if mesh:
+            scan["mesh"] = {"enabled": True}
+        cfg = from_dict(StorageConfig, {
+            "scheduler": {"schedule_interval": "1h"}, "scan": scan})
+        cfg.manifest.merge_interval = ReadableDuration.parse("1h")
+        cfg.scrub.interval = ReadableDuration.parse("1h")
+        return cfg
+
+    forced = {}
+    for key in ("HORAEDB_HOST_AGG", "HORAEDB_FUSED_AGG"):
+        forced[key] = os.environ.get(key)
+        os.environ[key] = "0"
+
+    async def fill(s, n_hosts, n_rows_per, overlap=True):
+        for seg in range(segments):
+            passes = [n_rows_per]
+            if overlap and seg % 2:
+                # second overlapping SST: the k-way merge's territory
+                passes.append(max(n_hosts, n_rows_per // 8))
+            for n in passes:
+                ts = T0 + seg * segment_ms + rng.integers(
+                    0, segment_ms - 1000, n).astype(np.int64)
+                ts.sort()
+                names = [f"host_{i:03d}" for i in
+                         rng.integers(0, n_hosts, n)]
+                vals = rng.random(n) * 100
+                b = pa.record_batch(
+                    [pa.array(names), pa.array(ts),
+                     pa.array(vals, type=pa.float64())], schema=schema)
+                await s.write(WriteRequest(
+                    b, TimeRange.new(int(ts[0]), int(ts[-1]) + 1)))
+
+    async def go():
+        rt = runtimes_mod.from_config(ThreadsConfig())
+        store = MemoryObjectStore()
+        s_ctl = await CloudObjectStorage.open(
+            "db", segment_ms, store, schema, 2, cfg_of(False, "host"),
+            runtimes=rt)
+        await fill(s_ctl, hosts, per_seg)
+        s_mesh = await CloudObjectStorage.open(
+            "db", segment_ms, store, schema, 2, cfg_of(True, "host"),
+            runtimes=rt)
+        s_dec = await CloudObjectStorage.open(
+            "db", segment_ms, store, schema, 2, cfg_of(True, "device"),
+            runtimes=rt)
+        lo, hi = T0, T0 + span
+        spec = AggregateSpec(
+            group_col="host", ts_col="ts", value_col="v",
+            range_start=lo, bucket_ms=bucket_ms,
+            num_buckets=span // bucket_ms, which=("avg", "max"))
+        req = ScanRequest(range=TimeRange.new(lo, hi))
+
+        def clear(s):
+            s.reader.scan_cache.clear()
+            s.reader.encoded_cache.clear()
+            s.reader.parts_memo.clear()
+            s.reader._stack_cache.clear()
+            s.reader._stack_cache_bytes = 0
+
+        reps = max(3, iters // 3)
+
+        async def leg(s, tk=None, sp=None, rq=None, n=reps):
+            times, out = [], None
+            for _ in range(n):
+                clear(s)
+                t0 = time.perf_counter()
+                out = await s.scan_aggregate(rq or req, sp or spec,
+                                             top_k=tk)
+                times.append(time.perf_counter() - t0)
+            return float(np.median(times) * 1e3), out
+
+        def same(a, b, ctx):
+            assert np.array_equal(a[0], b[0]), ctx
+            for k in a[1]:
+                assert np.asarray(a[1][k]).tobytes() == \
+                    np.asarray(b[1][k]).tobytes(), (ctx, k)
+
+        ctl_ms, ctl_out = await leg(s_ctl)
+        rounds0 = read_mod._MESH_ROUNDS.value
+        mesh_ms, mesh_out = await leg(s_mesh)
+        mesh_rounds = int(read_mod._MESH_ROUNDS.value - rounds0)
+        rounds0 = read_mod._MESH_ROUNDS.value
+        kway0 = dd_mod._SORT_SKIPPED["kway"].value
+        sorted0 = dd_mod._SORT_RAN.value
+        drows0 = dd_mod._STAGE_ROWS.value
+        dec_ms, dec_out = await leg(s_dec)
+        dec_rounds = int(read_mod._MESH_ROUNDS.value - rounds0)
+        kway_skips = int(dd_mod._SORT_SKIPPED["kway"].value - kway0)
+        full_sorts = int(dd_mod._SORT_RAN.value - sorted0)
+        dec_rows = int(dd_mod._STAGE_ROWS.value - drows0)
+        assert mesh_rounds > 0, "mesh leg never dispatched a round"
+        assert dec_rounds > 0, \
+            "fused-decode leg never dispatched a mesh round"
+        assert dec_rows > 0, "fused-decode leg never decoded on device"
+        # the k-way routing evidence: overlapped segments merged their
+        # presorted runs on device, the full lax.sort never paid
+        assert kway_skips > 0, "no segment took the k-way merge route"
+        assert full_sorts == 0, \
+            f"{full_sorts} dispatches paid the full device sort"
+        # in-bench bit-identity across ALL THREE legs
+        same(ctl_out, mesh_out, "control vs mesh")
+        same(ctl_out, dec_out, "control vs mesh+decode")
+
+        # additive top-k egress at two group cardinalities (count is
+        # admissible against any agg set; decode stays host on this
+        # leg — the topk_decode gate keeps mixed provenance out of
+        # device scoring by design)
+        tk = TopKSpec(k=5, by="count")
+
+        async def additive_leg(s, sp, rq):
+            clear(s)
+            served0 = read_mod._MESH_TOPK.value
+            cells0 = read_mod._MESH_PART_CELLS.value
+            tk_ms, tk_out = await leg(s, tk=tk, sp=sp, rq=rq, n=1)
+            assert read_mod._MESH_TOPK.value == served0 + 1, \
+                "additive top-k not device-served"
+            return tk_ms, tk_out, int(
+                read_mod._MESH_PART_CELLS.value - cells0)
+
+        topk_ms, topk_out, cells_small = await additive_leg(
+            s_mesh, spec, req)
+        _ctl_ms, ctl_topk = await leg(s_ctl, tk=tk, n=1)
+        same(ctl_topk, topk_out, "control vs additive topk")
+        # cardinality 2: same segments/span/k, 8x the hosts
+        store2 = MemoryObjectStore()
+        s2_ctl = await CloudObjectStorage.open(
+            "db", segment_ms, store2, schema, 2, cfg_of(False, "host"),
+            runtimes=rt)
+        await fill(s2_ctl, hosts_big, max(hosts_big, per_seg // 4),
+                   overlap=False)
+        s2_mesh = await CloudObjectStorage.open(
+            "db", segment_ms, store2, schema, 2, cfg_of(True, "host"),
+            runtimes=rt)
+        _ms2, topk2_out, cells_big = await additive_leg(
+            s2_mesh, spec, req)
+        _c2, ctl2_topk = await leg(s2_ctl, tk=tk, n=1)
+        same(ctl2_topk, topk2_out, "control vs additive topk (800)")
+        # parts x k x run width x grid kinds; parts = 16 + 8 overlap
+        # runs on the small store, 16 on the big one
+        bound = 24 * tk.k * spec.num_buckets * 8
+        assert cells_small <= bound, (cells_small, bound)
+        assert cells_big <= bound, (cells_big, bound)
+        # THE additive acceptance bound: winner egress must not scale
+        # with the group count (the score vector is counted
+        # separately) — 8x the hosts, same ceiling
+        assert cells_big <= cells_small * 2, (cells_small, cells_big)
+
+        mesh_stats = s_dec.reader.mesh_stats()
+        shape = mesh_stats["shape"]
+        out = {
+            "metric": (f"mesh fused decode: full-span avg/max "
+                       f"downsample over {segments} segments "
+                       f"(8 multi-SST), "
+                       f"{per_seg * segments / 1e6:.1f}M rows, "
+                       f"{shape['time']}x{shape['series']} mesh, "
+                       f"stored-bytes-to-answer cold p50"),
+            "value": round(dec_ms, 1),
+            "unit": "ms",
+            "vs_baseline": round(dec_ms / ctl_ms, 4),
+            "rows": per_seg * segments,
+            "control_cold_p50_ms": round(ctl_ms, 1),
+            "mesh_cold_p50_ms": round(mesh_ms, 1),
+            "meshdecode_cold_p50_ms": round(dec_ms, 1),
+            "meshdecode_vs_mesh": round(dec_ms / mesh_ms, 4),
+            "additive_topk_p50_ms": round(topk_ms, 1),
+            "mesh_shape": shape,
+            "mesh_rounds": mesh_rounds,
+            "meshdecode_rounds": dec_rounds,
+            "device_decoded_rows": dec_rows,
+            "kway_merge_dispatches": kway_skips,
+            "full_device_sorts": full_sorts,
+            "additive_topk_cells_100": cells_small,
+            "additive_topk_cells_800": cells_big,
+            "additive_topk_bound": bound,
+            "additive_topk_dense_cells_800": (
+                hosts_big * spec.num_buckets * 2),
+            "mesh_stalls": mesh_stats["stalls"],
+            "mesh_fallbacks": mesh_stats["fallbacks"],
+            "bit_identical": True,
+            "note": ("CPU virtual-device rung — wall caveat: the "
+                     "multichip_r02 271ms cold-p50 bar is NOT met "
+                     "here and cannot be on this box. All shards "
+                     "share 2 physical cores, and XLA-on-CPU runs "
+                     "the fused decode kernels interpreted-slow: "
+                     "bench_results/device_decode_r01.json already "
+                     "measured plain device decode ~3x the host "
+                     "decode wall on this rung (device_true_cold "
+                     "3379ms vs host 1206ms), which bounds every "
+                     "from-stored-bytes leg below. The single-chip "
+                     "XLA control leg recorded alongside is the "
+                     "honest wall reference; decode placement, k-way "
+                     "routing, zero full sorts, bit-identity, and "
+                     "the additive egress bound are structural and "
+                     "hold regardless. Re-grade walls on a real TPU "
+                     "pod — same command, tpu_verified discipline."),
+        }
+        _log(f"config22: control {ctl_ms:.0f}ms vs mesh {mesh_ms:.0f}ms "
+             f"vs mesh+decode {dec_ms:.0f}ms "
+             f"({shape['time']}x{shape['series']} mesh, "
+             f"{dec_rounds} fused rounds, {kway_skips} kway merges, "
+             f"{full_sorts} full sorts); additive topk egress "
+             f"{cells_small} -> {cells_big} cells at 100 -> 800 hosts")
+        for s in (s2_mesh, s2_ctl, s_dec, s_mesh, s_ctl):
+            await s.close()
+        rt.close()
+        return out
+
+    try:
+        return asyncio.run(go())
+    finally:
+        for key, old in forced.items():
+            if old is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = old
+
+
 RUNNERS = {2: run_config2, 3: run_config3, 4: run_config4, 5: run_config5,
            6: run_config6, 7: run_config7, 8: run_config8, 9: run_config9,
            10: run_config10, 11: run_config11, 12: run_config12,
            13: run_config13, 14: run_config14, 15: run_config15,
            16: run_config16, 17: run_config17, 18: run_config18,
-           19: run_config19, 20: run_config20, 21: run_config21}
+           19: run_config19, 20: run_config20, 21: run_config21,
+           22: run_config22}
 
 
 def main() -> None:

@@ -62,6 +62,7 @@ import jax.numpy as jnp
 
 from horaedb_tpu.ops import downsample
 from horaedb_tpu.ops import filter as filter_ops
+from horaedb_tpu.ops import merge as merge_ops
 from horaedb_tpu.ops.filter import (
     _const_code_exact,
     _const_code_lower,
@@ -88,6 +89,10 @@ FALLBACK_REASONS = (
     "range",           # epoch-to-range shift overflows int32
     "pallas_no_tpu",   # pallas impl failed off-TPU (interpret mode)
     "pallas_error",    # pallas impl failed ON TPU — a real kernel bug
+    "kway_runs",       # multi-run segment declined the k-way merge
+                       # (run boundaries unknown / runs not per-run
+                       # sorted / too many runs) — the dispatch still
+                       # decodes on device but pays the full lax.sort
 )
 
 _FALLBACKS = registry.counter(
@@ -108,9 +113,12 @@ _SORT_SKIPPED = {
         "fused decode dispatches that skipped the device lax.sort: "
         "compacted = single-run segment, (pk, seq)-sorted by "
         "construction (no host check either); checked = the one-pass "
-        "host sortedness check proved the concatenated runs sorted"
+        "host sortedness check proved the concatenated runs sorted; "
+        "kway = multi-run interleaved segment merged on device by the "
+        "presorted-run k-way merge (ops/merge.kway_merge_perm) instead "
+        "of the full sort"
     ).labels(route=route)
-    for route in ("compacted", "checked")
+    for route in ("compacted", "checked", "kway")
 }
 _SORT_RAN = registry.counter(
     "scan_decode_sorted_total",
@@ -139,6 +147,11 @@ _EDGE_NAMES = {_OP_LT: "lt", _OP_LE: "le", _OP_GT: "gt", _OP_GE: "ge"}
 # an In leaf beyond this many resolved codes would trace a (capacity x
 # k) compare — fall back to host decode instead of trading HBM for it
 _IN_MAX_CODES = 64
+
+# beyond this many presorted runs the k-way merge tree's log2(k) levels
+# of binary searches stop beating the full bitonic sort — decline to
+# the sort route (counted reason="kway_runs") instead
+_KWAY_MAX_RUNS = 64
 
 
 class _EmptyMatch(Exception):
@@ -331,39 +344,32 @@ def _lex_sorted_np(keys: list) -> bool:
     return True
 
 
-@functools.partial(jax.jit, static_argnames=(
-    "key_slots", "num_pks", "group_pos", "ts_pos", "val_slot",
-    "leaf_prog", "g_pad", "width", "which", "use_pallas", "presorted"))
-def _decode_aggregate_jit(cols: tuple, n_valid, leaf_consts: tuple,
-                          shift, lo, total, bucket_ms, *,
-                          key_slots: tuple, num_pks: int,
-                          group_pos: int, ts_pos: int,
-                          val_slot: int, leaf_prog: tuple,
-                          g_pad: int, width: int, which: tuple,
-                          use_pallas: bool, presorted: bool = False):
-    """THE fused dispatch: encoded columns in, partial grids out.
+def decode_rows_core(cols: tuple, n_valid, leaf_consts: tuple,
+                     run_offsets, *, key_slots: tuple, num_pks: int,
+                     group_pos: int, val_slot: int, leaf_prog: tuple,
+                     route: str, num_runs: int):
+    """The traced decode→filter→merge→dedup body, shared by the single
+    -device fused dispatch below and the mesh's per-slot program
+    (parallel/scan.mesh_decode_partials).  Returns (keys_s, gid,
+    val_s, n_rows): rows in (pk, seq)-sorted order with dropped rows
+    masked to gid = -1 — the exact shape window_local_partials expects
+    (ts rides in keys_s[ts_pos]).
 
-    `cols` is the tuple of uploaded int32 code columns (pad capacity);
-    `key_slots` indexes the sort keys into it — the first `num_pks`
-    are the PK code columns, then seq, then any non-PK group/ts column
-    (appended AFTER seq so they cannot perturb the dedup order; with
-    (pk, seq) effectively unique they only ride along to come back
-    sorted).  `group_pos`/`ts_pos` locate the group/ts columns inside
-    the sorted key outputs; `val_slot` indexes the f32 value column
-    (carried, not a key).  `leaf_prog` is the static (column-slot,
-    opcode) program from compile_leaves with `leaf_consts` its traced
-    constants.
-
-    Dropped rows (padding, leaf-filtered, dup-shadowed) are masked to
-    gid = -1, never compacted — static shapes, no host round trip.
-    Returns ({partial grids}, kept_rows)."""
+    `route` picks how rows reach sorted order:
+      presorted — they already are (host-checked / single run);
+      kway      — merge the `num_runs` presorted runs bounded by
+                  `run_offsets` on device (ops/merge.kway_merge_perm),
+                  then stably sink filter-failed rows so the valid
+                  prefix is BIT-identical to the sort route's;
+      sorted    — the full variadic device sort (the counted fallback).
+    """
     cap = cols[0].shape[0]
     iota = jnp.arange(cap, dtype=jnp.int32)
     valid = iota < jnp.asarray(n_valid, jnp.int32)
     for (slot, op), c in zip(leaf_prog, leaf_consts):
         valid = valid & _leaf_mask(cols[slot], op, c)
 
-    if presorted:
+    if route == "presorted":
         # rows already arrive (pk, seq)-sorted (host-checked, the
         # single-SST/post-compaction shape): the run-boundary masks
         # below work in place.  Leaf-failed rows cannot split a run —
@@ -372,6 +378,30 @@ def _decode_aggregate_jit(cols: tuple, n_valid, leaf_consts: tuple,
         valid_s = valid
         keys_s = tuple(cols[i] for i in key_slots)
         val_s = cols[val_slot]
+    elif route == "kway":
+        # merge the presorted runs by (padding, pk..., seq, row): the
+        # padding bit keeps the trailing pad zone (its own run) last
+        # without perturbing within-run order, and strict/leq counting
+        # inside the merge supplies the row tiebreak.  Filter-failed
+        # rows then sink behind the valid prefix via a stable
+        # partition, so the prefix — the only thing the grids see —
+        # is bit-identical to the sort route's (~valid, keys, row)
+        # order.
+        pad_bit = (~(iota < jnp.asarray(n_valid, jnp.int32))) \
+            .astype(jnp.int32)
+        mkeys = (pad_bit,) + tuple(cols[i]
+                                   for i in key_slots[:num_pks + 1])
+        perm = merge_ops.kway_merge_perm(mkeys, run_offsets,
+                                         num_runs=num_runs)
+        valid_m = valid[perm]
+        vpos = jnp.cumsum(valid_m.astype(jnp.int32))
+        n_ok = vpos[-1]
+        ipos = jnp.cumsum((~valid_m).astype(jnp.int32))
+        pos = jnp.where(valid_m, vpos - 1, n_ok + ipos - 1)
+        part = jnp.zeros(cap, dtype=jnp.int32).at[pos].set(perm)
+        valid_s = valid[part]
+        keys_s = tuple(cols[i][part] for i in key_slots)
+        val_s = cols[val_slot][part]
     else:
         # sort by (invalid, pks..., seq, ..., row): invalid rows sink
         # as a block; the row index makes the key total, so equal-
@@ -380,7 +410,7 @@ def _decode_aggregate_jit(cols: tuple, n_valid, leaf_consts: tuple,
         operands = [(~valid).astype(jnp.int32)] \
             + [cols[i] for i in key_slots] + [iota, cols[val_slot]]
         n_keys = 2 + len(key_slots)
-        sorted_ops = jax.lax.sort(tuple(operands), num_keys=n_keys)
+        sorted_ops = merge_ops.lex_sort(tuple(operands), num_keys=n_keys)
         valid_s = sorted_ops[0] == 0
         keys_s = sorted_ops[1:1 + len(key_slots)]
         val_s = sorted_ops[-1]
@@ -395,8 +425,45 @@ def _decode_aggregate_jit(cols: tuple, n_valid, leaf_consts: tuple,
         [differs_next | ~valid_s[1:], jnp.ones(1, dtype=bool)])
 
     gid = jnp.where(kept, keys_s[group_pos], jnp.int32(-1))
-    ts_s = keys_s[ts_pos]
     n_rows = jnp.sum(kept.astype(jnp.int32))
+    return keys_s, gid, val_s, n_rows
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "key_slots", "num_pks", "group_pos", "ts_pos", "val_slot",
+    "leaf_prog", "g_pad", "width", "which", "use_pallas", "route",
+    "num_runs"))
+def _decode_aggregate_jit(cols: tuple, n_valid, leaf_consts: tuple,
+                          shift, lo, total, bucket_ms, run_offsets, *,
+                          key_slots: tuple, num_pks: int,
+                          group_pos: int, ts_pos: int,
+                          val_slot: int, leaf_prog: tuple,
+                          g_pad: int, width: int, which: tuple,
+                          use_pallas: bool, route: str = "sorted",
+                          num_runs: int = 0):
+    """THE fused dispatch: encoded columns in, partial grids out.
+
+    `cols` is the tuple of uploaded int32 code columns (pad capacity);
+    `key_slots` indexes the sort keys into it — the first `num_pks`
+    are the PK code columns, then seq, then any non-PK group/ts column
+    (appended AFTER seq so they cannot perturb the dedup order; with
+    (pk, seq) effectively unique they only ride along to come back
+    sorted).  `group_pos`/`ts_pos` locate the group/ts columns inside
+    the sorted key outputs; `val_slot` indexes the f32 value column
+    (carried, not a key).  `leaf_prog` is the static (column-slot,
+    opcode) program from compile_leaves with `leaf_consts` its traced
+    constants.  Row ordering/dedup semantics live in decode_rows_core
+    (shared with the mesh round program).
+
+    Dropped rows (padding, leaf-filtered, dup-shadowed) are masked to
+    gid = -1, never compacted — static shapes, no host round trip.
+    Returns ({partial grids}, kept_rows)."""
+    cap = cols[0].shape[0]
+    keys_s, gid, val_s, n_rows = decode_rows_core(
+        cols, n_valid, leaf_consts, run_offsets, key_slots=key_slots,
+        num_pks=num_pks, group_pos=group_pos, val_slot=val_slot,
+        leaf_prog=leaf_prog, route=route, num_runs=num_runs)
+    ts_s = keys_s[ts_pos]
     if use_pallas:
         from horaedb_tpu.ops.pallas_kernels import pallas_window_partials
 
@@ -528,11 +595,64 @@ def classify_pallas_failure() -> str:
     return "pallas_error" if on_tpu else "pallas_no_tpu"
 
 
-def prepare_dispatch(es, spec, pk_names: list, seq_name: str,
-                     leaves, max_bytes: int, width: int,
-                     pad_capacity) -> "DecodeDispatch | DevicePart | str":
+@dataclass
+class DecodePlan:
+    """One segment's fused dispatch, PLANNED but not yet on the device:
+    all gates passed, leaves compiled, routing decided, geometry
+    computed — no upload issued.  `execute_plan` runs it standalone on
+    the default device; the mesh scheduler instead groups compatible
+    plans (same `static_key`) into one sharded per-round program
+    (read._run_mesh_decode_round), so decode shards along the time
+    axis with the aggregation instead of serializing ahead of it."""
+
+    es: object
+    cap: int
+    shift: int
+    lo: int
+    local_ok: bool
+    use_width: int
+    w_eff: int
+    g: int
+    g_pad: int
+    values: object            # the group dictionary (host array)
+    upload_names: list
+    key_slots: tuple
+    num_pks: int
+    group_pos: int
+    ts_pos: int
+    val_slot: int
+    leaf_prog: tuple
+    consts: tuple             # host int32 arrays, one per leaf
+    route: str                # "presorted" | "kway" | "sorted"
+    run_offsets: Optional[np.ndarray]
+    num_runs: int
+    which: tuple
+    bucket_ms: int
+    num_buckets: int
+
+    @property
+    def n_valid(self) -> int:
+        # windows-list accounting parity (DeviceBatch/DevicePart ride
+        # the same lists): source rows, pre-filter/dedup
+        return self.es.n
+
+    def static_key(self) -> tuple:
+        """Everything that must match for two plans to share one
+        compiled mesh-round program (traced-constant SHAPES included:
+        leaf const arrays stack across slots)."""
+        return (self.key_slots, self.num_pks, self.group_pos,
+                self.ts_pos, self.val_slot, self.leaf_prog,
+                tuple(len(c) for c in self.consts), self.route,
+                self.num_runs, self.local_ok, len(self.upload_names),
+                self.which)
+
+
+def plan_dispatch(es, spec, pk_names: list, seq_name: str,
+                  leaves, max_bytes: int, width: int,
+                  pad_capacity) -> "DecodePlan | DevicePart | str":
     """Validate one EncodedSegment against the fused program's layout
-    and dispatch it.  Returns a DecodeDispatch (in flight), a DevicePart
+    and plan its dispatch WITHOUT touching the device.  Returns a
+    DecodePlan (ready to execute or to join a mesh round), a DevicePart
     (provably-empty segment, no dispatch), or a fallback reason string
     (the caller counts it and takes the host path)."""
     encs = es.encodings
@@ -592,18 +712,42 @@ def prepare_dispatch(es, spec, pk_names: list, seq_name: str,
     # post-compaction steady state) is (pk, seq)-sorted by
     # construction — both write paths sort before the SST put and
     # compaction emits merge-sorted — so it routes sort-free without
-    # even the one-pass host check; multi-run segments pay the check,
-    # and only segments it cannot prove sorted pay the device
-    # lax.sort.  Routed-vs-sorted is counted per segment.
+    # even the one-pass host check; multi-run segments pay the check;
+    # interleaved multi-run segments with known per-run boundaries
+    # k-way-merge the presorted runs on device (row tiebreak
+    # preserved, grids byte-identical); only segments neither route
+    # admits pay the device lax.sort, counted reason="kway_runs".
+    route = "sorted"
+    run_offsets = None
+    num_runs = 0
+    key_arrs = [es.columns[nm] for nm in pk_names] \
+        + [es.columns[seq_name]]
     if es.source_runs == 1:
-        presorted = True
+        route = "presorted"
         _SORT_SKIPPED["compacted"].inc()
+    elif _lex_sorted_np(key_arrs):
+        route = "presorted"
+        _SORT_SKIPPED["checked"].inc()
     else:
-        presorted = _lex_sorted_np(
-            [es.columns[nm] for nm in pk_names] + [es.columns[seq_name]])
-        if presorted:
-            _SORT_SKIPPED["checked"].inc()
+        rl = getattr(es, "run_lengths", None)
+        offs = None
+        if rl and 1 < len(rl) <= _KWAY_MAX_RUNS \
+                and sum(rl) == es.n:
+            offs = np.cumsum(np.asarray((0,) + tuple(rl),
+                                        dtype=np.int64))
+            if not merge_ops.runs_lex_sorted_np(key_arrs, offs):
+                offs = None
+        if offs is not None:
+            route = "kway"
+            # runs + the trailing pad zone as its own run, padded to a
+            # power of two with empty runs (static merge-tree depth)
+            num_runs = 1 << max(1, int(len(rl))).bit_length()
+            run_offsets = np.full(num_runs + 1, cap, dtype=np.int32)
+            run_offsets[:len(offs)] = offs
+            run_offsets[len(rl)] = es.n  # real runs end at n
+            _SORT_SKIPPED["kway"].inc()
         else:
+            note_fallback("kway_runs")
             _SORT_RAN.inc()
     local_ok = ts_enc.kind == "offset"
     lo = max(0, shift // spec.bucket_ms) if local_ok else 0
@@ -611,33 +755,51 @@ def prepare_dispatch(es, spec, pk_names: list, seq_name: str,
     g = len(g_enc.dictionary)
     g_pad = max(8, 1 << (g - 1).bit_length())
     w_eff = min(use_width, spec.num_buckets - lo)
-
-    t0 = time.perf_counter()
-    upload_bytes = 0
-    cols_dev = []
-    for nm in upload_names:
-        arr = es.columns[nm]
-        padded = np.zeros(cap, dtype=arr.dtype)  # calloc: tail free
-        padded[:es.n] = arr
-        upload_bytes += int(padded.nbytes)
-        cols_dev.append(jax.device_put(padded))
     key_slots = tuple(slot_of[nm] for nm in key_names)
     # group/ts positions INSIDE the sorted key outputs
     group_pos = key_names.index(spec.group_col)
     ts_pos = key_names.index(spec.ts_col)
     leaf_prog = tuple((slot_of[c], op) for c, op in prog)
-    consts_dev = tuple(jnp.asarray(c) for c in consts)
+    return DecodePlan(
+        es=es, cap=cap, shift=shift, lo=lo, local_ok=local_ok,
+        use_width=use_width, w_eff=w_eff, g=g, g_pad=g_pad,
+        values=g_enc.dictionary, upload_names=upload_names,
+        key_slots=key_slots, num_pks=len(pk_names),
+        group_pos=group_pos, ts_pos=ts_pos,
+        val_slot=slot_of[spec.value_col], leaf_prog=leaf_prog,
+        consts=consts, route=route, run_offsets=run_offsets,
+        num_runs=num_runs, which=spec.which,
+        bucket_ms=spec.bucket_ms, num_buckets=spec.num_buckets)
+
+
+def execute_plan(dp: DecodePlan) -> DecodeDispatch:
+    """Upload one planned segment and issue its fused dispatch on the
+    default device — the single-device tail of the old prepare path
+    and the per-item fallback when a mesh round declines a plan."""
+    es = dp.es
+    t0 = time.perf_counter()
+    upload_bytes = 0
+    cols_dev = []
+    for nm in dp.upload_names:
+        arr = es.columns[nm]
+        padded = np.zeros(dp.cap, dtype=arr.dtype)  # calloc: tail free
+        padded[:es.n] = arr
+        upload_bytes += int(padded.nbytes)
+        cols_dev.append(jax.device_put(padded))
+    consts_dev = tuple(jnp.asarray(c) for c in dp.consts)
+    offs_dev = jnp.int32(0) if dp.run_offsets is None \
+        else jnp.asarray(dp.run_offsets)
 
     def run(pallas: bool):
         return _decode_aggregate_jit(
             tuple(cols_dev), es.n, consts_dev,
-            np.int32(shift), np.int32(lo), np.int32(spec.num_buckets),
-            np.int32(spec.bucket_ms),
-            key_slots=key_slots, num_pks=len(pk_names),
-            group_pos=group_pos, ts_pos=ts_pos,
-            val_slot=slot_of[spec.value_col], leaf_prog=leaf_prog,
-            g_pad=g_pad, width=use_width, which=spec.which,
-            use_pallas=pallas, presorted=presorted)
+            np.int32(dp.shift), np.int32(dp.lo),
+            np.int32(dp.num_buckets), np.int32(dp.bucket_ms), offs_dev,
+            key_slots=dp.key_slots, num_pks=dp.num_pks,
+            group_pos=dp.group_pos, ts_pos=dp.ts_pos,
+            val_slot=dp.val_slot, leaf_prog=dp.leaf_prog,
+            g_pad=dp.g_pad, width=dp.use_width, which=dp.which,
+            use_pallas=pallas, route=dp.route, num_runs=dp.num_runs)
 
     if use_pallas_partials():
         try:
@@ -651,7 +813,19 @@ def prepare_dispatch(es, spec, pk_names: list, seq_name: str,
     else:
         outs, n_rows = run(False)
     return DecodeDispatch(outs=outs, n_rows=n_rows,
-                          values=g_enc.dictionary, lo=lo, w_eff=w_eff,
-                          bucket_ms=spec.bucket_ms,
+                          values=dp.values, lo=dp.lo, w_eff=dp.w_eff,
+                          bucket_ms=dp.bucket_ms,
                           t_dispatch=time.perf_counter() - t0,
                           upload_bytes=upload_bytes, src_rows=es.n)
+
+
+def prepare_dispatch(es, spec, pk_names: list, seq_name: str,
+                     leaves, max_bytes: int, width: int,
+                     pad_capacity) -> "DecodeDispatch | DevicePart | str":
+    """plan_dispatch + execute_plan in one step — the non-mesh entry
+    point (and the shape every existing caller/test expects)."""
+    dp = plan_dispatch(es, spec, pk_names, seq_name, leaves, max_bytes,
+                       width, pad_capacity)
+    if not isinstance(dp, DecodePlan):
+        return dp
+    return execute_plan(dp)

@@ -58,6 +58,121 @@ def merge_impl() -> str:
 set_merge_impl(os.environ.get("HORAEDB_MERGE_IMPL", "host_perm"))
 
 
+def lex_sort(operands: tuple, num_keys: int,
+             is_stable: bool = False) -> tuple:
+    """THE `jax.lax.sort` seam: every variadic lexicographic device sort
+    in the engine goes through here (tools/lint.py errors on `lax.sort`
+    call sites outside this module), so the sort-vs-merge choice lives
+    in one place and A/B instrumentation wraps one function."""
+    return jax.lax.sort(tuple(operands), num_keys=num_keys,
+                        is_stable=is_stable)
+
+
+def _lex_less(ks: tuple, idx: jax.Array, xs: tuple):
+    """Vectorized lexicographic compare of ks[:, idx] against xs[:, j]
+    per slot j.  Returns (lt, eq) boolean arrays."""
+    lt = jnp.zeros(idx.shape, dtype=bool)
+    eq = jnp.ones(idx.shape, dtype=bool)
+    for kcol, xcol in zip(ks, xs):
+        probe = kcol[idx]
+        lt = lt | (eq & (probe < xcol))
+        eq = eq & (probe == xcol)
+    return lt, eq
+
+
+@functools.partial(jax.jit, static_argnames=("num_runs",))
+def _kway_merge_perm_impl(keys: tuple, offsets: jax.Array, num_runs: int):
+    cap = keys[0].shape[0]
+    iota = jnp.arange(cap, dtype=jnp.int32)
+    # run of each original row; padded runs are empty so searchsorted
+    # lands rows on the LAST run starting at-or-before them
+    run_of = jnp.clip(
+        jnp.searchsorted(offsets, iota, side="right").astype(jnp.int32) - 1,
+        0, num_runs - 1)
+    # within-run order IS sorted order (the caller's contract), and runs
+    # are contiguous ascending — so the identity permutation is the
+    # level-0 "sorted within every block" state
+    perm = iota
+    n_steps = max(1, cap - 1).bit_length() + 1
+    level = 1
+    while level < num_runs:
+        ks = tuple(k[perm] for k in keys)  # keys in block-sorted order
+        # elements never leave their block's row range, so the block of
+        # slot j is the block of its element's original run
+        blk = run_of[perm] // level
+        p = blk >> 1
+        base = 2 * level * p
+        start = offsets[base]
+        mid = offsets[base + level]
+        end = offsets[base + 2 * level]
+        in_a = iota < mid
+        # merged rank of slot j's element within its pair block:
+        #   A-side: own offset + |{b in B : key(b) <  key(j)}|
+        #   B-side: own offset + |{a in A : key(a) <= key(j)}|
+        # (runs are contiguous, so every B row index exceeds every A row
+        # index — strict/leq encodes the original-row tiebreak exactly)
+        lo = jnp.where(in_a, mid, start)
+        hi = jnp.where(in_a, end, mid)
+        for _ in range(n_steps):
+            active = lo < hi
+            probe = jnp.clip((lo + hi) // 2, 0, cap - 1)
+            p_lt, p_eq = _lex_less(ks, probe, ks)
+            go = active & jnp.where(in_a, p_lt, p_lt | p_eq)
+            lo = jnp.where(go, probe + 1, lo)
+            hi = jnp.where(go | ~active, hi, probe)
+        new_slot = jnp.where(in_a,
+                             iota + (lo - mid),
+                             (iota - mid) + lo)
+        perm = jnp.zeros(cap, dtype=jnp.int32).at[new_slot].set(perm)
+        level *= 2
+    return perm
+
+
+def kway_merge_perm(keys: tuple, offsets, *, num_runs: int) -> jax.Array:
+    """Permutation that stably merges `num_runs` presorted runs — the
+    k-way replacement for the full variadic device sort when the store
+    already delivers (pk, seq)-sorted per-SST runs.
+
+    Args:
+      keys: int32 arrays (capacity,), compare-priority order.  Rows of
+        run r (indices [offsets[r], offsets[r+1])) must already be
+        sorted lexicographically by `keys`, equal keys in row order.
+      offsets: int32 (num_runs + 1,), non-decreasing, offsets[0] == 0,
+        offsets[-1] == capacity.  Empty runs allowed — pad the run
+        count to a power of two with empty runs to keep it static.
+      num_runs: static run count (power of two).
+
+    Returns perm (capacity,) int32 such that gathering rows by `perm`
+    yields the stable sort by (keys..., original row index): a
+    log2(num_runs)-level pairwise merge tree where each level ranks
+    elements by their in-block position plus a lexicographic binary
+    search over the partner block — O(n · log n · log k) compares
+    instead of the sort's O(n · log² n) full key shuffles.
+    """
+    return _kway_merge_perm_impl(
+        tuple(keys), jnp.asarray(offsets, dtype=jnp.int32),
+        num_runs=num_runs)
+
+
+def runs_lex_sorted_np(key_cols: list, offsets) -> bool:
+    """Host-side admission check for `kway_merge_perm`: every run is
+    individually lex-sorted by `key_cols` (numpy arrays).  O(n) per key
+    column — the per-run twin of the whole-segment sortedness probe."""
+    import numpy as np
+
+    for a, b in zip(offsets[:-1], offsets[1:]):
+        if b - a <= 1:
+            continue
+        later = np.zeros(b - a - 1, dtype=bool)
+        for col in key_cols:
+            seg = np.asarray(col[a:b])
+            cur, nxt = seg[:-1], seg[1:]
+            if ((cur > nxt) & ~later).any():
+                return False
+            later = later | (cur < nxt)
+    return True
+
+
 def sorted_run_starts(pk_cols: tuple, valid: jax.Array) -> jax.Array:
     """Boolean mask of primary-key run starts over sorted columns.
 

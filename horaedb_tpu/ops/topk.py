@@ -14,6 +14,74 @@ import jax
 import jax.numpy as jnp
 
 
+# ---------------------------------------------------------------------------
+# exact double-float (hi/lo) accumulation — the additive-top-k score plane
+# ---------------------------------------------------------------------------
+#
+# count/sum/avg rankings need the device score fold to reproduce the host
+# control's f64 part-fold BIT-exactly, or the winner set can differ at the
+# margin.  We borrow the rollup plane's compensated discipline: each cell's
+# running sum is an unevaluated f32 pair (hi, lo) maintained with Knuth
+# 2Sum, plus per-step flags that prove the pair still equals the exact sum.
+# When every step is provably exact, the host's f64 fold over the same
+# addends in the same order lands on the same value; any step that is NOT
+# provably exact sets a sticky `lossy` flag and the round downgrades to the
+# full-parts path (reason-counted, never silently wrong).
+
+
+def two_sum(a: jax.Array, b: jax.Array):
+    """Knuth 2Sum: s + e == a + b exactly (s = fl(a+b))."""
+    s = a + b
+    bv = s - a
+    av = s - bv
+    e = (a - av) + (b - bv)
+    return s, e
+
+
+def pair_add(hi: jax.Array, lo: jax.Array, x: jax.Array):
+    """Add f32 `x` into the (hi, lo) pair.
+
+    Returns (hi', lo', exact): `exact` is True when hi' + lo' provably
+    equals the exact real sum hi + lo + x AND the pair stays "dense"
+    enough that a host f64 fold of the same addends reproduces it.  The
+    density guard (|lo'| tiny relative to hi', or zero) rejects pairs
+    whose error term carries information beyond f64's 53-bit window —
+    e.g. 1.0 + (2^-53 + 2^-77): the pair holds it exactly, an f64
+    cannot, and equality with the host fold would break.  Over-flagging
+    only costs a counted downgrade, never a wrong answer.
+    """
+    s, e = two_sum(hi, x)
+    lo2, e1 = two_sum(lo, e)
+    hi2, lo3 = two_sum(s, lo2)
+    dense = (lo3 == 0.0) | (jnp.abs(lo3) * jnp.float32(2.0**28)
+                            >= jnp.abs(hi2))
+    exact = (e1 == 0.0) & dense & jnp.isfinite(hi2)
+    return hi2, lo3, exact
+
+
+def pair_max_normalized(hi: jax.Array, lo: jax.Array, mask: jax.Array,
+                        axis: int, largest: bool = True):
+    """Reduce (hi, lo) pairs along `axis` to the extreme REAL value.
+
+    two_sum-maintained pairs are normalized (|lo| <= ulp(hi)/2), so the
+    real-value order is the lexicographic (hi, lo) order: compare hi
+    first, break ties on lo.  Masked-out cells never win; if nothing is
+    masked in, the result is (-inf hi, 0 lo) [or +inf for smallest].
+    Returns (hi_ext, lo_ext).
+    """
+    if not largest:
+        h2, l2 = pair_max_normalized(-hi, -lo, mask, axis, largest=True)
+        return -h2, -l2
+    neg = jnp.float32(-jnp.inf)
+    mh = jnp.where(mask, hi, neg)
+    m_hi = jnp.max(mh, axis=axis, keepdims=True)
+    at_max = mask & (mh == m_hi)
+    m_lo = jnp.max(jnp.where(at_max, lo, neg), axis=axis,
+                   keepdims=True)
+    m_lo = jnp.where(jnp.isfinite(m_lo), m_lo, jnp.float32(0.0))
+    return (jnp.squeeze(m_hi, axis=axis), jnp.squeeze(m_lo, axis=axis))
+
+
 @functools.partial(jax.jit, static_argnames=("k", "largest"))
 def top_k_groups(scores: jax.Array, k: int, largest: bool = True):
     """Return (values, group_indices) of the top-k groups.

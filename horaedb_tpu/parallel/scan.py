@@ -65,7 +65,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from horaedb_tpu.common.error import Error
 from horaedb_tpu.ops import downsample, merge as merge_ops
-from horaedb_tpu.ops.topk import top_k_groups
+from horaedb_tpu.ops.topk import (pair_add, pair_max_normalized,
+                                  top_k_groups)
 from horaedb_tpu.parallel.mesh import SEGMENT_AXIS, SERIES_AXIS, TIME_AXIS
 
 
@@ -283,11 +284,7 @@ def mesh_run_partials(mesh, *, num_groups: int, num_buckets: int,
     (read.py _flush_mesh_round)."""
     time_n = int(mesh.shape[TIME_AXIS])
     series_n = int(mesh.shape[SERIES_AXIS])
-    if num_groups % series_n:
-        raise Error(
-            f"mesh group space {num_groups} not divisible by the "
-            f"series axis ({series_n}) — pad g to a multiple")
-    gb = num_groups // series_n
+    gb = _series_block(num_groups, series_n)
 
     def shard_fn(ts, gid, vals, remap, shift, lo, seg_ids, total,
                  bucket_ms):
@@ -296,33 +293,8 @@ def mesh_run_partials(mesh, *, num_groups: int, num_buckets: int,
             ts[0], gid[0], vals[0], remap[0], shift[0], lo[0], total,
             bucket_ms[0], num_groups=num_groups,
             num_buckets=num_buckets, which=which)
-        # full-width compute, series-block slice AFTER: the scatter
-        # program (and therefore every cell's f32 accumulation order)
-        # is the single-device kernel's; only the RESIDENT state and
-        # the collective payload shrink to the (gb, width) block
-        j = jax.lax.axis_index(SERIES_AXIS)
-        p = {k: jax.lax.dynamic_slice_in_dim(v, j * gb, gb, axis=0)
-             for k, v in p.items()}
-        sid = seg_ids  # (1,) block: ppermute needs an array operand
-        state = p
-        step = 1
-        while step < time_n:
-            perm = [(i, i + step) for i in range(time_n - step)]
-
-            def recv(a, _perm=perm):
-                return jax.lax.ppermute(a, TIME_AXIS, _perm)
-
-            prev = {k: recv(v) for k, v in state.items()}
-            prev_sid = recv(sid)
-            prev_live = recv(jnp.ones_like(sid))
-            # combine ONLY when the left neighbour's prefix belongs to
-            # this slot's segment (ppermute hands zeros to slots with
-            # no left neighbour — prev_live masks them out)
-            ok = (prev_live[0] > 0) & (prev_sid[0] == sid[0])
-            combined = downsample.combine_partial_pair(state, prev)
-            state = {k: jnp.where(ok, combined[k], state[k])
-                     for k in state}
-            step *= 2
+        p = _series_slice(p, gb)
+        state = _segmented_time_combine(p, seg_ids, time_n)
         return {k: v[None] for k, v in state.items()}
 
     mapped = shard_map(
@@ -331,6 +303,119 @@ def mesh_run_partials(mesh, *, num_groups: int, num_buckets: int,
                   P(TIME_AXIS, None), P(TIME_AXIS, None),
                   P(TIME_AXIS), P(TIME_AXIS), P(TIME_AXIS), P(), P()),
         out_specs=P(TIME_AXIS, SERIES_AXIS),
+        check_vma=False,
+    )
+    return jax.jit(mapped)
+
+
+def _series_block(num_groups: int, series_n: int) -> int:
+    if num_groups % series_n:
+        raise Error(
+            f"mesh group space {num_groups} not divisible by the "
+            f"series axis ({series_n}) — pad g to a multiple")
+    return num_groups // series_n
+
+
+def _series_slice(p: dict, gb: int) -> dict:
+    """Full-width compute, series-block slice AFTER: the scatter
+    program (and therefore every cell's f32 accumulation order) is the
+    single-device kernel's; only the RESIDENT state and the collective
+    payload shrink to the (gb, width) block."""
+    j = jax.lax.axis_index(SERIES_AXIS)
+    return {k: jax.lax.dynamic_slice_in_dim(v, j * gb, gb, axis=0)
+            for k, v in p.items()}
+
+
+def _segmented_time_combine(state: dict, seg_ids, time_n: int) -> dict:
+    """Inclusive SEGMENTED scan over the time axis via a log2(time)
+    ppermute tree: a slot folds in its left neighbour's prefix ONLY
+    when it belongs to the same segment (seg id match; ppermute hands
+    zeros to slots with no left neighbour — prev_live masks them out).
+    Shared by the host-decoded round program above and the fused
+    decode round program below — the combine IS the byte-identity
+    surface, so both programs must ride the same one."""
+    sid = seg_ids  # (1,) block: ppermute needs an array operand
+    step = 1
+    while step < time_n:
+        perm = [(i, i + step) for i in range(time_n - step)]
+
+        def recv(a, _perm=perm):
+            return jax.lax.ppermute(a, TIME_AXIS, _perm)
+
+        prev = {k: recv(v) for k, v in state.items()}
+        prev_sid = recv(sid)
+        prev_live = recv(jnp.ones_like(sid))
+        ok = (prev_live[0] > 0) & (prev_sid[0] == sid[0])
+        combined = downsample.combine_partial_pair(state, prev)
+        state = {k: jnp.where(ok, combined[k], state[k])
+                 for k in state}
+        step *= 2
+    return state
+
+
+def mesh_decode_partials(mesh, *, num_groups: int, num_buckets: int,
+                         which: tuple, key_slots: tuple, num_pks: int,
+                         group_pos: int, ts_pos: int, val_slot: int,
+                         leaf_prog: tuple, route: str, num_runs: int):
+    """The mesh-placed FUSED decode round: each time slot starts from
+    its segment's raw encoded sidecar buffers and runs leaf-filter →
+    (k-way merge | sort | presorted) → keep-last dedup → bucket
+    aggregate → ppermute segmented combine in ONE shard_map program —
+    decode shards along the time axis with the aggregation instead of
+    serializing ahead of it on one chip (ROADMAP item 1).
+
+    Static decode geometry (key_slots/leaf_prog/route/...) comes from
+    the round's DecodePlan group (ops/device_decode.plan_dispatch);
+    the dispatcher only batches plans whose DecodePlan.static_key()
+    agree, so one compiled program serves the whole round.
+
+    fn(cols, n_valid, leaf_consts, run_offsets, shift, lo, seg_ids,
+       total, bucket_ms):
+      cols: tuple of (time, capacity) int32 encoded code columns,
+        sharded on the time axis (one segment's buffers per slot);
+      n_valid: (time,) int32 real row counts (suffix is padding);
+      leaf_consts: tuple of (time, L_i) int32 leaf-constant stacks
+        (row t = slot t's constants for leaf i, padded by repetition);
+      run_offsets: (time, num_runs + 1) int32 per-slot run bounds
+        (all-capacity rows for non-kway routes ride along unused);
+      shift/lo/seg_ids: (time,) int32 as in mesh_run_partials;
+      total: replicated scalar global bucket count; bucket_ms: (1,).
+
+    Slot-local group codes ARE the round rows (identity remap): the
+    dispatcher gives same-segment slots a shared seg id only when
+    their dictionaries match, so the combine never mixes code spaces.
+    Output: (grids, kept) — grids as in mesh_run_partials (tails hold
+    whole runs), kept (time,) int32 post-dedup survivor counts."""
+    from horaedb_tpu.ops import device_decode
+
+    time_n = int(mesh.shape[TIME_AXIS])
+    series_n = int(mesh.shape[SERIES_AXIS])
+    gb = _series_block(num_groups, series_n)
+
+    def shard_fn(cols, n_valid, leaf_consts, run_offsets, shift, lo,
+                 seg_ids, total, bucket_ms):
+        _check_block_is_one(cols[0])
+        keys_s, gid, val_s, n_rows = device_decode.decode_rows_core(
+            tuple(c[0] for c in cols), n_valid[0],
+            tuple(c[0] for c in leaf_consts), run_offsets[0],
+            key_slots=key_slots, num_pks=num_pks, group_pos=group_pos,
+            val_slot=val_slot, leaf_prog=leaf_prog, route=route,
+            num_runs=num_runs)
+        p = downsample.window_local_partials(
+            keys_s[ts_pos], gid, val_s,
+            jnp.arange(num_groups, dtype=jnp.int32), shift[0], lo[0],
+            total, bucket_ms[0], num_groups=num_groups,
+            num_buckets=num_buckets, which=which)
+        p = _series_slice(p, gb)
+        state = _segmented_time_combine(p, seg_ids, time_n)
+        return ({k: v[None] for k, v in state.items()}, n_rows[None])
+
+    mapped = shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P(TIME_AXIS, None), P(TIME_AXIS),
+                  P(TIME_AXIS, None), P(TIME_AXIS, None),
+                  P(TIME_AXIS), P(TIME_AXIS), P(TIME_AXIS), P(), P()),
+        out_specs=(P(TIME_AXIS, SERIES_AXIS), P(TIME_AXIS)),
         check_vma=False,
     )
     return jax.jit(mapped)
@@ -422,6 +507,108 @@ def mesh_score_finalize(state: dict, *, largest: bool, num_buckets: int):
     else:
         scores = jnp.where(has, by_grid, jnp.inf).min(axis=1)
     return scores, has.any(axis=1)
+
+
+# ---- additive (count/sum/avg) score state ----------------------------------
+#
+# Additive rankings cannot reuse the selection state above: a prefix
+# slot's cells are NOT a subset of its run's — folding them would
+# double-count — and f32 cell adds across rounds drift from the host
+# control's f64 part-fold.  So the additive plane (a) folds TAIL slots
+# only (the dispatcher passes the tails mask), and (b) keeps each cell
+# as an exact (hi, lo) double-float pair (ops/topk.pair_add, the rollup
+# plane's compensated discipline): while every add is provably exact
+# AND f64-dense, host_f64_fold(same addends, same order) == hi + lo
+# bit-exactly, so the ranking the host computes from the downloaded
+# pair equals the mesh-off control's.  Any add that is not provably
+# exact sets the sticky `lossy` scalar and the query downgrades to the
+# full-parts path (reason-counted `additive_topk`) — never silently
+# wrong.
+
+
+def mesh_additive_init(num_groups: int, padded_buckets: int, by: str):
+    """Zero-filled additive score state for ranking by `by` (count /
+    sum / avg).  Same padded-bucket slack contract as mesh_score_init."""
+    shape = (num_groups, padded_buckets)
+    # distinct buffers per plane: the update donates the whole state,
+    # and donation rejects aliased arguments
+    z = lambda: jnp.zeros(shape, dtype=jnp.float32)
+    state = {"has": jnp.zeros(shape, dtype=bool),
+             "lossy": jnp.zeros((), dtype=bool)}
+    if by in ("count", "avg"):
+        state["cnt_hi"], state["cnt_lo"] = z(), z()
+    if by in ("sum", "avg"):
+        state["sum_hi"], state["sum_lo"] = z(), z()
+    return state
+
+
+@functools.partial(jax.jit, static_argnames=("by",), donate_argnums=(0,))
+def mesh_additive_update(state: dict, count_grid, sum_grid, tails, lo,
+                         *, by: str):
+    """Fold one round's (time, groups, width) outputs into the additive
+    state — TAIL slots only (`tails` is the (time,) run-tail mask; a
+    tail holds its whole run, prefixes would double-count).  Masked
+    slots add exact zeros (a canonical-pair no-op) and are excluded
+    from the lossy accounting."""
+    width = count_grid.shape[2]
+    planes = {"count": ("cnt",), "sum": ("sum",),
+              "avg": ("cnt", "sum")}[by]
+    grids = {"cnt": count_grid, "sum": sum_grid}
+
+    def body(t, st):
+        add = tails[t] & (count_grid[t] > 0)
+        out = dict(st)
+        for name in planes:
+            hi = jax.lax.dynamic_slice(
+                st[name + "_hi"], (0, lo[t]),
+                (st[name + "_hi"].shape[0], width))
+            lo_ = jax.lax.dynamic_slice(
+                st[name + "_lo"], (0, lo[t]),
+                (st[name + "_lo"].shape[0], width))
+            h2, l2, exact = pair_add(
+                hi, lo_, jnp.where(add, grids[name][t], 0.0))
+            out[name + "_hi"] = jax.lax.dynamic_update_slice(
+                st[name + "_hi"], h2, (0, lo[t]))
+            out[name + "_lo"] = jax.lax.dynamic_update_slice(
+                st[name + "_lo"], l2, (0, lo[t]))
+            out["lossy"] = out["lossy"] | jnp.any(add & ~exact)
+        cur_has = jax.lax.dynamic_slice(
+            st["has"], (0, lo[t]), (st["has"].shape[0], width))
+        out["has"] = jax.lax.dynamic_update_slice(
+            st["has"], cur_has | add, (0, lo[t]))
+        return out
+
+    return jax.lax.fori_loop(0, count_grid.shape[0], body, state)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("by", "largest", "num_buckets"))
+def mesh_additive_finalize(state: dict, *, by: str, largest: bool,
+                           num_buckets: int):
+    """Reduce the additive state to the download payload.
+
+    count/sum: the per-group extreme cell as an exact (hi, lo) pair —
+    normalized pairs order lexicographically, so the reduction is two
+    masked maxes — O(groups) egress like the selection path.  avg
+    needs a division the device cannot do bit-identically to the host,
+    so it returns the full (groups, buckets) pair grids for the host's
+    f64 sum/count divide — the one honestly O(groups × buckets) score
+    egress (documented in docs/parallel.md).  `lossy` rides along."""
+    has = state["has"][:, :num_buckets]
+    out = {"has_any": has.any(axis=1), "lossy": state["lossy"]}
+    if by == "avg":
+        for name in ("cnt", "sum"):
+            out[name + "_hi"] = state[name + "_hi"][:, :num_buckets]
+            out[name + "_lo"] = state[name + "_lo"][:, :num_buckets]
+        out["has"] = has
+        return out
+    name = {"count": "cnt", "sum": "sum"}[by]
+    s_hi, s_lo = pair_max_normalized(
+        state[name + "_hi"][:, :num_buckets],
+        state[name + "_lo"][:, :num_buckets], has, axis=1,
+        largest=largest)
+    out["score_hi"], out["score_lo"] = s_hi, s_lo
+    return out
 
 
 @jax.jit

@@ -157,6 +157,8 @@ MESH_FALLBACK_REASONS = (
     "topk_router",   # near-data agents cover segments: no global score
     "topk_decode",   # device-decode parts can't join device scoring
     "topk_budget",   # two-phase window pinning exceeds the cache budget
+    "additive_topk",  # an additive score add was not provably exact
+    "mesh_decode_budget",  # a fused-decode round exceeds upload/grid caps
 )
 _MESH_FALLBACKS = registry.counter(
     "scan_mesh_fallback_total",
@@ -262,6 +264,10 @@ class _MeshFallback(Exception):
         super().__init__(reason)
         self.reason = reason
 
+
+# prep sentinel marking a deferred fused-decode plan in a mesh round's
+# item list (host windows carry a real prep tuple, DeviceParts None)
+_DECODE_PREP = object()
 
 # guards every window's memo put: memo stores run on worker-pool
 # threads, and the byte accounting must not drift (a lost increment
@@ -395,6 +401,13 @@ class ScanPlan:
     # one jitted program, emitting finished per-segment parts instead
     # of host windows.  None = host decode (row scans, the control)
     decode_spec: Optional["AggregateSpec"] = None
+    # set alongside decode_spec on [scan.mesh] plans: the decode stage
+    # PLANS the fused dispatch (ops/device_decode.plan_dispatch) but
+    # defers the upload — DecodePlans ride the windows lists into the
+    # mesh pump, which batches compatible plans into per-round sharded
+    # decode programs (_run_mesh_decode_round).  False = each eligible
+    # segment uploads and dispatches standalone at decode time
+    decode_defer: bool = False
     # set when aggregate_segments routes this plan onto the 2-D scan
     # mesh ([scan.mesh]): window rounds aggregate with the device
     # kernel even where the numpy twin would normally win (CPU
@@ -454,6 +467,9 @@ class ParquetReader:
         # transfers — even scalar uploads pay tunnel latency
         self._scalar_cache: dict = {}
         self._stack_cache_bytes = 0
+        # live bytes of device-resident mesh top-k score state (the
+        # mesh_state ledger account's pull gauge; event-loop owned)
+        self._mesh_state_bytes = 0
         # Under the default host_perm merge, windows live in HOST RAM and
         # the stacks ARE the HBM working set — they get the full budget.
         # (In device_sort A/B mode windows also occupy HBM, so worst
@@ -481,6 +497,17 @@ class ParquetReader:
                f"unknown [scan.decode] mode "
                f"{config.scan.decode.mode!r}; expected one of "
                f"{DECODE_MODES}")
+        # conflicting mode COMBINATIONS fail at open too (the PR 9
+        # bad-mode precedent): decode.mode="device" under the legacy
+        # 1-D segment mesh would decline EVERY query with a counted
+        # fallback — a standing misconfiguration, not a data property
+        ensure(not (config.scan.decode.mode == "device"
+                    and config.scan.mesh_devices > 0),
+               '[scan.decode] mode="device" cannot run on the legacy '
+               "1-D segment mesh ([scan] mesh_devices > 0): the fused "
+               "decode dispatch targets the default device or the 2-D "
+               "[scan.mesh] rounds — change the decode mode or the "
+               "mesh config")
         # delta-summation tier: per-segment aggregate partials keyed by
         # the segment's exact SST set (event-loop owned, like the scan
         # cache) — narrowed/refined dashboard ranges recompute only
@@ -562,6 +589,17 @@ class ParquetReader:
                 kind="parts_memo",
                 budget=config.scan.combine.memo_max_bytes,
                 owner=root_path),
+            # device-resident mesh top-k score state (selection or
+            # compensated additive planes) held for the two-pass
+            # ranking's duration; decode round stacks ride the
+            # stack_cache account above
+            memledger.register(
+                f"mesh_state:{root_path}",
+                lambda r: r._mesh_state_bytes, anchor=self,
+                kind="mesh_state",
+                budget=config.scan.mesh.max_grid_bytes,
+                owner=root_path,
+                host=jax.default_backend() == "cpu"),
         ]
 
     def close(self) -> None:
@@ -574,6 +612,10 @@ class ParquetReader:
         self.encoded_cache.clear()
         self.parts_memo.lru.clear()
         self._scalar_cache.clear()
+        # compiled mesh programs (host-window AND fused-decode): their
+        # executables pin device constant buffers; a closed table keeps
+        # none
+        self._mesh_run_fns.clear()
         if self.scan_mesh is not None:
             # clear-on-close gauge discipline: a closed table must not
             # report a phantom mesh (last-writer semantics: the gauges
@@ -1002,7 +1044,7 @@ class ParquetReader:
         spec = plan.decode_spec
         leaves = (es.pending_leaves if es.pending_leaves is not None
                   else [])
-        got = device_decode.prepare_dispatch(
+        got = device_decode.plan_dispatch(
             es, spec, pk_names=self._pk_names_in(list(es.names)),
             seq_name=SEQ_COLUMN_NAME, leaves=leaves,
             max_bytes=self.config.scan.decode.max_upload_bytes,
@@ -1011,6 +1053,9 @@ class ParquetReader:
         if isinstance(got, str):
             device_decode.note_fallback(got)
             return None
+        if isinstance(got, device_decode.DecodePlan) \
+                and not plan.decode_defer:
+            got = device_decode.execute_plan(got)
         return [got]
 
     def _decode_segment_windows(self, table, plan: ScanPlan) -> list:
@@ -1550,9 +1595,14 @@ class ParquetReader:
         host windows instead of re-reading and re-merging.  (Tests and
         benchmarks only; production eviction is the LRUs' own.)"""
         with self._stack_cache_lock:
+            # includes the mesh decode round stacks — the fused path's
+            # uploaded (time, capacity) column matrices share this LRU
             self._stack_cache.clear()
             self._stack_cache_bytes = 0
         self._replay_cache.clear()
+        # tiny device scalars (num_buckets, bucket_ms) are HBM too on
+        # accelerators; re-uploading them is part of 'HBM evicted'
+        self._scalar_cache.clear()
         with _MEMO_LOCK:
             for windows in self.scan_cache.values():
                 for w in windows:
@@ -2028,6 +2078,11 @@ class ParquetReader:
         for entry in dispatched:
             if isinstance(entry, device_decode.DevicePart):
                 out.append(entry)
+            elif isinstance(entry, device_decode.DecodePlan):
+                # deferred fused decode: the plan rides the windows
+                # list into the mesh pump, which batches compatible
+                # plans into one sharded per-round program
+                out.append(entry)
             elif isinstance(entry, device_decode.DecodeDispatch):
                 out.append(entry.finalize())
             else:
@@ -2183,6 +2238,7 @@ class ParquetReader:
         bit-identity control.  Per-SEGMENT gates (encodings, dtype,
         upload budget) live in _dispatch_device_decode."""
         mode = self._decode_mode()
+        note = device_decode.note_fallback if count else (lambda _r: None)
         if mode == "host":
             return False
         if mode == "auto":
@@ -2194,13 +2250,10 @@ class ParquetReader:
                 return False
             if self._fused_agg_ok_base(plan):
                 return False  # fused keeps the warm/replay path
-            if self.scan_mesh is not None:
-                # auto defers to the mesh rounds (which aggregate on
-                # device anyway); mode="device" still forces the fused
-                # dispatch — its DeviceParts pass through the mesh pump
-                note("mesh")
-                return False
-        note = device_decode.note_fallback if count else (lambda _r: None)
+            # auto + the 2-D scan mesh rides the mesh-placed fused
+            # decode rounds (plan.decode_defer; _run_mesh_decode_round)
+            # — decode shards along the time axis with the aggregation
+            # instead of declining here
         if self.mesh is not None:
             note("mesh")
             return False
@@ -2512,8 +2565,12 @@ class ParquetReader:
         use_mesh = self._mesh_plan_ok(plan)
         if use_mesh:
             # mesh rounds and their single-chip fallbacks must share
-            # one rounding schedule (see ScanPlan.force_xla_agg)
-            plan = dc_replace(plan, force_xla_agg=True)
+            # one rounding schedule (see ScanPlan.force_xla_agg).
+            # Decode-eligible plans additionally DEFER the fused
+            # dispatch: DecodePlans ride the windows lists and batch
+            # into per-round sharded decode programs on the mesh
+            plan = dc_replace(plan, force_xla_agg=True,
+                              decode_defer=plan.decode_spec is not None)
             if top_k is not None and self._mesh_topk_ok(plan, spec,
                                                         top_k):
                 pump = self._aggregate_topk_mesh(plan, spec, top_k)
@@ -2790,13 +2847,17 @@ class ParquetReader:
                       tk) -> bool:
         """Whether a top-k query can take the device-scored, winner
         -sliced mesh path (egress bounded at O(k x buckets x aggs) per
-        run).  Rankings must be selection-exact on device (min/max/
-        last); additive rankings (count/sum/avg) and mixed-provenance
-        scans (near-data partials, device-decode parts) keep the full
-        -parts path, which is still mesh-combined — just not egress
-        -bounded."""
-        if tk.by not in ("min", "max", "last") or tk.by not in set(
-                spec.which):
+        run).  Selection rankings (min/max/last) score exactly on
+        device; additive rankings (count/sum/avg) score through the
+        compensated (hi, lo) plane — exact when every add provably is,
+        with a counted `additive_topk` downgrade otherwise.  Mixed
+        -provenance scans (near-data partials, device-decode parts)
+        keep the full-parts path, which is still mesh-combined — just
+        not egress-bounded."""
+        if tk.by not in ("min", "max", "last", "count", "sum", "avg") \
+                or not (tk.by == "count" or tk.by in set(spec.which)):
+            # same requested-agg rule combine_top_k enforces (count is
+            # always folded, so ranking by it needs no spec entry)
             note_mesh_fallback("topk_by")
             return False
         if plan.decode_spec is not None:
@@ -3017,11 +3078,17 @@ class ParquetReader:
         Returns [(seg_start, part_or_None, repaid_windows)]."""
         out: list = []
         host_items: list = []
+        deco_items: list = []
         for s, w, prep in items:
             if prep is None:
                 out.append((s, w.part, 1))
+            elif prep is _DECODE_PREP:
+                deco_items.append((s, w))
             else:
                 host_items.append((s, w, prep))
+        if deco_items:
+            out.extend(self._run_mesh_decode_rounds(deco_items, spec,
+                                                    plan))
         if not host_items:
             return out
         try:
@@ -3049,6 +3116,219 @@ class ParquetReader:
             (host_items[i][0], p[1] if p is not None else None, 1)
             for i, p in enumerate(flushed))
         return out
+
+    def _run_mesh_decode_rounds(self, deco: list, spec: AggregateSpec,
+                                plan: ScanPlan) -> list:
+        """Batch one flush's deferred DecodePlans into sharded fused
+        -decode rounds: plans group by static_key (one compiled program
+        per group) in arrival order, time-axis-wide chunks each run as
+        ONE mesh dispatch.  A round that declines (budget) or fails
+        (lost shard, XLA error) falls back PER ITEM to the standalone
+        fused dispatch (execute_plan) — still device decode, just not
+        mesh-placed; reasons counted in scan_mesh_fallback_total."""
+        T = int(self.scan_mesh.shape["time"])
+        groups: dict = {}
+        order: list = []
+        for s, dp in deco:
+            k = dp.static_key()
+            if k not in groups:
+                groups[k] = []
+                order.append(k)
+            groups[k].append((s, dp))
+        entries: list = []
+        for k in order:
+            grp = groups[k]
+            for i in range(0, len(grp), T):
+                chunk = grp[i:i + T]
+                try:
+                    entries.extend(self._run_mesh_decode_round(
+                        chunk, spec))
+                    continue
+                except _MeshFallback as f:
+                    note_mesh_fallback(f.reason)
+                except Exception as exc:  # noqa: BLE001 — counted,
+                    # per-item fused dispatch reproduces the parts
+                    note_mesh_fallback("mesh_error")
+                    logger.warning(
+                        "mesh decode round failed (%s); running the "
+                        "per-segment fused dispatch", exc)
+                for s, dp in chunk:
+                    part = device_decode.execute_plan(dp).finalize()
+                    entries.append((s, part.part, 1))
+        return entries
+
+    def _run_mesh_decode_round(self, chunk: list,
+                               spec: AggregateSpec) -> list:
+        """ONE device program from stored bytes to combined run grids:
+        stack the chunk's raw encoded buffers one segment per time
+        slot, run leaf-filter + (k-way merge | sort | presorted) +
+        keep-last dedup + bucket aggregate + segmented ppermute combine
+        in a single shard_map dispatch (parallel.scan
+        .mesh_decode_partials), then download run-TAIL grids only.
+
+        Slot-local group code spaces ARE the round rows (identity
+        remap): same-segment consecutive slots share a seg id — and
+        therefore combine on the mesh — only when their dictionaries,
+        first bucket, group count and clipped width all match AND the
+        combine is exact for the requested aggs (no additive sum
+        cells, f32-count bound); everything else gets a unique id and
+        comes back as its own part, exactly what the standalone fused
+        dispatches would emit."""
+        from horaedb_tpu.parallel.scan import (
+            mesh_decode_partials,
+            shard_time_axis,
+        )
+
+        mesh = self.scan_mesh
+        T = int(mesh.shape["time"])
+        series = int(mesh.shape["series"])
+        dps = [dp for _s, dp in chunk]
+        dp0 = dps[0]
+        cap = max(dp.cap for dp in dps)
+        g_pad = max(8, series, max(dp.g_pad for dp in dps))
+        width = max(dp.use_width for dp in dps)
+        want = combine_mod.expand_which(spec.which)
+        naggs = len(want) + (1 if "last" in want else 0)
+        ncol = len(dp0.upload_names)
+        if (T * cap * 4 * ncol
+                > self.config.scan.decode.max_upload_bytes
+                or T * g_pad * width * 4 * naggs
+                > self.config.scan.mesh.max_grid_bytes):
+            raise _MeshFallback("mesh_decode_budget")
+        # seg-id sharing gates — see docstring; unique negative ids on
+        # padding slots so they never combine (mesh_run_partials'
+        # convention)
+        sharable = "sum" not in want and T * cap < (1 << 24)
+        seg_ids = -(np.arange(T, dtype=np.int32) + 1)
+        rid = -1
+        for i, (s, dp) in enumerate(chunk):
+            joined = False
+            if i and sharable:
+                ps, pdp = chunk[i - 1]
+                joined = (ps == s and pdp.lo == dp.lo
+                          and pdp.shift == dp.shift
+                          and pdp.g == dp.g and pdp.w_eff == dp.w_eff
+                          and np.array_equal(pdp.values, dp.values))
+            if not joined:
+                rid += 1
+            seg_ids[i] = rid
+        t0 = time.perf_counter()
+        put = functools.partial(shard_time_axis, mesh)
+        # decode round stacks are HBM-resident and ride the SAME LRU +
+        # weakref discipline as the host-window round stacks (anchored
+        # on the cached EncodedSegments instead of merged windows), so
+        # warm repeats skip the re-upload and drop_hbm_state evicts
+        # them with everything else stack_cache-accounted
+        ncst = len(dp0.consts)
+        stack_key = ("meshdecode", dp0.static_key(), cap, T,
+                     tuple((s, dp.shift, dp.lo, dp.es.n,
+                            tuple(c.tobytes() for c in dp.consts))
+                           for s, dp in chunk))
+        es_list = tuple(dp.es for dp in dps)
+        cached = self._stack_cache_get(stack_key, es_list)
+        if cached is not None:
+            cols_dev = cached[:ncol]
+            consts_dev = cached[ncol:ncol + ncst]
+            nv_dev, offs_dev, shift_dev, lo_dev = cached[ncol + ncst:]
+            upload_bytes = 0
+        else:
+            # host stacks: one (T, cap) matrix per upload column,
+            # padding slots all-zero with n_valid 0 (every row invalid
+            # on device)
+            cols_np = [np.zeros((T, cap),
+                                dtype=dp0.es.columns[nm].dtype)
+                       for nm in dp0.upload_names]
+            nv = np.zeros(T, dtype=np.int32)
+            shift_np = np.zeros(T, dtype=np.int32)
+            lo_np = np.zeros(T, dtype=np.int32)
+            consts_np = [np.tile(c, (T, 1)).astype(np.int32)
+                         for c in dp0.consts]
+            if dp0.route == "kway":
+                offs_np = np.full((T, dp0.num_runs + 1), cap,
+                                  dtype=np.int32)
+                offs_np[:, 0] = 0
+            else:
+                offs_np = np.zeros((T, 1), dtype=np.int32)
+            upload_bytes = sum(c.nbytes for c in cols_np)
+            for t, (s, dp) in enumerate(chunk):
+                n = dp.es.n
+                for j, nm in enumerate(dp0.upload_names):
+                    cols_np[j][t, :n] = dp.es.columns[nm]
+                nv[t] = n
+                shift_np[t] = dp.shift
+                lo_np[t] = dp.lo
+                for ci, c in enumerate(dp.consts):
+                    consts_np[ci][t] = c
+                if dp0.route == "kway":
+                    # rebuild against the ROUND capacity: real run
+                    # bounds, then the pad zone [n, cap) as its own
+                    # run, trailing runs empty at cap (the
+                    # ops/merge.kway_merge_perm contract)
+                    rl = dp.es.run_lengths
+                    real = np.cumsum((0,) + tuple(rl))
+                    offs_np[t, :len(real)] = real
+                    offs_np[t, len(rl):] = cap
+                    offs_np[t, len(rl)] = n
+            cols_dev = tuple(put(c) for c in cols_np)
+            consts_dev = tuple(put(c) for c in consts_np)
+            nv_dev, offs_dev = put(nv), put(offs_np)
+            shift_dev, lo_dev = put(shift_np), put(lo_np)
+            self._stack_cache_put(
+                stack_key, es_list,
+                cols_dev + consts_dev
+                + (nv_dev, offs_dev, shift_dev, lo_dev))
+        fn_key = ("decode", dp0.static_key(), g_pad, width)
+        fn = self._mesh_run_fns.get(fn_key)
+        if fn is None:
+            fn = mesh_decode_partials(
+                mesh, num_groups=g_pad, num_buckets=width,
+                which=spec.which, key_slots=dp0.key_slots,
+                num_pks=dp0.num_pks, group_pos=dp0.group_pos,
+                ts_pos=dp0.ts_pos, val_slot=dp0.val_slot,
+                leaf_prog=dp0.leaf_prog, route=dp0.route,
+                num_runs=dp0.num_runs)
+            self._mesh_run_fns[fn_key] = fn
+        out, _kept = fn(cols_dev, nv_dev, consts_dev, offs_dev,
+                        shift_dev, lo_dev, put(seg_ids),
+                        self._dev_scalar(spec.num_buckets),
+                        self._dev_scalar(spec.bucket_ms, "arr1"))
+        _MESH_ROUNDS.inc()
+        if len(chunk) < T:
+            from horaedb_tpu.storage import pipeline as pipeline_mod
+
+            pipeline_mod.note_mesh_stall("time")
+        # run-tail emission, byte-for-byte DecodeDispatch.finalize's
+        # shape: slice to the tail plan's real group count and clipped
+        # width (copies — the (T, g_pad, width) download must not stay
+        # pinned), rebase window-local last_ts to range-relative int64
+        entries: list = []
+        cells = 0
+        src_rows = 0
+        a = 0
+        for i in range(len(chunk)):
+            if i + 1 < len(chunk) and seg_ids[i + 1] == seg_ids[i]:
+                continue
+            s, dp = chunk[i]
+            grids = {k: np.ascontiguousarray(
+                np.asarray(v[i])[:dp.g, :dp.w_eff])
+                for k, v in out.items()}
+            if "last_ts" in grids:
+                lt = grids["last_ts"].astype(np.int64)
+                grids["last_ts"] = np.where(
+                    grids["count"] > 0,
+                    lt + dp.lo * spec.bucket_ms, lt)
+            cells += sum(int(v.shape[0] * v.shape[1])
+                         for v in grids.values())
+            src_rows += sum(dp2.es.n for _s2, dp2 in chunk[a:i + 1])
+            entries.append(
+                (s, (dp.values, dp.lo, grids), i - a + 1))
+            a = i + 1
+        _MESH_PARTS.inc(len(entries))
+        _MESH_PART_CELLS.inc(cells)
+        device_decode.observe_decode_stage(
+            time.perf_counter() - t0, rows=src_rows,
+            nbytes=upload_bytes)
+        return entries
 
     async def _aggregate_segments_mesh(self, plan: ScanPlan,
                                        spec: AggregateSpec, memo_store):
@@ -3127,6 +3407,11 @@ class ParquetReader:
                         out = []
                         for w in ws:
                             _ROWS_SCANNED.inc(w.n_valid)
+                            if isinstance(w, device_decode.DecodePlan):
+                                # deferred fused decode: batched into
+                                # sharded rounds at flush time
+                                out.append((w, _DECODE_PREP))
+                                continue
                             if isinstance(w, device_decode.DevicePart):
                                 if w.part is not None:
                                     out.append((w, None))
@@ -3230,33 +3515,113 @@ class ParquetReader:
             else spec.num_buckets
         chunks = [items[i:i + T] for i in range(0, len(items), T)]
         bucket_dev = self._dev_scalar(spec.bucket_ms)
-        state = pscan.mesh_score_init(g_pad, spec.num_buckets + width,
-                                      tk.by)
+        additive = tk.by in ("count", "sum", "avg")
+        if additive:
+            state = pscan.mesh_additive_init(
+                g_pad, spec.num_buckets + width, tk.by)
+        else:
+            state = pscan.mesh_score_init(
+                g_pad, spec.num_buckets + width, tk.by)
+        # the score state is device-resident for the whole two-pass
+        # ranking: account it (mesh_state ledger kind) and free it on
+        # EVERY exit path before any parts yield
+        state_bytes = sum(int(v.nbytes) for v in state.values())
+        self._mesh_state_bytes += state_bytes
         downgrade = None
+        finished = None
         try:
-            for ci, chunk in enumerate(chunks):
-                deadline_checkpoint()
+            try:
+                for ci, chunk in enumerate(chunks):
+                    deadline_checkpoint()
 
-                def score_round(chunk=chunk, state=state, ci=ci):
-                    got = self._run_mesh_round(chunk, spec, plan,
-                                               group_space=all_values,
-                                               download=False,
-                                               round_salt=ci)
-                    last_ts = (got["out"].get("last_ts")
-                               if tk.by == "last" else None)
-                    return pscan.mesh_score_update(
-                        state, got["out"][tk.by], got["out"]["count"],
-                        last_ts, got["lo_dev"], bucket_dev, by=tk.by)
+                    def score_round(chunk=chunk, state=state, ci=ci):
+                        got = self._run_mesh_round(
+                            chunk, spec, plan, group_space=all_values,
+                            download=False, round_salt=ci)
+                        if additive:
+                            # TAIL slots only: a tail's segmented
+                            # combine already holds its whole run,
+                            # prefixes would double-count
+                            tails = np.zeros(T, dtype=bool)
+                            for _s, _a, b in got["runs"]:
+                                tails[b] = True
+                            return pscan.mesh_additive_update(
+                                state, got["out"]["count"],
+                                got["out"].get("sum",
+                                               got["out"]["count"]),
+                                jnp.asarray(tails), got["lo_dev"],
+                                by=tk.by)
+                        last_ts = (got["out"].get("last_ts")
+                                   if tk.by == "last" else None)
+                        return pscan.mesh_score_update(
+                            state, got["out"][tk.by],
+                            got["out"]["count"], last_ts,
+                            got["lo_dev"], bucket_dev, by=tk.by)
 
-                state = await self._run_pool(plan.pool, score_round)
-        except _MeshFallback as f:
-            downgrade = f.reason
-        except NotFoundError:
-            raise  # compaction race: the caller replans
-        except Exception as exc:  # noqa: BLE001 — counted downgrade
-            downgrade = "mesh_error"
-            logger.warning("mesh top-k scoring failed (%s); serving "
-                           "full-width parts", exc)
+                    state = await self._run_pool(plan.pool,
+                                                 score_round)
+            except _MeshFallback as f:
+                downgrade = f.reason
+            except NotFoundError:
+                raise  # compaction race: the caller replans
+            except Exception as exc:  # noqa: BLE001 — counted
+                # downgrade
+                downgrade = "mesh_error"
+                logger.warning("mesh top-k scoring failed (%s); "
+                               "serving full-width parts", exc)
+            if downgrade is None:
+                def finish_scores():
+                    if not additive:
+                        scores_d, has_d = pscan.mesh_score_finalize(
+                            state, largest=tk.largest,
+                            num_buckets=spec.num_buckets)
+                        _MESH_SCORE_CELLS.inc(2 * g)
+                        return (np.asarray(scores_d)[:g]
+                                .astype(np.float64),
+                                np.asarray(has_d)[:g])
+                    fin = pscan.mesh_additive_finalize(
+                        state, by=tk.by, largest=tk.largest,
+                        num_buckets=spec.num_buckets)
+                    if bool(fin["lossy"]):
+                        # an add was not provably exact: the
+                        # compensated pair may not match the host's
+                        # f64 fold — counted downgrade to full parts,
+                        # never a silently drifted winner set
+                        return None
+                    if tk.by == "avg":
+                        # the device cannot divide bit-identically to
+                        # the host, so avg downloads the full (groups,
+                        # buckets) cnt/sum pairs and the host runs
+                        # combine_top_k's exact score formula — the
+                        # one honestly O(g x buckets) score egress
+                        # (counted as such)
+                        cnt = (np.asarray(fin["cnt_hi"], np.float64)
+                               + np.asarray(fin["cnt_lo"],
+                                            np.float64))[:g]
+                        sm = (np.asarray(fin["sum_hi"], np.float64)
+                              + np.asarray(fin["sum_lo"],
+                                           np.float64))[:g]
+                        hs = np.asarray(fin["has"])[:g]
+                        _MESH_SCORE_CELLS.inc(5 * cnt.size + g)
+                        with np.errstate(invalid="ignore",
+                                         divide="ignore"):
+                            cell = sm / np.maximum(cnt, 1)
+                        fill = -np.inf if tk.largest else np.inf
+                        cell = np.where(hs, cell, fill)
+                        sc = (cell.max(axis=1) if tk.largest
+                              else cell.min(axis=1))
+                        return sc, hs.any(axis=1)
+                    sc = (np.asarray(fin["score_hi"], np.float64)
+                          + np.asarray(fin["score_lo"],
+                                       np.float64))[:g]
+                    _MESH_SCORE_CELLS.inc(3 * g)
+                    return sc, np.asarray(fin["has_any"])[:g]
+
+                finished = await self._run_pool(plan.pool,
+                                                finish_scores)
+        finally:
+            state = None
+            self._mesh_state_bytes -= state_bytes
         if downgrade is not None:
             note_mesh_fallback(downgrade)
             # full-width mesh parts through the normal chunk flush —
@@ -3266,15 +3631,13 @@ class ParquetReader:
                                                          plan):
                 yield out
             return
-
-        def finish_scores():
-            scores_d, has_d = pscan.mesh_score_finalize(
-                state, largest=tk.largest, num_buckets=spec.num_buckets)
-            return (np.asarray(scores_d)[:g].astype(np.float64),
-                    np.asarray(has_d)[:g])
-
-        scores, has_any = await self._run_pool(plan.pool, finish_scores)
-        _MESH_SCORE_CELLS.inc(2 * g)
+        if finished is None:
+            note_mesh_fallback("additive_topk")
+            async for out in self._yield_chunks_as_parts(chunks, spec,
+                                                         plan):
+                yield out
+            return
+        scores, has_any = finished
         kept = np.flatnonzero(has_any)
         winners = combine_mod.rank_top_k(
             [int(r) for r in kept], scores[kept], tk)

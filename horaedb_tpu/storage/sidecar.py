@@ -483,6 +483,12 @@ class EncodedSegment:
     # routes it sort-free without even the one-pass host check
     # (ops/device_decode.py, scan_decode_sort_skipped_total)
     source_runs: Optional[int] = None
+    # per-run row counts in concatenation order (sum == n); carried so
+    # the fused decode can k-way-merge the presorted runs on device
+    # instead of paying the full lax.sort (ops/merge.kway_merge_perm).
+    # None = run boundaries unknown (single-part shortcuts, legacy
+    # callers) — the decode then falls back to the sort route.
+    run_lengths: Optional[tuple] = None
 
     @property
     def num_rows(self) -> int:
@@ -508,6 +514,7 @@ def apply_leaves_host(es: EncodedSegment) -> EncodedSegment:
             es.pending_leaves = None
         return es
     cols = es.columns
+    run_lengths = es.run_lengths
     if es.n:
         batch = encode.DeviceBatch(columns=cols, encodings=es.encodings,
                                    n_valid=es.n, capacity=es.n)
@@ -516,10 +523,19 @@ def apply_leaves_host(es: EncodedSegment) -> EncodedSegment:
         if not mask.all():
             idx = np.flatnonzero(mask)
             cols = {nm: a[idx] for nm, a in cols.items()}
+            if run_lengths is not None:
+                # survivors per run: the run boundaries stay valid for
+                # the k-way route because compaction happens per run
+                counts, pos = [], 0
+                for rl in run_lengths:
+                    counts.append(int(mask[pos:pos + rl].sum()))
+                    pos += rl
+                run_lengths = tuple(counts)
     n = len(next(iter(cols.values()))) if cols else 0
     return EncodedSegment(columns=cols, encodings=es.encodings, n=n,
                           names=es.names, pending_leaves=None,
-                          source_runs=es.source_runs)
+                          source_runs=es.source_runs,
+                          run_lengths=run_lengths)
 
 
 def assemble_segment(bufs: list[bytes], columns: list,
@@ -548,6 +564,7 @@ def assemble_parts(parts: list, columns: list,
 
     leaves = leaves or []
     out_parts = []
+    run_lengths = []
     for cols, n in parts:
         if leaves and n:
             batch = encode.DeviceBatch(
@@ -559,14 +576,17 @@ def assemble_parts(parts: list, columns: list,
             if not mask.all():
                 idx = np.flatnonzero(mask)
                 cols = {nm: (a[idx], e) for nm, (a, e) in cols.items()}
+                n = len(idx)
         out_parts.append({nm: cols[nm] for nm in columns})
+        run_lengths.append(int(n))
     cc = concat_encoded(out_parts, list(columns))
     if cc is None:
         return None
     out_cols, out_encs, n_total = cc
     return EncodedSegment(columns=out_cols, encodings=out_encs,
                           n=n_total, names=list(columns),
-                          source_runs=len(parts))
+                          source_runs=len(parts),
+                          run_lengths=tuple(run_lengths))
 
 
 # ---------------------------------------------------------------------------

@@ -238,15 +238,18 @@ def test_streamed_segments_device_decode(runtimes):
 
 
 def test_sort_free_routing_counted(runtimes):
-    """Compaction-aware sort-free routing (ISSUE 15 satellite):
-    single-SST segments route past the device lax.sort AND the host
-    sortedness check ((pk, seq)-sorted by construction), multi-SST
-    segments that check sorted skip the sort too, and interleaved ones
-    pay it — each per segment on scan_decode_sort_*_total."""
+    """Compaction-aware sort-free routing (ISSUE 15 satellite, k-way
+    merge ISSUE 19): single-SST segments route past the device lax.sort
+    AND the host sortedness check ((pk, seq)-sorted by construction),
+    multi-SST segments that check sorted skip the sort too, and
+    interleaved ones with known per-run boundaries take the device
+    k-way merge (route="kway") — the full sort survives only as the
+    counted fallback — each per segment on scan_decode_sort_*_total."""
 
     def counts():
         return (device_decode._SORT_SKIPPED["compacted"].value,
                 device_decode._SORT_SKIPPED["checked"].value,
+                device_decode._SORT_SKIPPED["kway"].value,
                 device_decode._SORT_RAN.value)
 
     async def go():
@@ -263,14 +266,16 @@ def test_sort_free_routing_counted(runtimes):
                 clear_caches(s)
                 await s.scan_aggregate(req, spec)
                 c1 = counts()
-                assert c1[0] == c0[0] + 1 and c1[2] == c0[2]
+                assert c1[0] == c0[0] + 1 and c1[3] == c0[3]
                 # overlapping second SST with interleaving PK ranges:
-                # the concat is unsorted -> the device sort runs
+                # the concat is unsorted -> the per-SST runs k-way
+                # merge on device; the full sort does NOT run
                 await s.write(wreq([("k0", 10, 1.0), ("k5", 20, 2.0)]))
                 clear_caches(s)
                 await s.scan_aggregate(req, spec)
                 c2 = counts()
                 assert c2[2] == c1[2] + 1, (c1, c2)
+                assert c2[3] == c1[3], (c1, c2)
                 # disjoint-PK second write CAN still concat sorted —
                 # whichever way it lands, routed-vs-sorted must sum to
                 # one more segment dispatch

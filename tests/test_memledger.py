@@ -274,12 +274,13 @@ class TestEngineWiring:
                 bucket_ms=1000, aggs=("avg",))
             kinds = ledger.kinds()
             for kind in ("scan_cache", "encoded_cache", "parts_memo",
-                         "memtable", "wal_backlog", "rollup_state"):
+                         "memtable", "wal_backlog", "rollup_state",
+                         "mesh_state"):
                 assert kind in kinds, kind
             await e.close()
             gone = ("scan_cache", "stack_cache", "encoded_cache",
                     "parts_memo", "memtable", "wal_backlog",
-                    "rollup_state", "chunk_cache")
+                    "rollup_state", "chunk_cache", "mesh_state")
             after = ledger.kinds()
             for kind in gone:
                 assert kind not in after, kind

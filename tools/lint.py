@@ -379,6 +379,28 @@ _METRIC_FACTORIES = {"counter", "gauge", "histogram"}
 _MESH_CONSTRUCTORS = {"Mesh", "shard_map", "NamedSharding"}
 
 
+def _lax_sort_outside_merge(node: ast.Call) -> bool:
+    """`jax.lax.sort` call sites outside ops/merge.py: the engine's
+    variadic lexicographic sort has ONE seam (ops/merge.lex_sort) and
+    one presorted-run bypass (kway_merge_perm) — a stray lax.sort is
+    how the O(n log n) full sort quietly grows back into a path the
+    k-way merge already made sort-free.  Matches `lax.sort(...)` and
+    `jax.lax.sort(...)` receivers (sort_key_val etc. included via the
+    attr prefix check)."""
+    func = node.func
+    if not isinstance(func, ast.Attribute) \
+            or not func.attr.startswith("sort"):
+        return False
+    chain = []
+    cur = func.value
+    while isinstance(cur, ast.Attribute):
+        chain.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        chain.append(cur.id)
+    return "lax" in chain
+
+
 def _mesh_construction_outside_parallel(node: ast.Call) -> bool:
     """Mesh/shard_map/NamedSharding construction outside
     horaedb_tpu/parallel/: mesh topology and sharding specs stay
@@ -612,6 +634,17 @@ def lint_file(path: pathlib.Path) -> list[str]:
                     "sites silently reintroduce the host decode the "
                     "device-native path removed; route reads through "
                     "the reader (ops/device_decode.py)")
+        elif (isinstance(node, ast.Call) and "horaedb_tpu" in path.parts
+                and path.name != "merge.py"
+                and _lax_sort_outside_merge(node)):
+            src = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+            if "noqa" not in src:
+                problems.append(
+                    f"{path}:{node.lineno}: jax.lax.sort called "
+                    "outside ops/merge.py — the device sort has one "
+                    "seam (ops/merge.lex_sort) so presorted and k-way "
+                    "-mergeable inputs can bypass it; call lex_sort / "
+                    "kway_merge_perm instead (docs/parallel.md)")
         elif (isinstance(node, ast.Call) and "horaedb_tpu" in path.parts
                 and "parallel" not in path.parts
                 and _mesh_construction_outside_parallel(node)):

@@ -48,16 +48,20 @@ def next_record_path() -> str:
 def run(n_devices: int, timeout_s: float, mode: str = "dryrun",
         rows: int = 2_000_000) -> dict:
     if mode == "mesh":
-        # the mesh-scan A/B (ISSUE 15): BENCH_CONFIG=19 runs the 2-D
-        # mesh scan vs the single-chip control with in-bench
-        # bit-identity + top-k egress assertions.  On this box the
-        # rung is the CPU virtual mesh
-        # (--xla_force_host_platform_device_count); a TPU host runs
-        # the identical command on real chips and the record's
-        # backend/fallback labels say which it was
+        # the mesh-scan A/B: BENCH_CONFIG=22 (ISSUE 19) runs the
+        # mesh-placed FUSED-DECODE scan (stored bytes to ranked
+        # answer) vs the PR 15 mesh-over-host-windows leg vs the
+        # single-chip control, with in-bench bit-identity across all
+        # three legs, k-way-merge routing asserts, and the additive
+        # top-k egress bound at two group cardinalities
+        # (BENCH_CONFIG=19 remains the PR 15 two-leg A/B, selectable
+        # via MESH_BENCH_CONFIG).  On this box the rung is the CPU
+        # virtual mesh (--xla_force_host_platform_device_count); a TPU
+        # host runs the identical command on real chips and the
+        # record's backend/fallback labels say which it was
         cmd = [sys.executable, "bench.py"]
         env = dict(os.environ)
-        env["BENCH_CONFIG"] = "19"
+        env["BENCH_CONFIG"] = env.get("MESH_BENCH_CONFIG", "22")
         env.setdefault("BENCH_ROWS", str(rows))
         env["MESH_BENCH_DEVICES"] = str(n_devices)
         flags = env.get("XLA_FLAGS", "")
