@@ -94,6 +94,12 @@ lint:
 trace-demo:
 	JAX_PLATFORMS=cpu python tools/trace_demo.py --ops
 
+# device-plane demo (docs/observability.md, device plane): a cold
+# fused mesh-decode round then the identical warm repeat, attributed —
+# compile ledger, dispatch/exec split, transfer totals, round timeline
+trace-demo-device:
+	JAX_PLATFORMS=cpu python tools/trace_demo.py --device
+
 # multichip dryrun with a GUARANTEED result record: even a wedged run
 # (rc=124) writes bench_results/multichip_rNN.json with an explicit
 # timeout status instead of silence (ROADMAP item 3 recording gap)

@@ -539,7 +539,7 @@ def main() -> None:
     try:
         config = int(os.environ.get("BENCH_CONFIG", 1))
     except ValueError:
-        sys.exit(f"BENCH_CONFIG must be 0-22, got "
+        sys.exit(f"BENCH_CONFIG must be 0-23, got "
                  f"{os.environ.get('BENCH_CONFIG')!r}")
 
     ensure_responsive_backend()
@@ -555,7 +555,7 @@ def main() -> None:
         from horaedb_tpu.bench.suite import RUNNERS
 
         if config not in RUNNERS:
-            sys.exit(f"BENCH_CONFIG must be 0-22, got {config}")
+            sys.exit(f"BENCH_CONFIG must be 0-23, got {config}")
         result = RUNNERS[config](rows, iters)
     # a config's own backend/fallback labels win (config 6 is pure host
     # work and must never read as a device number)

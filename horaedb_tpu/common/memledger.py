@@ -91,6 +91,10 @@ _DEVICE_BYTES = registry.gauge(
     "memory_device_bytes",
     "accelerator bytes in use per device (jax memory_stats; absent on "
     "CPU backends and older jax)")
+_DEVICE_HIGH_WATER = registry.gauge(
+    "memory_device_high_water_bytes",
+    "peak accelerator bytes in use per device since engine open "
+    "(sampled high-water; reset to 0 on engine close)")
 
 
 def read_rss_bytes() -> Optional[int]:
@@ -268,6 +272,9 @@ class MemoryLedger:
         # discipline) — same for per-device gauges
         self._gauge_kinds: set[str] = set()
         self._device_labels: set[str] = set()
+        # sampled per-device peaks; survive label absence (a device that
+        # freed everything keeps its peak) until reset on engine close
+        self._device_high_water: dict[str, int] = {}
         self.enabled = True
         self.interval_s = 5.0
         # 0 = derive from MemTotal at configure time (soft 70%, hard
@@ -420,8 +427,14 @@ class MemoryLedger:
         devices = device_memory()
         labels = set()
         for d in devices:
-            _DEVICE_BYTES.labels(device=d["device"]).set(d["bytes_in_use"])
-            labels.add(d["device"])
+            dev = d["device"]
+            b = d["bytes_in_use"]
+            hw = max(self._device_high_water.get(dev, 0), b)
+            self._device_high_water[dev] = hw
+            d["high_water_bytes"] = hw
+            _DEVICE_BYTES.labels(device=dev).set(b)
+            _DEVICE_HIGH_WATER.labels(device=dev).set(hw)
+            labels.add(dev)
         for label in self._device_labels - labels:
             _DEVICE_BYTES.labels(device=label).set(0)
         self._device_labels = labels
@@ -587,7 +600,17 @@ class MemoryLedger:
             "unattributed_bytes": sample["unattributed_bytes"],
             "pressure": self.pressure_level,
             "accounts": dict(sorted(sample["accounts"].items())),
+            "device_high_water": dict(sorted(
+                self._device_high_water.items())),
         }
+
+    def reset_device_high_water(self) -> None:
+        """Engine close resets the per-device peaks (clear-on-close
+        discipline): the next engine's high-water marks are its own,
+        not inherited from a table that no longer exists."""
+        for dev in self._device_high_water:
+            _DEVICE_HIGH_WATER.labels(device=dev).set(0)
+        self._device_high_water = {}
 
 
 ledger = MemoryLedger()

@@ -49,7 +49,6 @@ try/except.
 
 from __future__ import annotations
 
-import functools
 import logging
 import time
 from dataclasses import dataclass
@@ -60,6 +59,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from horaedb_tpu.common import deviceprof
 from horaedb_tpu.ops import downsample
 from horaedb_tpu.ops import filter as filter_ops
 from horaedb_tpu.ops import merge as merge_ops
@@ -429,7 +429,7 @@ def decode_rows_core(cols: tuple, n_valid, leaf_consts: tuple,
     return keys_s, gid, val_s, n_rows
 
 
-@functools.partial(jax.jit, static_argnames=(
+@deviceprof.jit(static_argnames=(
     "key_slots", "num_pks", "group_pos", "ts_pos", "val_slot",
     "leaf_prog", "g_pad", "width", "which", "use_pallas", "route",
     "num_runs"))
@@ -529,6 +529,11 @@ class DecodeDispatch:
     def finalize(self) -> DevicePart:
         t0 = time.perf_counter()
         g = len(self.values)
+        # the full (g_pad, width) grids cross the device boundary here
+        # (np.asarray downloads the whole buffer before the slice) —
+        # the d2h charge counts what moved, not what was kept
+        d2h_bytes = sum(int(getattr(v, "nbytes", 0))
+                        for v in self.outs.values())
         # mirror _flush_window_batch's emission exactly: slice to the
         # real group count and the query-clipped width, then re-base
         # window-local last_ts to range_start-relative int64.  The
@@ -537,6 +542,11 @@ class DecodeDispatch:
         # — the PartsMemo views-pin-bases defect, not repeated here
         grids = {k: np.ascontiguousarray(np.asarray(v)[:g, :self.w_eff])
                  for k, v in self.outs.items()}
+        # the asarray wait IS the device execution for this dispatch
+        # (the jit call returned immediately; this synced)
+        deviceprof.observe_exec("_decode_aggregate_jit",
+                                time.perf_counter() - t0)
+        deviceprof.charge_transfer("d2h", d2h_bytes)
         if "last_ts" in grids:
             lt = grids["last_ts"].astype(np.int64)
             grids["last_ts"] = np.where(
@@ -785,7 +795,7 @@ def execute_plan(dp: DecodePlan) -> DecodeDispatch:
         padded = np.zeros(dp.cap, dtype=arr.dtype)  # calloc: tail free
         padded[:es.n] = arr
         upload_bytes += int(padded.nbytes)
-        cols_dev.append(jax.device_put(padded))
+        cols_dev.append(deviceprof.device_put(padded))
     consts_dev = tuple(jnp.asarray(c) for c in dp.consts)
     offs_dev = jnp.int32(0) if dp.run_offsets is None \
         else jnp.asarray(dp.run_offsets)

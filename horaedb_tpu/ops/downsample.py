@@ -19,12 +19,13 @@ combine correctly under collectives, NaNs would not.
 
 from __future__ import annotations
 
-import functools
 import logging
 import os
 
 import jax
 import jax.numpy as jnp
+
+from horaedb_tpu.common import deviceprof
 
 _F32_MAX = jnp.float32(jnp.finfo(jnp.float32).max)
 _I32_MIN = jnp.int32(-(2**31))
@@ -257,8 +258,7 @@ def time_bucket_aggregate(ts_offset: jax.Array, group_ids: jax.Array,
         num_groups=num_groups, num_buckets=num_buckets, which=which)
 
 
-@functools.partial(jax.jit, static_argnames=("num_groups", "num_buckets",
-                                             "which"))
+@deviceprof.jit(static_argnames=("num_groups", "num_buckets", "which"))
 def _time_bucket_aggregate_impl(ts_offset: jax.Array, group_ids: jax.Array,
                                 values: jax.Array, n_valid, bucket_ms,
                                 num_groups: int, num_buckets: int,

@@ -18,6 +18,7 @@ Decode inverts the mapping for result batches.
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -25,6 +26,7 @@ import numpy as np
 import pyarrow as pa
 import pyarrow.compute as pc
 
+from horaedb_tpu.common import deviceprof
 from horaedb_tpu.common.error import Error, ensure
 from horaedb_tpu.utils import registry
 
@@ -214,7 +216,16 @@ def encode_batch(batch: pa.RecordBatch, capacity: Optional[int] = None,
         dev, enc = encode_column(col, name)
         padded = np.zeros(cap, dtype=dev.dtype)
         padded[:n] = dev
-        columns[name] = device_put(padded) if device_put else padded
+        if device_put is None:
+            columns[name] = padded
+        else:
+            t0 = time.perf_counter()
+            columns[name] = device_put(padded)
+            # profiler-owned puts charge themselves — don't double-count
+            if getattr(device_put, "__self__", None) \
+                    is not deviceprof.profiler:
+                deviceprof.charge_transfer(
+                    "h2d", int(padded.nbytes), time.perf_counter() - t0)
         encodings[name] = enc
     return DeviceBatch(columns=columns, encodings=encodings, n_valid=n, capacity=cap)
 

@@ -20,11 +20,12 @@ too (first `num_runs` rows valid), so downstream ops stay compiled.
 
 from __future__ import annotations
 
-import functools
 import os
 
 import jax
 import jax.numpy as jnp
+
+from horaedb_tpu.common import deviceprof
 
 _PAD_SENTINEL = jnp.int32(2**31 - 1)
 
@@ -80,7 +81,7 @@ def _lex_less(ks: tuple, idx: jax.Array, xs: tuple):
     return lt, eq
 
 
-@functools.partial(jax.jit, static_argnames=("num_runs",))
+@deviceprof.jit(static_argnames=("num_runs",))
 def _kway_merge_perm_impl(keys: tuple, offsets: jax.Array, num_runs: int):
     cap = keys[0].shape[0]
     iota = jnp.arange(cap, dtype=jnp.int32)
@@ -187,7 +188,7 @@ def sorted_run_starts(pk_cols: tuple, valid: jax.Array) -> jax.Array:
     return (first | neq) & valid
 
 
-@functools.partial(jax.jit, static_argnames=("num_pks", "num_keys"))
+@deviceprof.jit(static_argnames=("num_pks", "num_keys"))
 def _merge_dedup_impl(cols: tuple, n_valid: jax.Array, num_pks: int, num_keys: int):
     capacity = cols[0].shape[0]
     iota = jnp.arange(capacity, dtype=jnp.int32)
@@ -223,7 +224,7 @@ def _merge_dedup_impl(cols: tuple, n_valid: jax.Array, num_pks: int, num_keys: i
     return out_cols, out_valid, num_runs
 
 
-@functools.partial(jax.jit, static_argnames=("num_pks", "has_perm"))
+@deviceprof.jit(static_argnames=("num_pks", "has_perm"))
 def _dedup_presorted_impl(cols: tuple, perm, n_valid: jax.Array,
                           num_pks: int, has_perm: bool):
     capacity = cols[0].shape[0]

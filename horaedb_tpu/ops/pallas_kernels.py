@@ -29,6 +29,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from horaedb_tpu.common import deviceprof
+
 _F32_MAX = float(jnp.finfo(jnp.float32).max)
 
 BLOCK_ROWS = 1024
@@ -179,8 +181,8 @@ def _pallas_partial_grids(ts_offset: jax.Array, group_ids: jax.Array,
     return partial
 
 
-@functools.partial(jax.jit, static_argnames=("num_groups", "num_buckets",
-                                             "which", "interpret"))
+@deviceprof.jit(static_argnames=("num_groups", "num_buckets",
+                                "which", "interpret"))
 def pallas_time_bucket_aggregate(ts_offset: jax.Array, group_ids: jax.Array,
                                  values: jax.Array, n_valid, bucket_ms,
                                  num_groups: int, num_buckets: int,

@@ -8,10 +8,10 @@ so k-selection is a single `lax.top_k` over group scores.
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
+
+from horaedb_tpu.common import deviceprof
 
 
 # ---------------------------------------------------------------------------
@@ -82,7 +82,7 @@ def pair_max_normalized(hi: jax.Array, lo: jax.Array, mask: jax.Array,
     return (jnp.squeeze(m_hi, axis=axis), jnp.squeeze(m_lo, axis=axis))
 
 
-@functools.partial(jax.jit, static_argnames=("k", "largest"))
+@deviceprof.jit(static_argnames=("k", "largest"))
 def top_k_groups(scores: jax.Array, k: int, largest: bool = True):
     """Return (values, group_indices) of the top-k groups.
 

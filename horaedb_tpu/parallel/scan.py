@@ -20,8 +20,6 @@ the reference's cross-partition SortPreservingMergeExec, except only
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 
@@ -63,6 +61,7 @@ except ImportError:  # older jax: pre-promotion experimental namespace
         return _shard_map_compat(f, **kw)
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from horaedb_tpu.common import deviceprof
 from horaedb_tpu.common.error import Error
 from horaedb_tpu.ops import downsample, merge as merge_ops
 from horaedb_tpu.ops.topk import (pair_add, pair_max_normalized,
@@ -128,7 +127,7 @@ def sharded_downsample_query(mesh, *, num_groups: int, num_buckets: int,
         out_specs=(P(), P(), P()),
         check_vma=False,
     )
-    return jax.jit(mapped)
+    return deviceprof.jit(mapped, name="sharded_downsample_query")
 
 
 def _shard_partial(ts, gid, vals, n_valid, bucket_ms, *, num_groups: int,
@@ -185,7 +184,7 @@ def sharded_remap_partials(mesh, *, num_groups: int, num_buckets: int,
         out_specs=P(SEGMENT_AXIS),
         check_vma=False,
     )
-    return jax.jit(mapped)
+    return deviceprof.jit(mapped, name="sharded_remap_partials")
 
 
 def _build_sharded_merge(mesh, merge_fn):
@@ -212,7 +211,8 @@ def _build_sharded_merge(mesh, merge_fn):
                    P(SEGMENT_AXIS)),
         check_vma=False,
     )
-    return jax.jit(mapped)
+    return deviceprof.jit(
+        mapped, name=f"sharded_merge[{merge_fn.__name__}]")
 
 
 def sharded_merge_dedup(mesh, *, num_pks: int):
@@ -233,7 +233,7 @@ def sharded_merge_dedup(mesh, *, num_pks: int):
 
 def shard_leading_axis(mesh, arr):
     """Place an (n_devices, ...) host array sharded over the segment axis."""
-    return jax.device_put(arr, NamedSharding(mesh, P(SEGMENT_AXIS)))
+    return deviceprof.device_put(arr, NamedSharding(mesh, P(SEGMENT_AXIS)))
 
 
 # ---------------------------------------------------------------------------
@@ -247,7 +247,7 @@ def shard_time_axis(mesh, arr):
     for their own group block — the series axis divides resident grid
     STATE and combine egress, not row work (the output-parallel layout;
     docs/parallel.md)."""
-    return jax.device_put(arr, NamedSharding(mesh, P(TIME_AXIS)))
+    return deviceprof.device_put(arr, NamedSharding(mesh, P(TIME_AXIS)))
 
 
 def mesh_run_partials(mesh, *, num_groups: int, num_buckets: int,
@@ -305,7 +305,7 @@ def mesh_run_partials(mesh, *, num_groups: int, num_buckets: int,
         out_specs=P(TIME_AXIS, SERIES_AXIS),
         check_vma=False,
     )
-    return jax.jit(mapped)
+    return deviceprof.jit(mapped, name="mesh_run_partials")
 
 
 def _series_block(num_groups: int, series_n: int) -> int:
@@ -418,7 +418,7 @@ def mesh_decode_partials(mesh, *, num_groups: int, num_buckets: int,
         out_specs=(P(TIME_AXIS, SERIES_AXIS), P(TIME_AXIS)),
         check_vma=False,
     )
-    return jax.jit(mapped)
+    return deviceprof.jit(mapped, name="mesh_decode_partials")
 
 
 # ---- device-resident top-k score state -------------------------------------
@@ -455,7 +455,7 @@ def mesh_score_init(num_groups: int, padded_buckets: int, by: str):
     return state
 
 
-@functools.partial(jax.jit, static_argnames=("by",), donate_argnums=(0,))
+@deviceprof.jit(static_argnames=("by",), donate_argnums=(0,))
 def mesh_score_update(state: dict, by_grid, count_grid, last_ts, lo,
                       bucket_ms, *, by: str):
     """Fold one round's (time, groups, width) outputs into the score
@@ -494,7 +494,7 @@ def mesh_score_update(state: dict, by_grid, count_grid, last_ts, lo,
     return jax.lax.fori_loop(0, by_grid.shape[0], body, state)
 
 
-@functools.partial(jax.jit, static_argnames=("largest", "num_buckets"))
+@deviceprof.jit(static_argnames=("largest", "num_buckets"))
 def mesh_score_finalize(state: dict, *, largest: bool, num_buckets: int):
     """(scores, has_any) per group — the ONLY full-group bytes the
     top-k path downloads.  Score formula mirrors combine_top_k's: the
@@ -542,7 +542,7 @@ def mesh_additive_init(num_groups: int, padded_buckets: int, by: str):
     return state
 
 
-@functools.partial(jax.jit, static_argnames=("by",), donate_argnums=(0,))
+@deviceprof.jit(static_argnames=("by",), donate_argnums=(0,))
 def mesh_additive_update(state: dict, count_grid, sum_grid, tails, lo,
                          *, by: str):
     """Fold one round's (time, groups, width) outputs into the additive
@@ -581,8 +581,7 @@ def mesh_additive_update(state: dict, count_grid, sum_grid, tails, lo,
     return jax.lax.fori_loop(0, count_grid.shape[0], body, state)
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("by", "largest", "num_buckets"))
+@deviceprof.jit(static_argnames=("by", "largest", "num_buckets"))
 def mesh_additive_finalize(state: dict, *, by: str, largest: bool,
                            num_buckets: int):
     """Reduce the additive state to the download payload.
@@ -611,7 +610,7 @@ def mesh_additive_finalize(state: dict, *, by: str, largest: bool,
     return out
 
 
-@jax.jit
+@deviceprof.jit
 def mesh_take_rows(grids: dict, idx):
     """Winner-row gather on device: (time, groups, width) round outputs
     sliced to the k winners' rows BEFORE download — the O(k x buckets

@@ -141,6 +141,29 @@ class MemoryConfig:
 
 
 @dataclass
+class DeviceprofConfig:
+    """[deviceprof]: the device plane (common/deviceprof.py).  Every
+    jitted seam routes through the process-global DeviceProfiler
+    (lint-enforced: no bare jax.jit outside it), which keeps the
+    compile ledger, the dispatch/exec split, h2d/d2h transfer totals,
+    and the mesh round timeline.  `GET /debug/device` serves the
+    compile-cache table + transfer totals + per-device memory;
+    device_compiles_total{fn=} / device_dispatch_seconds{fn=} /
+    device_transfer_bytes_total{direction=} land on /metrics."""
+
+    enabled: bool = True
+    # recompile-storm watchdog: `storm_threshold` compiles of one fn
+    # inside a sliding `storm_window` fire
+    # device_recompile_storms_total{fn=} ONCE per episode plus a
+    # slow-log line naming the churning cache-key dimension
+    storm_window: ReadableDuration = field(
+        default_factory=lambda: ReadableDuration.parse("60s"))
+    storm_threshold: int = 5
+    # mesh round timeline entries kept (FIFO) for /debug/device
+    rounds: int = 256
+
+
+@dataclass
 class TestConfig:
     """Write-load generator (ref: config.rs:48-57)."""
 
@@ -208,6 +231,9 @@ class ServerConfig:
     # memory plane: ledger sampler + pressure watermarks
     # (common/memledger.py, GET /debug/memory)
     memory: MemoryConfig = field(default_factory=MemoryConfig)
+    # device plane: compile ledger + dispatch profiler + transfer
+    # accounting (common/deviceprof.py, GET /debug/device)
+    deviceprof: DeviceprofConfig = field(default_factory=DeviceprofConfig)
     # replication plane: WAL shipping + lease-fenced ownership
     # (cluster/replication.py); disabled reproduces single-copy
     # behavior bit-for-bit
@@ -288,6 +314,9 @@ def _dc_from_dict(cls: type, data: dict[str, Any]) -> Any:
         elif key == "memory" and cls is ServerConfig:
             ensure(isinstance(value, dict), f"{where} expects a config table")
             kwargs[key] = _dc_from_dict(MemoryConfig, value)
+        elif key == "deviceprof":
+            ensure(isinstance(value, dict), f"{where} expects a config table")
+            kwargs[key] = _dc_from_dict(DeviceprofConfig, value)
         elif key == "replication":
             ensure(isinstance(value, dict), f"{where} expects a config table")
             kwargs[key] = _dc_from_dict(ReplicationConfig, value)
@@ -364,6 +393,13 @@ def load_config(path: Optional[str] = None) -> ServerConfig:
            "[watchdog] interval must be positive")
     ensure(cfg.memory.interval.seconds > 0,
            "[memory] interval must be positive")
+    ensure(cfg.deviceprof.storm_threshold >= 2,
+           "[deviceprof] storm_threshold must be >= 2 (1 would flag "
+           "every cold compile as a storm)")
+    ensure(cfg.deviceprof.storm_window.seconds > 0,
+           "[deviceprof] storm_window must be positive")
+    ensure(cfg.deviceprof.rounds >= 1,
+           "[deviceprof] rounds must be >= 1")
     ensure(0.0 <= cfg.memory.hysteresis <= 0.5,
            "[memory] hysteresis must be in [0, 0.5]")
     if cfg.memory.soft_limit.bytes and cfg.memory.hard_limit.bytes:

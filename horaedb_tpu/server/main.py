@@ -41,6 +41,7 @@ from horaedb_tpu.common.deadline import (
     DeadlineExceeded,
     deadline_scope,
 )
+from horaedb_tpu.common.deviceprof import profiler as deviceprof
 from horaedb_tpu.common.loops import loops
 from horaedb_tpu.common.memledger import ledger as memledger
 from horaedb_tpu.common.tenant import (
@@ -76,7 +77,7 @@ _UNGOVERNED_ENDPOINTS = frozenset({
     "/admin/scrub", "/admin/flush", "/admin/rollups",
     "/admin/tenants", "/admin/rebalance",
     "/debug/traces", "/debug/traces/{trace_id}", "/debug/tasks",
-    "/debug/memory",
+    "/debug/memory", "/debug/device",
     # replication ops plane (cluster/replication.py): internal
     # node-to-node shipping — the follower bounds its RPCs client-side,
     # so replication never sheds under query admission pressure
@@ -443,6 +444,14 @@ class ServerState:
             hard_bytes=(config.memory.hard_limit.bytes
                         if config.memory.pressure else -1),
             hysteresis=config.memory.hysteresis)
+        # [deviceprof] applies to the process-wide device profiler:
+        # every jitted seam already routes through it (lint-enforced);
+        # this sets the storm watchdog + round-timeline knobs
+        deviceprof.configure(
+            enabled=config.deviceprof.enabled,
+            storm_window_s=config.deviceprof.storm_window.seconds,
+            storm_threshold=config.deviceprof.storm_threshold,
+            rounds_kept=config.deviceprof.rounds)
         # a cluster-backed server applies its [breaker] section to the
         # engine's scatter-gather policy (the setter re-points breakers
         # of already-attached remote regions too)
@@ -1081,6 +1090,19 @@ def build_app(state: ServerState) -> web.Application:
         This is the byte-plane twin of /debug/tasks."""
         return web.json_response(memledger.snapshot())
 
+    @routes.get("/debug/device")
+    async def debug_device(_req: web.Request) -> web.Response:
+        """The device plane (common/deviceprof.py): the compile-cache
+        table (per-fn compile counts/seconds, last cache key, storm
+        state), dispatch/exec time split, h2d/d2h transfer totals, the
+        mesh round timeline (slot fill, padding waste, per-shard row
+        imbalance), and per-device memory with high-water marks.  This
+        is the jit seam's /debug/memory."""
+        out = deviceprof.snapshot()
+        sample = memledger.sample_once()
+        out["devices"] = sample.get("devices", [])
+        return web.json_response(out)
+
     @routes.get("/debug/traces/{trace_id}")
     async def debug_trace(req: web.Request) -> web.Response:
         """One trace as a JSON span tree: per-stage durations, cache
@@ -1107,6 +1129,8 @@ def build_app(state: ServerState) -> web.Application:
         out["loops"] = loops.summary()
         # the memory plane's compact rollup (full tree on /debug/memory)
         out["memory"] = memledger.summary()
+        # the device plane's compact rollup (full table on /debug/device)
+        out["deviceprof"] = deviceprof.summary()
         if state.tenants is not None:
             out["tenants"] = _tenant_stats(state)
         return web.json_response(out)

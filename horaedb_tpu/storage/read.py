@@ -39,6 +39,7 @@ import pyarrow as pa
 import jax
 import jax.numpy as jnp
 
+from horaedb_tpu.common import deviceprof
 from horaedb_tpu.common.deadline import checkpoint as deadline_checkpoint
 from horaedb_tpu.common.error import Error, ensure
 from horaedb_tpu.common.memledger import ledger as memledger
@@ -625,6 +626,12 @@ class ParquetReader:
         for acct in self._mem_accounts:
             memledger.deregister(acct)
         self._mem_accounts = []
+        # device-plane clear-on-close: compile/dispatch/transfer
+        # families and the per-device high-water marks are process
+        # -global like the mesh gauges above — a closed table leaves
+        # them zeroed/absent (last-writer semantics)
+        deviceprof.profiler.clear()
+        memledger.reset_device_high_water()
 
     def _scan_cache_resident_bytes(self) -> int:
         """Actual bytes the tier-1 cache holds: column buffers at
@@ -2053,7 +2060,8 @@ class ParquetReader:
                           for k, v in sub.items()}
             if n_win == 0:
                 continue
-            dev_cols = {name: jax.device_put(c) for name, c in padded.items()}
+            dev_cols = {name: deviceprof.device_put(c)
+                        for name, c in padded.items()}
             pks = tuple(dev_cols[name] for name in sort_pk_names)
             seq = dev_cols[SEQ_COLUMN_NAME]
             values = tuple(dev_cols[name] for name in carry_names)
@@ -2496,7 +2504,7 @@ class ParquetReader:
         t0 = time.perf_counter()
         final = _fused_finalize_jit(acc, spec.which)
         out = {k: v[:g] for k, v in final.items()}
-        jax.block_until_ready(out)
+        deviceprof.block_until_ready(out, fn="fused_rounds")
         t_dev += time.perf_counter() - t0
         return out, t_dev
 
@@ -3027,22 +3035,41 @@ class ParquetReader:
             from horaedb_tpu.storage import pipeline as pipeline_mod
 
             pipeline_mod.note_mesh_stall("series")
+        rows_per_shard = [int(it[1].n_valid) for it in items]
+        pad_rows = (T - len(items)) * cap \
+            + sum(cap - r for r in rows_per_shard)
         if not download:
             _STAGE_SECONDS["mesh_aggregate"].observe(
                 time.perf_counter() - t0)
+            deviceprof.record_round(
+                "mesh_run", slots=len(items), capacity=T,
+                rows_per_shard=rows_per_shard, padding_rows=pad_rows,
+                seconds=time.perf_counter() - t0)
             return {"out": out, "runs": runs, "lo": lo,
                     "lo_dev": lo_dev, "g": g, "width": width}
         entries: list = []
         cells = 0
+        dl_bytes = 0
+        t_dl = time.perf_counter()
         for s, a, b in runs:
             lo_run, grids = self._slice_mesh_part(out, b, g, int(lo[b]),
                                                   width, spec)
             cells += sum(int(v.shape[0] * v.shape[1])
                          for v in grids.values())
+            dl_bytes += sum(int(v.nbytes) for v in grids.values())
             entries.append((s, (group_space, lo_run, grids), b - a + 1))
+        # the tail-grid downloads above synced the dispatch — exec and
+        # d2h attribution for the round lands here
+        deviceprof.observe_exec("mesh_run_partials",
+                                time.perf_counter() - t_dl)
+        deviceprof.charge_transfer("d2h", dl_bytes)
         _STAGE_SECONDS["mesh_aggregate"].observe(time.perf_counter() - t0)
         _MESH_PARTS.inc(len(entries))
         _MESH_PART_CELLS.inc(cells)
+        deviceprof.record_round(
+            "mesh_run", slots=len(items), capacity=T,
+            rows_per_shard=rows_per_shard, padding_rows=pad_rows,
+            seconds=time.perf_counter() - t0)
         return entries
 
     @staticmethod
@@ -3304,7 +3331,9 @@ class ParquetReader:
         entries: list = []
         cells = 0
         src_rows = 0
+        dl_bytes = 0
         a = 0
+        t_dl = time.perf_counter()
         for i in range(len(chunk)):
             if i + 1 < len(chunk) and seg_ids[i + 1] == seg_ids[i]:
                 continue
@@ -3319,12 +3348,23 @@ class ParquetReader:
                     lt + dp.lo * spec.bucket_ms, lt)
             cells += sum(int(v.shape[0] * v.shape[1])
                          for v in grids.values())
+            dl_bytes += sum(int(v.nbytes) for v in grids.values())
             src_rows += sum(dp2.es.n for _s2, dp2 in chunk[a:i + 1])
             entries.append(
                 (s, (dp.values, dp.lo, grids), i - a + 1))
             a = i + 1
+        deviceprof.observe_exec("mesh_decode_partials",
+                                time.perf_counter() - t_dl)
+        deviceprof.charge_transfer("d2h", dl_bytes)
         _MESH_PARTS.inc(len(entries))
         _MESH_PART_CELLS.inc(cells)
+        deviceprof.record_round(
+            "mesh_decode", slots=len(chunk), capacity=T,
+            rows_per_shard=[int(dp.es.n) for _s, dp in chunk],
+            padding_rows=(T - len(chunk)) * cap
+            + sum(cap - int(dp.es.n) for _s, dp in chunk),
+            upload_bytes=upload_bytes, stack_hit=cached is not None,
+            seconds=time.perf_counter() - t0)
         device_decode.observe_decode_stage(
             time.perf_counter() - t0, rows=src_rows,
             nbytes=upload_bytes)
@@ -4041,7 +4081,7 @@ class ParquetReader:
                 put = functools.partial(shard_leading_axis, self.mesh)
                 sharded = True
             else:
-                put = jax.device_put
+                put = deviceprof.device_put
         if stack_key is None:
             space_fp = (len(group_space), hash(group_space.tobytes()))
             stack_key = self._round_stack_key(items[0][0], spec, plan,
@@ -4436,8 +4476,7 @@ def _host_window_partials(items: list, spec: AggregateSpec,
     return parts
 
 
-@functools.partial(jax.jit, static_argnames=("num_groups", "num_buckets",
-                                             "which"))
+@deviceprof.jit(static_argnames=("num_groups", "num_buckets", "which"))
 def _fused_acc_init_jit(*, num_groups: int, num_buckets: int, which: tuple):
     """Query-global device accumulator grids with combine-identity
     inits (matching ops.downsample partial conventions)."""
@@ -4459,8 +4498,8 @@ def _fused_acc_init_jit(*, num_groups: int, num_buckets: int, which: tuple):
     return acc
 
 
-@functools.partial(jax.jit, static_argnames=("num_groups", "width", "which"),
-                   donate_argnums=(0,))
+@deviceprof.jit(static_argnames=("num_groups", "width", "which"),
+                donate_argnums=(0,))
 def _fused_round_accumulate_jit(acc, ts, gid, vals, remap, shift, lo, total,
                                 bucket_ms, *, num_groups: int, width: int,
                                 which: tuple):
@@ -4520,7 +4559,7 @@ def _fused_round_accumulate_jit(acc, ts, gid, vals, remap, shift, lo, total,
     return jax.lax.fori_loop(0, ts.shape[0], body, acc)
 
 
-@functools.partial(jax.jit, static_argnames=("which",))
+@deviceprof.jit(static_argnames=("which",))
 def _fused_finalize_jit(acc: dict, which: tuple) -> dict:
     """Device finalize of the fused accumulator.  Conventions match
     combine_aggregate_parts: min/max empty cells read +/-inf, avg/last
@@ -4546,15 +4585,14 @@ def _fused_finalize_jit(acc: dict, which: tuple) -> dict:
     return out
 
 
-@jax.jit
+@deviceprof.jit
 def _group_has_data_jit(count):
     """Per-group any-data mask — G bools, the only bytes the aligned
     fast path's empty-group check ever downloads."""
     return (count > 0).any(axis=1)
 
 
-@functools.partial(jax.jit, static_argnames=("num_groups", "num_buckets",
-                                             "which"))
+@deviceprof.jit(static_argnames=("num_groups", "num_buckets", "which"))
 def _batched_window_partials_jit(ts, gid, vals, remap, shift, lo, total,
                                  bucket_ms, num_groups: int,
                                  num_buckets: int, which: tuple):

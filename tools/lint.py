@@ -401,6 +401,19 @@ def _lax_sort_outside_merge(node: ast.Call) -> bool:
     return "lax" in chain
 
 
+def _bare_jax_jit(node: ast.Attribute) -> bool:
+    """Any `jax.jit` reference outside common/deviceprof.py: the
+    compile ledger only sees seams that route through deviceprof.jit —
+    a bare jax.jit (decorator, functools.partial, or direct call; all
+    three forms contain the `jax.jit` attribute node this matches)
+    compiles invisibly, so its recompile storms, dispatch wall, and
+    compile seconds never reach /debug/device or the per-trace
+    attribution.  Wrap with deviceprof.jit, or noqa WITH a reason (the
+    bench suite's unprofiled baselines are the intended escape)."""
+    return (node.attr == "jit" and isinstance(node.value, ast.Name)
+            and node.value.id == "jax")
+
+
 def _mesh_construction_outside_parallel(node: ast.Call) -> bool:
     """Mesh/shard_map/NamedSharding construction outside
     horaedb_tpu/parallel/: mesh topology and sharding specs stay
@@ -645,6 +658,19 @@ def lint_file(path: pathlib.Path) -> list[str]:
                     "seam (ops/merge.lex_sort) so presorted and k-way "
                     "-mergeable inputs can bypass it; call lex_sort / "
                     "kway_merge_perm instead (docs/parallel.md)")
+        elif (isinstance(node, ast.Attribute)
+                and "horaedb_tpu" in path.parts
+                and path.name != "deviceprof.py"
+                and _bare_jax_jit(node)):
+            src = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+            if "noqa" not in src:
+                problems.append(
+                    f"{path}:{node.lineno}: bare jax.jit outside "
+                    "common/deviceprof.py — jitted seams route through "
+                    "deviceprof.jit so the compile ledger, dispatch "
+                    "profiler, and recompile-storm watchdog see them "
+                    "(GET /debug/device; docs/observability.md); noqa "
+                    "with a reason for intentional unprofiled sites")
         elif (isinstance(node, ast.Call) and "horaedb_tpu" in path.parts
                 and "parallel" not in path.parts
                 and _mesh_construction_outside_parallel(node)):

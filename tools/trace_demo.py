@@ -6,7 +6,14 @@ With `--ops`, also exercise the BACKGROUND plane — force SSTs, trigger
 a compaction and a rollup maintenance pass — then pretty-print the most
 recent op traces (/debug/traces?kind=op) alongside the query tree.
 
-Usage: python tools/trace_demo.py [--port N] [--ops]
+With `--device` (`make trace-demo-device`), exercise the DEVICE plane
+instead: drive a cold fused mesh-decode aggregate directly against a
+throwaway CloudObjectStorage, repeat it warm, and pretty-print the
+compile/dispatch/exec/transfer attribution the profiler collected —
+the same tables `GET /debug/device` serves, plus the per-trace device
+twins showing the warm repeat paid nothing.
+
+Usage: python tools/trace_demo.py [--port N] [--ops | --device]
 """
 
 from __future__ import annotations
@@ -62,6 +69,130 @@ async def _print_op_trace(s, base: str, timeout, op: str,
                 for k, v in sorted(trace.get("counters", {}).items())}
     if counters:
         print(json.dumps(counters, indent=2))
+
+
+def _device_twins(trace) -> dict:
+    return {k: round(v, 2) for k, v in sorted(trace.counters.items())
+            if k.startswith("stage_device_") or k.startswith("device_")}
+
+
+async def device_main() -> int:
+    """The --device leg: cold fused mesh-decode round, warm repeat,
+    then the profiler's attribution tables (docs/observability.md,
+    device plane)."""
+    import random
+
+    # the bit-identity convention: aggregate with the XLA window
+    # kernel so the fused dispatch actually runs on the device path
+    os.environ["HORAEDB_HOST_AGG"] = "0"
+
+    from horaedb_tpu.common import ReadableDuration, deviceprof
+    from horaedb_tpu.common import runtimes as runtimes_mod
+    from horaedb_tpu.objstore import MemoryObjectStore
+    from horaedb_tpu.storage.config import (
+        StorageConfig,
+        ThreadsConfig,
+        from_dict,
+    )
+    from horaedb_tpu.storage.read import AggregateSpec, ScanRequest
+    from horaedb_tpu.storage.storage import CloudObjectStorage, WriteRequest
+    from horaedb_tpu.storage.types import TimeRange
+    from horaedb_tpu.utils import tracing
+
+    import pyarrow as pa
+
+    segment_ms = 3_600_000
+    schema = pa.schema([("k", pa.string()), ("ts", pa.int64()),
+                        ("v", pa.float64())])
+    cfg = from_dict(StorageConfig, {
+        "scheduler": {"schedule_interval": "1h", "input_sst_min_num": 2},
+        "scan": {"mesh": {"enabled": True},
+                 "decode": {"mode": "device"}},
+    })
+    cfg.manifest.merge_interval = ReadableDuration.parse("1h")
+    cfg.scrub.interval = ReadableDuration.parse("1h")
+    rt = runtimes_mod.from_config(ThreadsConfig())
+    s = await CloudObjectStorage.open(
+        "db", segment_ms, MemoryObjectStore(), schema, 2, cfg,
+        runtimes=rt)
+    try:
+        rng = random.Random(1337)
+        for seg in range(3):
+            rows = [(f"k{rng.randint(0, 7)}",
+                     seg * segment_ms + rng.randrange(
+                         0, segment_ms - 1000, 250),
+                     float(rng.randint(0, 10**6))) for _ in range(300)]
+            lo = min(r[1] for r in rows)
+            hi = max(r[1] for r in rows) + 1
+            k, t, v = zip(*rows)
+            b = pa.record_batch(
+                [pa.array(list(k)), pa.array(list(t), type=pa.int64()),
+                 pa.array(list(v), type=pa.float64())], schema=schema)
+            await s.write(WriteRequest(b, TimeRange.new(lo, hi)))
+        s.reader.scan_cache.clear()
+        s.reader.encoded_cache.clear()
+        s.reader.parts_memo.clear()
+        deviceprof.profiler.clear()
+        tracing.recorder.configure(enabled=True, sample_rate=1.0)
+
+        spec = AggregateSpec(
+            group_col="k", ts_col="ts", value_col="v", range_start=0,
+            bucket_ms=60_000,
+            num_buckets=-(-(3 * segment_ms) // 60_000),
+            which=("avg", "max", "last"))
+        req = ScanRequest(range=TimeRange.new(0, 3 * segment_ms))
+
+        async def traced_scan(name):
+            trace = tracing.recorder.start(name)
+            t0 = asyncio.get_running_loop().time()
+            with tracing.trace_scope(trace):
+                await s.scan_aggregate(req, spec)
+            wall_ms = (asyncio.get_running_loop().time() - t0) * 1e3
+            tracing.recorder.finish(trace)
+            return trace, wall_ms
+
+        cold, cold_ms = await traced_scan("/scan-cold")
+        warm, warm_ms = await traced_scan("/scan-warm")
+
+        snap = deviceprof.profiler.snapshot()
+        print("== compile ledger (cold mesh-decode + warm repeat) ==")
+        hdr = (f"{'fn':<34s} {'comp':>4s} {'comp_ms':>8s} "
+               f"{'disp':>4s} {'disp_ms':>8s} {'exec':>4s} "
+               f"{'exec_ms':>8s}")
+        print(hdr)
+        for rec in snap["fns"]:
+            if not (rec["compiles"] or rec["dispatches"] or rec["execs"]):
+                continue
+            print(f"{rec['fn']:<34.34s} {rec['compiles']:>4d} "
+                  f"{rec['compile_seconds'] * 1e3:>8.1f} "
+                  f"{rec['dispatches']:>4d} "
+                  f"{rec['dispatch_seconds'] * 1e3:>8.1f} "
+                  f"{rec['execs']:>4d} "
+                  f"{rec['exec_seconds'] * 1e3:>8.1f}")
+        print("\n== transfers ==")
+        for d, t in snap["transfer"].items():
+            print(f"  {d}: {t['bytes']:>10d} B in {t['count']:>3d} "
+                  f"transfers ({t['seconds'] * 1e3:.2f} ms)")
+        if snap["rounds"]:
+            print("\n== mesh round timeline ==")
+            for r in snap["rounds"]:
+                rows_s = ""
+                if "row_imbalance" in r:
+                    rows_s = (f" imbalance={r['row_imbalance']} "
+                              f"shard_rows={r['shard_rows']}")
+                print(f"  {r['kind']:<12s} fill={r['fill_ratio']} "
+                      f"({r['slots']}/{r['capacity']}) "
+                      f"pad_rows={r['padding_rows']} "
+                      f"stack_hit={r['stack_hit']}{rows_s}")
+        print(f"\n== cold scan ({cold_ms:.1f} ms wall) device twins ==")
+        print(json.dumps(_device_twins(cold), indent=2))
+        print(f"\n== warm repeat ({warm_ms:.1f} ms wall) device twins "
+              f"(memo-served: expect none) ==")
+        print(json.dumps(_device_twins(warm), indent=2))
+    finally:
+        await s.close()
+        rt.close()
+    return 0
 
 
 async def main(port: int, ops: bool = False) -> int:
@@ -168,5 +299,12 @@ if __name__ == "__main__":
                         help="also provoke + pretty-print background "
                              "op traces (compaction, roll pass) and "
                              "the /debug/tasks loop table")
+    parser.add_argument("--device", action="store_true",
+                        help="device-plane demo: cold fused "
+                             "mesh-decode round + warm repeat, then "
+                             "the compile/dispatch/exec/transfer "
+                             "attribution tables")
     args = parser.parse_args()
+    if args.device:
+        sys.exit(asyncio.run(device_main()))
     sys.exit(asyncio.run(main(args.port, ops=args.ops)))
